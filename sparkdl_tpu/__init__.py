@@ -1,0 +1,58 @@
+"""sparkdl_tpu — TPU-native Deep Learning Pipelines.
+
+A brand-new, TPU-first framework with the capabilities of Databricks' Deep
+Learning Pipelines (``sparkdl``; reference mirror
+``codealphago/spark-deep-learning`` — see SURVEY.md): pretrained-CNN
+featurization/prediction over image dataframes, arbitrary-model batch
+inference, SQL-UDF model serving, and distributed fine-tuning with
+hyperparameter search — rebuilt on JAX/XLA/PJRT with jit-compiled Flax models,
+``jax.sharding`` data/model parallelism over TPU ICI, Pallas kernels for hot
+host↔device preprocessing, and orbax checkpointing.
+
+Public API (reference analog: ``python/sparkdl/__init__.py``† ``__all__``).
+Exports resolve lazily (PEP 562) so importing the package stays cheap and
+partial installs remain usable.
+"""
+
+import importlib
+import os
+
+# Keras (used only for model ingestion) must run on its JAX backend so
+# imported models jit straight onto TPU. Must be set before keras is imported
+# anywhere in the process.
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+VERSION = __version__ = "0.1.0"
+
+_EXPORTS = {
+    "XlaFunction": "sparkdl_tpu.graph.function",
+    "imageSchema": "sparkdl_tpu.image.imageIO",
+    "imageType": "sparkdl_tpu.image.imageIO",
+    "readImages": "sparkdl_tpu.image.imageIO",
+    "TPUImageTransformer": "sparkdl_tpu.transformers.tf_image",
+    "TFImageTransformer": "sparkdl_tpu.transformers.tf_image",
+    "DeepImagePredictor": "sparkdl_tpu.transformers.named_image",
+    "DeepImageFeaturizer": "sparkdl_tpu.transformers.named_image",
+    "KerasImageFileTransformer": "sparkdl_tpu.transformers.keras_image",
+    "TPUTransformer": "sparkdl_tpu.transformers.tf_tensor",
+    "TFTransformer": "sparkdl_tpu.transformers.tf_tensor",
+    "KerasTransformer": "sparkdl_tpu.transformers.keras_tensor",
+    "KerasImageFileEstimator": "sparkdl_tpu.estimators.keras_image_file_estimator",
+    "registerKerasImageUDF": "sparkdl_tpu.udf.keras_image_model",
+    "TPUSession": "sparkdl_tpu.sql.session",
+}
+
+__all__ = ["VERSION", *sorted(_EXPORTS)]
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        module = importlib.import_module(_EXPORTS[name])
+        value = getattr(module, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

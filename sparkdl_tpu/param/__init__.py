@@ -1,0 +1,37 @@
+"""Spark-ML-compatible typed parameter system.
+
+This is the config backbone of the framework (reference analog:
+``python/sparkdl/param/`` plus the ``pyspark.ml.param`` core it builds on —
+see SURVEY.md §5.6).  It re-implements just enough of the pyspark ``Params`` /
+``Param`` / ``TypeConverters`` semantics that param grids, ``CrossValidator``
+and ``keyword_only`` setters work unmodified, without a pyspark dependency.
+"""
+
+from sparkdl_tpu.param.base import Param, Params, TypeConverters, keyword_only
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
+from sparkdl_tpu.param.shared import (
+    CanLoadImage,
+    HasInputCol,
+    HasKerasLoss,
+    HasKerasModel,
+    HasKerasOptimizer,
+    HasLabelCol,
+    HasOutputCol,
+    HasOutputMode,
+)
+
+__all__ = [
+    "Param",
+    "Params",
+    "TypeConverters",
+    "keyword_only",
+    "SparkDLTypeConverters",
+    "HasInputCol",
+    "HasOutputCol",
+    "HasLabelCol",
+    "HasOutputMode",
+    "CanLoadImage",
+    "HasKerasModel",
+    "HasKerasOptimizer",
+    "HasKerasLoss",
+]

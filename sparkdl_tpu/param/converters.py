@@ -1,0 +1,83 @@
+"""Domain type converters for framework params.
+
+Reference analog: ``python/sparkdl/param/converters.py``†
+(``SparkDLTypeConverters``: ``toTFGraph``, ``toStringOrTFTensor``,
+``toKerasLoss``, ``toKerasOptimizer``, channel order — SURVEY.md §2).
+Here the graph object is an :class:`~sparkdl_tpu.graph.XlaFunction` instead of
+a TF 1.x ``tf.Graph``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+SUPPORTED_CHANNEL_ORDERS = ("RGB", "BGR", "L")
+
+# Keras-compatible loss / optimizer names we can map onto optax (see
+# sparkdl_tpu.estimators.losses). Kept as data so converters don't import jax.
+KERAS_LOSS_NAMES = frozenset(
+    {
+        "categorical_crossentropy",
+        "sparse_categorical_crossentropy",
+        "binary_crossentropy",
+        "mean_squared_error",
+        "mse",
+        "mean_absolute_error",
+        "mae",
+    }
+)
+KERAS_OPTIMIZER_NAMES = frozenset(
+    {"sgd", "adam", "adamw", "rmsprop", "adagrad", "nadam", "lamb", "lion"}
+)
+
+
+class SparkDLTypeConverters:
+    @staticmethod
+    def toXlaFunction(value: Any):
+        from sparkdl_tpu.graph.function import XlaFunction
+
+        if isinstance(value, XlaFunction):
+            return value
+        raise TypeError(
+            "Could not convert %s to XlaFunction" % type(value)
+        )
+
+    # Alias kept for API parity with the reference's ``toTFGraph``.
+    toGraph = toXlaFunction
+
+    @staticmethod
+    def toChannelOrder(value: Any) -> str:
+        if isinstance(value, str) and value.upper() in SUPPORTED_CHANNEL_ORDERS:
+            return value.upper()
+        raise TypeError(
+            "Channel order must be one of %s, got %r"
+            % (SUPPORTED_CHANNEL_ORDERS, value)
+        )
+
+    @staticmethod
+    def toStringOrTensorName(value: Any) -> str:
+        """Accept a plain output name string (the TF-tensor analog)."""
+        if isinstance(value, str):
+            return value
+        raise TypeError("Could not convert %r to an output name" % (value,))
+
+    @staticmethod
+    def toKerasLoss(value: Any):
+        if callable(value):
+            return value
+        if isinstance(value, str) and value.lower() in KERAS_LOSS_NAMES:
+            return value.lower()
+        raise ValueError(
+            "Named loss not supported in Keras or unknown: %r" % (value,)
+        )
+
+    @staticmethod
+    def toKerasOptimizer(value: Any):
+        if isinstance(value, str) and value.lower() in KERAS_OPTIMIZER_NAMES:
+            return value.lower()
+        # allow a pre-built optax.GradientTransformation
+        if hasattr(value, "init") and hasattr(value, "update"):
+            return value
+        raise ValueError(
+            "Named optimizer not supported or unknown: %r" % (value,)
+        )

@@ -1,0 +1,78 @@
+"""Params system tests (reference analog: pyspark.ml.param semantics relied
+on throughout ``python/sparkdl/param/``† — SURVEY.md §2/§5.6)."""
+
+import pytest
+
+from sparkdl_tpu.param import (
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    Params,
+    TypeConverters,
+    keyword_only,
+)
+
+
+class _Stage(HasInputCol, HasOutputCol):
+    threshold = Param(
+        "undefined", "threshold", "a float param", TypeConverters.toFloat
+    )
+
+    @keyword_only
+    def __init__(self, inputCol=None, outputCol=None, threshold=None):
+        super().__init__()
+        self._setDefault(threshold=0.5, outputCol="out")
+        kwargs = self._input_kwargs
+        self._set(**kwargs)
+
+
+def test_defaults_and_set():
+    s = _Stage(inputCol="x")
+    assert s.getInputCol() == "x"
+    assert s.getOutputCol() == "out"
+    assert s.getOrDefault("threshold") == 0.5
+    s.setOutputCol("y")
+    assert s.getOutputCol() == "y"
+    assert s.isSet(s.outputCol)
+    assert not s.isSet(s.threshold)
+    assert s.isDefined(s.threshold)
+
+
+def test_type_conversion_and_validation():
+    s = _Stage(inputCol="x", threshold=1)
+    assert isinstance(s.getOrDefault("threshold"), float)
+    with pytest.raises(TypeError):
+        s.set(s.threshold, "not-a-float")
+    with pytest.raises(TypeError):
+        _Stage(inputCol=3)
+
+
+def test_copy_with_extra():
+    s = _Stage(inputCol="x")
+    extra = {s.threshold: 0.9}
+    c = s.copy(extra)
+    assert c.getOrDefault(c.threshold) == 0.9
+    assert s.getOrDefault(s.threshold) == 0.5  # original untouched
+    assert c.uid == s.uid
+    assert c.getInputCol() == "x"
+    # param identity across copies (param grid semantics)
+    assert c.threshold == s.threshold
+
+
+def test_param_independence_between_instances():
+    a = _Stage(inputCol="a")
+    b = _Stage(inputCol="b")
+    a.setOutputCol("oa")
+    assert b.getOutputCol() == "out"
+    assert a.getInputCol() == "a" and b.getInputCol() == "b"
+
+
+def test_explain_params():
+    s = _Stage(inputCol="x")
+    text = s.explainParams()
+    assert "threshold" in text and "default: 0.5" in text
+
+
+def test_keyword_only_rejects_positional():
+    with pytest.raises(TypeError):
+        _Stage("x")
