@@ -1,0 +1,7 @@
+"""Spark-ML-compatible pipeline layer (Transformer/Estimator/Pipeline/
+CrossValidator) re-implemented natively — SURVEY.md §7 step 7."""
+
+from sparkdl_tpu.ml.base import Estimator, Model, Transformer
+from sparkdl_tpu.ml.pipeline import Pipeline, PipelineModel
+
+__all__ = ["Transformer", "Estimator", "Model", "Pipeline", "PipelineModel"]

@@ -1,0 +1,96 @@
+"""Transformer / Estimator / Model abstractions (pyspark.ml.base subset).
+
+Reference analog: the Spark ML pipeline-stage contract every sparkdl stage
+implements (SURVEY.md §1 L5): ``Transformer.transform(df[, params])``,
+``Estimator.fit(df[, params])`` with list-of-paramMaps fan-out and
+``fitMultiple`` for parallel tuning.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from sparkdl_tpu.param.base import Param, Params
+
+
+class Transformer(Params, metaclass=abc.ABCMeta):
+    def transform(self, dataset, params: Optional[Dict[Param, Any]] = None):
+        if params is None:
+            params = {}
+        if isinstance(params, dict):
+            if params:
+                return self.copy(params)._transform(dataset)
+            return self._transform(dataset)
+        raise TypeError(f"Params must be a param map but got {type(params)}.")
+
+    @abc.abstractmethod
+    def _transform(self, dataset):
+        ...
+
+
+class Model(Transformer, metaclass=abc.ABCMeta):
+    """A Transformer produced by an Estimator."""
+
+
+class Estimator(Params, metaclass=abc.ABCMeta):
+    @abc.abstractmethod
+    def _fit(self, dataset) -> Model:
+        ...
+
+    def fit(
+        self,
+        dataset,
+        params: "Optional[Dict[Param, Any] | Sequence[Dict[Param, Any]]]" = None,
+    ):
+        if params is None:
+            params = {}
+        if isinstance(params, (list, tuple)):
+            models: List[Optional[Model]] = [None] * len(params)
+            for index, model in self.fitMultiple(dataset, params):
+                models[index] = model
+            return models
+        if isinstance(params, dict):
+            if params:
+                return self.copy(params)._fit(dataset)
+            return self._fit(dataset)
+        raise TypeError(
+            "Params must be either a param map or a list/tuple of param "
+            f"maps, but got {type(params)}."
+        )
+
+    def fitMultiple(
+        self, dataset, paramMaps: Sequence[Dict[Param, Any]]
+    ) -> Iterator[Tuple[int, Model]]:
+        """Fit one model per param map; yields ``(index, model)`` possibly
+        out of order.  Thread-safe iterator (CrossValidator drives it from a
+        thread pool, matching pyspark semantics)."""
+        estimator = self.copy()
+        lock = threading.Lock()
+        indices = iter(range(len(paramMaps)))
+
+        class _Iter:
+            def __iter__(self):
+                return self
+
+            def __next__(inner):
+                with lock:
+                    index = next(indices)
+                return index, estimator.fit(dataset, paramMaps[index])
+
+        return _Iter()
+
+
+class Evaluator(Params, metaclass=abc.ABCMeta):
+    @abc.abstractmethod
+    def _evaluate(self, dataset) -> float:
+        ...
+
+    def evaluate(self, dataset, params: Optional[Dict[Param, Any]] = None) -> float:
+        if params:
+            return self.copy(params)._evaluate(dataset)
+        return self._evaluate(dataset)
+
+    def isLargerBetter(self) -> bool:
+        return True

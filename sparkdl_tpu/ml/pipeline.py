@@ -1,0 +1,80 @@
+"""Pipeline / PipelineModel (pyspark.ml.pipeline subset).
+
+Chains Transformers/Estimators; used by the flagship transfer-learning flow
+``Pipeline([DeepImageFeaturizer, LogisticRegression])`` (SURVEY.md §7 step 4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from sparkdl_tpu.ml.base import Estimator, Model, Transformer
+from sparkdl_tpu.param.base import Param, Params, keyword_only
+
+
+class Pipeline(Estimator):
+    stages = Param("undefined", "stages", "a list of pipeline stages")
+
+    @keyword_only
+    def __init__(self, stages: Optional[List[Params]] = None):
+        super().__init__()
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(self, stages: Optional[List[Params]] = None):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    def setStages(self, value: List[Params]):
+        return self._set(stages=value)
+
+    def getStages(self) -> List[Params]:
+        return self.getOrDefault(self.stages)
+
+    def _fit(self, dataset) -> "PipelineModel":
+        stages = self.getStages()
+        for stage in stages:
+            if not isinstance(stage, (Estimator, Transformer)):
+                raise TypeError(
+                    f"Cannot recognize a pipeline stage of type {type(stage)}."
+                )
+        last_estimator = -1
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                last_estimator = i
+        transformers: List[Transformer] = []
+        for i, stage in enumerate(stages):
+            if i <= last_estimator:
+                if isinstance(stage, Estimator):
+                    model = stage.fit(dataset)
+                    transformers.append(model)
+                    if i < last_estimator:
+                        dataset = model.transform(dataset)
+                else:
+                    transformers.append(stage)
+                    if i < last_estimator:
+                        dataset = stage.transform(dataset)
+            else:
+                transformers.append(stage)
+        return PipelineModel(transformers)
+
+    def copy(self, extra=None):
+        that = Params.copy(self, extra)
+        if that.isDefined(that.stages):
+            that._set(stages=[s.copy() for s in that.getStages()])
+        return that
+
+
+class PipelineModel(Model):
+    def __init__(self, stages: List[Transformer]):
+        super().__init__()
+        self.stages = stages
+
+    def _transform(self, dataset):
+        for t in self.stages:
+            dataset = t.transform(dataset)
+        return dataset
+
+    def copy(self, extra=None):
+        return PipelineModel([s.copy() for s in self.stages])

@@ -1,0 +1,17 @@
+"""Columnar dataframe engine — the Spark substrate analog.
+
+The reference delegates scheduling/data movement to Apache Spark (SURVEY.md
+§1 L0).  pyspark is unavailable here, so this package provides a native
+partitioned-dataset engine with the Spark DataFrame/SQL API *shape* the
+``sparkdl`` layers need: partitioned columnar data, ``select``/``withColumn``/
+``collect``/``mapInArrow``-style partition mapping, Python UDF registration,
+temp views and a minimal ``SELECT`` dialect.  A real Spark binding can later
+be an adapter over the same Transformer/Estimator API.
+"""
+
+from sparkdl_tpu.sql.types import Row
+from sparkdl_tpu.sql.dataframe import DataFrame
+from sparkdl_tpu.sql.session import TPUSession
+from sparkdl_tpu.sql.functions import col, lit, udf
+
+__all__ = ["Row", "DataFrame", "TPUSession", "col", "lit", "udf"]

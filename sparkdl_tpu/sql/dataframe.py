@@ -1,0 +1,374 @@
+"""Partitioned columnar DataFrame.
+
+The engine substrate replacing Spark DataFrames (SURVEY.md §1 L0, §7):
+data lives as partitions of column→list dicts; ``mapPartitions`` is the
+primitive every model transformer builds on (the ``TensorFrames
+map_blocks`` analog — whole partitions reach the model runner so batching
+and jit caching work).  Interop: ``to_arrow``/``toPandas`` for columnar
+exchange with the native bridge.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+from sparkdl_tpu.sql.functions import Column, col as _col
+from sparkdl_tpu.sql.types import (
+    DataType,
+    Row,
+    StructField,
+    StructType,
+    infer_type,
+)
+
+Partition = Dict[str, List[Any]]
+
+
+def _partition_nrows(part: Partition) -> int:
+    if not part:
+        return 0
+    return len(next(iter(part.values())))
+
+
+class DataFrame:
+    def __init__(
+        self,
+        partitions: List[Partition],
+        schema: StructType,
+        session: "Any" = None,
+    ):
+        self._partitions = partitions
+        self._schema = schema
+        self.sql_ctx = self.sparkSession = session
+
+    # ------------------------------------------------------------------
+    # metadata
+    # ------------------------------------------------------------------
+    @property
+    def schema(self) -> StructType:
+        return self._schema
+
+    @property
+    def columns(self) -> List[str]:
+        return list(self._schema.names)
+
+    def printSchema(self):
+        print(self._schema.simpleString())
+
+    def getNumPartitions(self) -> int:
+        return len(self._partitions)
+
+    def count(self) -> int:
+        return sum(_partition_nrows(p) for p in self._partitions)
+
+    # ------------------------------------------------------------------
+    # actions
+    # ------------------------------------------------------------------
+    def collect(self) -> List[Row]:
+        names = self.columns
+        rows: List[Row] = []
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            cols = [part[c] for c in names]
+            rows.extend(Row._make(names, vals) for vals in zip(*cols))
+            if n and not names:
+                raise RuntimeError("partition with rows but no columns")
+        return rows
+
+    def take(self, num: int) -> List[Row]:
+        return self.limit(num).collect()
+
+    def head(self, n: Optional[int] = None):
+        if n is None:
+            rows = self.take(1)
+            return rows[0] if rows else None
+        return self.take(n)
+
+    def first(self):
+        return self.head()
+
+    def show(self, n: int = 20, truncate: bool = True):
+        rows = self.take(n)
+        print(" | ".join(self.columns))
+        for r in rows:
+            cells = []
+            for v in r:
+                s = repr(v)
+                if truncate and len(s) > 24:
+                    s = s[:21] + "..."
+                cells.append(s)
+            print(" | ".join(cells))
+
+    def toPandas(self):
+        import pandas as pd
+
+        names = self.columns
+        data = {c: [] for c in names}
+        for part in self._partitions:
+            for c in names:
+                data[c].extend(part[c])
+        return pd.DataFrame(data)
+
+    def to_arrow(self):
+        """Best-effort conversion of arrow-compatible columns to a pyarrow
+        Table (object/ndarray columns are converted via python lists)."""
+        import pyarrow as pa
+
+        names = self.columns
+        data = {c: [] for c in names}
+        for part in self._partitions:
+            for c in names:
+                data[c].extend(part[c])
+        return pa.table({c: pa.array(vals) for c, vals in data.items()})
+
+    # ------------------------------------------------------------------
+    # transformations
+    # ------------------------------------------------------------------
+    def _with_partitions(
+        self, partitions: List[Partition], schema: Optional[StructType] = None
+    ) -> "DataFrame":
+        return DataFrame(partitions, schema or self._schema, self.sparkSession)
+
+    def select(self, *cols: "Column | str") -> "DataFrame":
+        if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
+            cols = tuple(cols[0])
+        exprs: List[Column] = []
+        for c in cols:
+            if isinstance(c, str):
+                if c == "*":
+                    exprs.extend(_col(name) for name in self.columns)
+                else:
+                    exprs.append(_col(c))
+            else:
+                exprs.append(c)
+        out_parts: List[Partition] = []
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            out_parts.append({e._name: e._eval(part, n) for e in exprs})
+        new_schema = StructType()
+        probe = next((p for p in out_parts if _partition_nrows(p)), None)
+        for e in exprs:
+            dt = infer_type(probe[e._name][0]) if probe else self._field_type(e._name)
+            new_schema.add(e._name, dt)
+        return self._with_partitions(out_parts, new_schema)
+
+    def _field_type(self, name: str) -> DataType:
+        for f in self._schema:
+            if f.name == name:
+                return f.dataType
+        from sparkdl_tpu.sql.types import ObjectType
+
+        return ObjectType()
+
+    def withColumn(
+        self,
+        name: str,
+        value: "Column | Callable",
+        *input_cols: str,
+    ) -> "DataFrame":
+        """Add/replace a column.  ``value`` is a Column expression, or (engine
+        extension) a plain callable applied row-wise over ``input_cols``."""
+        if callable(value) and not isinstance(value, Column):
+            from sparkdl_tpu.sql.functions import udf as _udf
+
+            value = _udf(value)(*input_cols)
+        expr: Column = value
+        out_parts: List[Partition] = []
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            new_part = dict(part)
+            new_part[name] = expr._eval(part, n)
+            out_parts.append(new_part)
+        new_schema = StructType()
+        probe = next((p for p in out_parts if _partition_nrows(p)), None)
+        for f in self._schema:
+            if f.name != name:
+                new_schema.add(f.name, f.dataType)
+        new_schema.add(
+            name, infer_type(probe[name][0]) if probe else self._field_type(name)
+        )
+        return self._with_partitions(out_parts, new_schema)
+
+    def withColumnRenamed(self, existing: str, new: str) -> "DataFrame":
+        out_parts = []
+        for part in self._partitions:
+            p = dict(part)
+            if existing in p:
+                p[new] = p.pop(existing)
+            out_parts.append(p)
+        schema = StructType(
+            [
+                StructField(new if f.name == existing else f.name, f.dataType)
+                for f in self._schema
+            ]
+        )
+        return self._with_partitions(out_parts, schema)
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [c for c in self.columns if c not in names]
+        return self.select(*keep)
+
+    def filter(self, condition: "Column | Callable") -> "DataFrame":
+        out_parts = []
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            if isinstance(condition, Column):
+                mask = condition._eval(part, n)
+            else:
+                rows = list(zip(*[part[c] for c in self.columns]))
+                mask = [
+                    condition(Row._make(self.columns, vals)) for vals in rows
+                ]
+            out_parts.append(
+                {
+                    c: [v for v, m in zip(vals, mask) if m]
+                    for c, vals in part.items()
+                }
+            )
+        return self._with_partitions(out_parts)
+
+    where = filter
+
+    def limit(self, num: int) -> "DataFrame":
+        remaining = num
+        out_parts = []
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            k = min(n, remaining)
+            out_parts.append({c: vals[:k] for c, vals in part.items()})
+            remaining -= k
+            if remaining <= 0:
+                break
+        if not out_parts:
+            out_parts = [{c: [] for c in self.columns}]
+        return self._with_partitions(out_parts)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if self.columns != other.columns:
+            raise ValueError(
+                f"Union requires same columns: {self.columns} vs {other.columns}"
+            )
+        return self._with_partitions(self._partitions + other._partitions)
+
+    unionAll = union
+
+    def repartition(self, numPartitions: int) -> "DataFrame":
+        names = self.columns
+        all_cols: Dict[str, List[Any]] = {c: [] for c in names}
+        for part in self._partitions:
+            for c in names:
+                all_cols[c].extend(part[c])
+        total = len(next(iter(all_cols.values()))) if names else 0
+        numPartitions = max(1, numPartitions)
+        out_parts = []
+        for i in range(numPartitions):
+            lo = i * total // numPartitions
+            hi = (i + 1) * total // numPartitions
+            out_parts.append({c: all_cols[c][lo:hi] for c in names})
+        return self._with_partitions(out_parts)
+
+    coalesce = repartition
+
+    def randomSplit(
+        self, weights: Sequence[float], seed: Optional[int] = None
+    ) -> List["DataFrame"]:
+        rng = _random.Random(seed)
+        total_w = float(sum(weights))
+        cum = []
+        acc = 0.0
+        for w in weights:
+            acc += w / total_w
+            cum.append(acc)
+        buckets: List[List[Partition]] = [[] for _ in weights]
+        names = self.columns
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            assignment = [
+                next(i for i, c in enumerate(cum) if rng.random() <= c or i == len(cum) - 1)
+                for _ in range(n)
+            ]
+            for i in range(len(weights)):
+                buckets[i].append(
+                    {
+                        c: [v for v, a in zip(part[c], assignment) if a == i]
+                        for c in names
+                    }
+                )
+        return [self._with_partitions(b) for b in buckets]
+
+    def orderBy(self, *cols: str, ascending: bool = True) -> "DataFrame":
+        names = self.columns
+        rows = self.collect()
+        keys = [c if isinstance(c, str) else c._name for c in cols]
+        rows.sort(key=lambda r: tuple(r[k] for k in keys), reverse=not ascending)
+        part = {c: [r[c] for r in rows] for c in names}
+        return self._with_partitions([part])
+
+    sort = orderBy
+
+    def cache(self) -> "DataFrame":
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        return self
+
+    # ------------------------------------------------------------------
+    # partition-level compute (the hot path)
+    # ------------------------------------------------------------------
+    def mapPartitions(
+        self,
+        fn: Callable[[Partition], Partition],
+        schema: Optional[StructType] = None,
+    ) -> "DataFrame":
+        """Apply ``fn`` to each partition's column dict → new column dict.
+
+        This is the engine primitive under every model transformer (the
+        TensorFrames ``map_blocks`` analog — SURVEY.md §3.1 hot loop)."""
+        out_parts = [fn(dict(part)) for part in self._partitions]
+        if schema is None:
+            schema = StructType()
+            probe = next((p for p in out_parts if _partition_nrows(p)), None)
+            cols = list(out_parts[0].keys()) if out_parts else []
+            for c in cols:
+                schema.add(c, infer_type(probe[c][0]) if probe else self._field_type(c))
+        return self._with_partitions(out_parts, schema)
+
+    def mapInArrow(self, fn: Callable, schema: Optional[StructType] = None):
+        """Arrow-columnar partition mapping: ``fn(pyarrow.RecordBatch) ->
+        pyarrow.RecordBatch`` (native-bridge integration point)."""
+        import pyarrow as pa
+
+        def wrapper(part: Partition) -> Partition:
+            batch = pa.record_batch(
+                {c: pa.array(vals) for c, vals in part.items()}
+            )
+            out = fn(batch)
+            return {
+                name: out.column(i).to_pylist()
+                for i, name in enumerate(out.schema.names)
+            }
+
+        return self.mapPartitions(wrapper, schema)
+
+    def foreachPartition(self, fn: Callable[[Partition], None]):
+        for part in self._partitions:
+            fn(dict(part))
+
+    # ------------------------------------------------------------------
+    # views
+    # ------------------------------------------------------------------
+    def createOrReplaceTempView(self, name: str):
+        if self.sparkSession is None:
+            raise RuntimeError("DataFrame has no session")
+        self.sparkSession.catalog._views[name] = self
+
+    registerTempTable = createOrReplaceTempView
+
+    def __repr__(self):
+        cols = ", ".join(
+            f"{f.name}: {f.dataType.simpleString()}" for f in self._schema
+        )
+        return f"DataFrame[{cols}]"

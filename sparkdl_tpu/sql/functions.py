@@ -1,0 +1,209 @@
+"""Column expressions and UDFs (pyspark.sql.functions API subset).
+
+The engine's expression layer: a ``Column`` is a small eval tree applied
+per-partition over Python lists.  Python UDFs here are the L4 analog of the
+reference's TensorFrames-registered UDFs (SURVEY.md §2 "TensorFrames UDF
+maker") — model-backed UDFs built by :mod:`sparkdl_tpu.udf` evaluate whole
+partitions at once so batched, jit-compiled execution stays possible.
+"""
+
+from __future__ import annotations
+
+import operator
+from typing import Any, Callable, List, Optional, Sequence
+
+from sparkdl_tpu.sql.types import DataType, Row
+
+
+class Column:
+    """An expression evaluable against a partition (dict of column lists)."""
+
+    def __init__(self, eval_fn: Callable[[dict, int], List[Any]], name: str):
+        # eval_fn(partition_columns, n_rows) -> list of n_rows values
+        self._eval = eval_fn
+        self._name = name
+
+    # -- construction helpers --------------------------------------------
+    @staticmethod
+    def _column_ref(name: str) -> "Column":
+        def ev(cols, n):
+            if name not in cols:
+                raise KeyError(f"No such column: {name!r}")
+            return cols[name]
+
+        return Column(ev, name)
+
+    @staticmethod
+    def _literal(value: Any) -> "Column":
+        return Column(lambda cols, n: [value] * n, str(value))
+
+    def alias(self, name: str) -> "Column":
+        return Column(self._eval, name)
+
+    def getField(self, field: str) -> "Column":
+        def ev(cols, n):
+            return [v[field] if v is not None else None for v in self._eval(cols, n)]
+
+        return Column(ev, f"{self._name}.{field}")
+
+    getItem = getField
+
+    def cast(self, to: str) -> "Column":
+        caster = {
+            "int": int,
+            "long": int,
+            "float": float,
+            "double": float,
+            "string": str,
+            "boolean": bool,
+        }[to]
+
+        def ev(cols, n):
+            return [None if v is None else caster(v) for v in self._eval(cols, n)]
+
+        return Column(ev, self._name)
+
+    # -- operators --------------------------------------------------------
+    def _binop(self, other, op, sym) -> "Column":
+        other_col = other if isinstance(other, Column) else Column._literal(other)
+
+        def ev(cols, n):
+            return [
+                None if a is None or b is None else op(a, b)
+                for a, b in zip(self._eval(cols, n), other_col._eval(cols, n))
+            ]
+
+        return Column(ev, f"({self._name} {sym} {other_col._name})")
+
+    def __add__(self, other):
+        return self._binop(other, operator.add, "+")
+
+    def __sub__(self, other):
+        return self._binop(other, operator.sub, "-")
+
+    def __mul__(self, other):
+        return self._binop(other, operator.mul, "*")
+
+    def __truediv__(self, other):
+        return self._binop(other, operator.truediv, "/")
+
+    def __eq__(self, other):  # type: ignore[override]
+        return self._binop(other, operator.eq, "==")
+
+    def __ne__(self, other):  # type: ignore[override]
+        return self._binop(other, operator.ne, "!=")
+
+    def __lt__(self, other):
+        return self._binop(other, operator.lt, "<")
+
+    def __le__(self, other):
+        return self._binop(other, operator.le, "<=")
+
+    def __gt__(self, other):
+        return self._binop(other, operator.gt, ">")
+
+    def __ge__(self, other):
+        return self._binop(other, operator.ge, ">=")
+
+    def __and__(self, other):
+        return self._binop(other, operator.and_, "&")
+
+    def __or__(self, other):
+        return self._binop(other, operator.or_, "|")
+
+    def __invert__(self):
+        return Column(
+            lambda cols, n: [None if v is None else not v for v in self._eval(cols, n)],
+            f"(NOT {self._name})",
+        )
+
+    def isNull(self):
+        return Column(
+            lambda cols, n: [v is None for v in self._eval(cols, n)],
+            f"({self._name} IS NULL)",
+        )
+
+    def isNotNull(self):
+        return Column(
+            lambda cols, n: [v is not None for v in self._eval(cols, n)],
+            f"({self._name} IS NOT NULL)",
+        )
+
+    def __repr__(self):
+        return f"Column<{self._name}>"
+
+
+def col(name: str) -> Column:
+    return Column._column_ref(name)
+
+
+column = col
+
+
+def lit(value: Any) -> Column:
+    return Column._literal(value)
+
+
+def struct(*cols: "Column | str") -> Column:
+    cols_ = [c if isinstance(c, Column) else col(c) for c in cols]
+
+    def ev(colmap, n):
+        evaluated = [c._eval(colmap, n) for c in cols_]
+        names = [c._name for c in cols_]
+        return [Row._make(names, vals) for vals in zip(*evaluated)]
+
+    return Column(ev, "struct(%s)" % ", ".join(c._name for c in cols_))
+
+
+class UserDefinedFunction:
+    """A Python UDF. ``vectorized=True`` UDFs receive whole-partition lists
+    (the batched, TensorFrames-"blocked"-mode analog) and must return a list;
+    scalar UDFs receive one row's values."""
+
+    def __init__(
+        self,
+        func: Callable,
+        returnType: Optional[DataType] = None,
+        name: Optional[str] = None,
+        vectorized: bool = False,
+    ):
+        self.func = func
+        self.returnType = returnType
+        self._name = name or getattr(func, "__name__", "udf")
+        self.vectorized = vectorized
+
+    def __call__(self, *cols_in: "Column | str") -> Column:
+        cols_ = [c if isinstance(c, Column) else col(c) for c in cols_in]
+        func, vectorized = self.func, self.vectorized
+
+        def ev(colmap, n):
+            args = [c._eval(colmap, n) for c in cols_]
+            if vectorized:
+                out = func(*args)
+                out = list(out)
+                if len(out) != n:
+                    raise ValueError(
+                        f"Vectorized UDF {self._name!r} returned {len(out)} "
+                        f"rows for a {n}-row partition"
+                    )
+                return out
+            return [func(*vals) for vals in zip(*args)] if n else []
+
+        label = "%s(%s)" % (self._name, ", ".join(c._name for c in cols_))
+        return Column(ev, label)
+
+
+def udf(
+    f: Optional[Callable] = None,
+    returnType: Optional[DataType] = None,
+    vectorized: bool = False,
+):
+    """Create a UDF; usable directly or as a decorator."""
+    if f is None:
+        return lambda func: UserDefinedFunction(func, returnType, vectorized=vectorized)
+    return UserDefinedFunction(f, returnType, vectorized=vectorized)
+
+
+def pandas_udf(f: Callable, returnType: Optional[DataType] = None):
+    """Arrow/pandas-shaped UDF: receives and returns whole-column sequences."""
+    return UserDefinedFunction(f, returnType, vectorized=True)

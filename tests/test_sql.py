@@ -1,0 +1,121 @@
+"""DataFrame engine tests (the Spark-substrate analog — SURVEY.md §7)."""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.sql import Row, TPUSession, col, lit, udf
+from sparkdl_tpu.sql.functions import pandas_udf, struct
+
+
+@pytest.fixture()
+def df(tpu_session):
+    data = [(i, f"name_{i}", float(i) * 1.5) for i in range(10)]
+    return tpu_session.createDataFrame(data, ["id", "name", "score"])
+
+
+def test_create_collect_count(df):
+    assert df.count() == 10
+    rows = df.collect()
+    assert rows[0] == Row(id=0, name="name_0", score=0.0)
+    assert rows[3].name == "name_3"
+    assert rows[3]["score"] == 4.5
+    assert df.columns == ["id", "name", "score"]
+
+
+def test_partitioning(tpu_session):
+    df = tpu_session.createDataFrame([(i,) for i in range(100)], ["x"], numPartitions=7)
+    assert df.getNumPartitions() == 7
+    assert df.count() == 100
+    assert df.repartition(3).getNumPartitions() == 3
+    assert sorted(r.x for r in df.repartition(3).collect()) == list(range(100))
+
+
+def test_select_and_exprs(df):
+    out = df.select("id", (col("score") * 2).alias("double_score"))
+    rows = out.collect()
+    assert out.columns == ["id", "double_score"]
+    assert rows[2].double_score == 6.0
+
+
+def test_with_column_and_udf(df):
+    plus = udf(lambda a, b: a + b)
+    out = df.withColumn("total", plus(col("id"), col("score")))
+    assert out.collect()[4].total == 4 + 6.0
+    # engine extension: plain callable rowwise
+    out2 = df.withColumn("name_len", lambda s: len(s), "name")
+    assert out2.collect()[0].name_len == 6
+
+
+def test_vectorized_udf(df):
+    doubler = pandas_udf(lambda xs: [x * 2 for x in xs])
+    out = df.select(doubler(col("id")).alias("d"))
+    assert [r.d for r in out.collect()] == [2 * i for i in range(10)]
+
+
+def test_filter_where_limit(df):
+    assert df.filter(col("id") >= 5).count() == 5
+    assert df.where(lambda r: r.id % 2 == 0).count() == 5
+    assert df.limit(3).count() == 3
+
+
+def test_random_split(tpu_session):
+    df = tpu_session.createDataFrame([(i,) for i in range(200)], ["x"])
+    a, b = df.randomSplit([0.7, 0.3], seed=42)
+    assert a.count() + b.count() == 200
+    assert 100 < a.count() < 180
+
+
+def test_map_partitions(df):
+    def fn(part):
+        return {"sum": [sum(part["id"])]}
+
+    out = df.repartition(2).mapPartitions(fn)
+    assert sum(r.sum for r in out.collect()) == sum(range(10))
+
+
+def test_map_in_arrow(df):
+    import pyarrow as pa
+
+    def fn(batch):
+        ids = batch.column(0)
+        return pa.record_batch({"id2": pa.compute.multiply(ids, 2)})
+
+    out = df.select("id").mapInArrow(fn)
+    assert [r.id2 for r in out.collect()] == [2 * i for i in range(10)]
+
+
+def test_struct_and_get_field(df):
+    out = df.select(struct("id", "name").alias("s")).withColumn(
+        "sid", col("s").getField("id")
+    )
+    assert out.collect()[7].sid == 7
+
+
+def test_temp_view_and_sql(df, tpu_session):
+    df.createOrReplaceTempView("people")
+    tpu_session.udf.register("doubled", lambda x: x * 2)
+    out = tpu_session.sql("SELECT doubled(score) AS ds, name FROM people WHERE id >= 8")
+    rows = out.collect()
+    assert len(rows) == 2
+    assert rows[0].ds == 8 * 1.5 * 2
+    out2 = tpu_session.sql("SELECT * FROM people LIMIT 4")
+    assert out2.count() == 4 and out2.columns == ["id", "name", "score"]
+
+
+def test_union_drop_rename(df):
+    assert df.union(df).count() == 20
+    assert df.drop("name").columns == ["id", "score"]
+    assert df.withColumnRenamed("name", "label").columns == ["id", "label", "score"]
+
+
+def test_numpy_column(tpu_session):
+    arrs = [(i, np.full((3,), i, dtype=np.float32)) for i in range(6)]
+    df = tpu_session.createDataFrame(arrs, ["i", "arr"])
+    row = df.collect()[4]
+    np.testing.assert_array_equal(row.arr, np.full((3,), 4, dtype=np.float32))
+
+
+def test_to_pandas(df):
+    pdf = df.toPandas()
+    assert list(pdf.columns) == ["id", "name", "score"]
+    assert len(pdf) == 10
