@@ -1,0 +1,19 @@
+from sparkdl_tpu.image.imageIO import (
+    filesToDF,
+    imageArrayToStruct,
+    imageSchema,
+    imageStructToArray,
+    imageType,
+    readImages,
+    readImagesWithCustomFn,
+)
+
+__all__ = [
+    "imageSchema",
+    "imageType",
+    "imageArrayToStruct",
+    "imageStructToArray",
+    "readImages",
+    "readImagesWithCustomFn",
+    "filesToDF",
+]

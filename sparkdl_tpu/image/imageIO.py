@@ -1,0 +1,263 @@
+"""Image I/O and Spark-compatible image schema.
+
+Reference analog: ``python/sparkdl/image/imageIO.py``† and Scala
+``ImageUtils.scala``† (SURVEY.md §1 L1, §2 "Image I/O").  Field layout and
+conventions match Spark 2.3+ ``pyspark.ml.image.ImageSchema``: struct
+``(origin, height, width, nChannels, mode, data)`` with OpenCV type codes and
+**BGR channel order** in ``data`` — so downstream graph pieces must (and do)
+handle BGR↔RGB exactly like the reference's ``buildSpImageConverter``.
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import os
+from collections import namedtuple
+from typing import Callable, List, Optional
+
+import numpy as np
+from PIL import Image
+
+from sparkdl_tpu.sql.types import (
+    BinaryType,
+    IntegerType,
+    Row,
+    StringType,
+    StructField,
+    StructType,
+)
+
+# ---------------------------------------------------------------------------
+# Schema (Spark ImageSchema-compatible)
+# ---------------------------------------------------------------------------
+
+imageSchema = StructType(
+    [
+        StructField("origin", StringType()),
+        StructField("height", IntegerType()),
+        StructField("width", IntegerType()),
+        StructField("nChannels", IntegerType()),
+        StructField("mode", IntegerType()),
+        StructField("data", BinaryType()),
+    ]
+)
+
+_OcvType = namedtuple("_OcvType", ["name", "ord", "nChannels", "dtype"])
+
+_OCV_TYPES = [
+    _OcvType(name="Undefined", ord=-1, nChannels=-1, dtype="N/A"),
+    _OcvType(name="CV_8UC1", ord=0, nChannels=1, dtype="uint8"),
+    _OcvType(name="CV_8UC3", ord=16, nChannels=3, dtype="uint8"),
+    _OcvType(name="CV_8UC4", ord=24, nChannels=4, dtype="uint8"),
+    _OcvType(name="CV_32FC1", ord=5, nChannels=1, dtype="float32"),
+    _OcvType(name="CV_32FC3", ord=21, nChannels=3, dtype="float32"),
+    _OcvType(name="CV_32FC4", ord=29, nChannels=4, dtype="float32"),
+]
+
+ocvTypes = {t.name: t.ord for t in _OCV_TYPES}
+
+
+class imageType:
+    """Lookup helpers between OpenCV type codes and (nChannels, dtype)."""
+
+    @staticmethod
+    def byOrdinal(ord_: int) -> _OcvType:
+        for t in _OCV_TYPES:
+            if t.ord == ord_:
+                return t
+        raise KeyError(f"Unknown OpenCV type ordinal: {ord_}")
+
+    @staticmethod
+    def byName(name: str) -> _OcvType:
+        for t in _OCV_TYPES:
+            if t.name == name:
+                return t
+        raise KeyError(f"Unknown OpenCV type name: {name}")
+
+    @staticmethod
+    def forArray(arr: np.ndarray) -> _OcvType:
+        if arr.ndim == 2:
+            n_channels = 1
+        elif arr.ndim == 3:
+            n_channels = arr.shape[2]
+        else:
+            raise ValueError(f"Image array must be 2-d or 3-d, got shape {arr.shape}")
+        dtype = str(arr.dtype)
+        for t in _OCV_TYPES:
+            if t.nChannels == n_channels and t.dtype == dtype:
+                return t
+        raise ValueError(
+            f"Unsupported image array: {n_channels} channels, dtype {dtype}"
+        )
+
+
+imageTypeByOrdinal = imageType.byOrdinal
+imageTypeByName = imageType.byName
+
+# ---------------------------------------------------------------------------
+# Array <-> struct codecs
+# ---------------------------------------------------------------------------
+
+
+def imageArrayToStruct(imgArray: np.ndarray, origin: str = "") -> Row:
+    """Pack a (H, W[, C]) array into an image struct Row.
+
+    Array is assumed already channel-ordered the way it should be stored
+    (Spark stores BGR); use :func:`rgbArrayToStruct` for RGB input.
+    """
+    if imgArray.ndim == 2:
+        imgArray = imgArray[:, :, None]
+    ocv = imageType.forArray(imgArray)
+    height, width, n_channels = imgArray.shape
+    contiguous = np.ascontiguousarray(imgArray)
+    return Row(
+        origin=origin,
+        height=int(height),
+        width=int(width),
+        nChannels=int(n_channels),
+        mode=int(ocv.ord),
+        data=contiguous.tobytes(),
+    )
+
+
+def imageStructToArray(imageRow: Row) -> np.ndarray:
+    """Unpack an image struct Row into a (H, W, C) numpy array (stored
+    channel order, i.e. BGR for color images)."""
+    ocv = imageType.byOrdinal(imageRow["mode"])
+    shape = (imageRow["height"], imageRow["width"], imageRow["nChannels"])
+    return np.frombuffer(imageRow["data"], dtype=ocv.dtype).reshape(shape)
+
+
+def rgbArrayToStruct(rgbArray: np.ndarray, origin: str = "") -> Row:
+    """Pack an RGB(A) array, converting to the stored BGR(A) order."""
+    arr = rgbArray
+    if arr.ndim == 3 and arr.shape[2] >= 3:
+        arr = arr[:, :, ::-1] if arr.shape[2] == 3 else arr[:, :, [2, 1, 0, 3]]
+    return imageArrayToStruct(arr, origin)
+
+
+def imageStructToRGBArray(imageRow: Row) -> np.ndarray:
+    """Unpack to RGB(A) order (undoing the stored BGR(A))."""
+    arr = imageStructToArray(imageRow)
+    if arr.shape[2] == 3:
+        return arr[:, :, ::-1]
+    if arr.shape[2] == 4:
+        return arr[:, :, [2, 1, 0, 3]]
+    return arr
+
+
+def _decode_image_bytes(raw: bytes, origin: str = "") -> Optional[Row]:
+    """Decode compressed image bytes (PNG/JPEG/...) → image struct, or None
+    if undecodable (matching the reference's null-tolerant decode)."""
+    try:
+        img = Image.open(io.BytesIO(raw))
+        if img.mode not in ("L", "RGB", "RGBA"):
+            img = img.convert("RGB")
+        arr = np.asarray(img)
+    except Exception:
+        return None
+    return rgbArrayToStruct(arr, origin) if arr.ndim == 3 else imageArrayToStruct(arr, origin)
+
+
+def PIL_decode_and_resize(size):
+    """Return decoder fn bytes → RGB float array resized to ``size`` (H, W)."""
+
+    def decode(raw: bytes) -> np.ndarray:
+        img = Image.open(io.BytesIO(raw)).convert("RGB")
+        img = img.resize((size[1], size[0]), Image.BILINEAR)
+        return np.asarray(img, dtype=np.float32)
+
+    return decode
+
+
+def resizeImage(size):
+    """Row-wise image-struct resize UDF factory (analog of the reference's
+    PIL resize udf / Scala ``ImageUtils.resizeImage``†)."""
+
+    height, width = size
+
+    def resize(imageRow: Row) -> Row:
+        arr = imageStructToArray(imageRow)
+        n = arr.shape[2]
+        pil_mode = {1: "L", 3: "RGB", 4: "RGBA"}[n]
+        img = Image.fromarray(arr.squeeze() if n == 1 else arr, mode=pil_mode)
+        resized = np.asarray(
+            img.resize((width, height), Image.BILINEAR), dtype=np.uint8
+        )
+        if resized.ndim == 2:
+            resized = resized[:, :, None]
+        return imageArrayToStruct(resized, imageRow["origin"])
+
+    return resize
+
+
+# ---------------------------------------------------------------------------
+# Readers
+# ---------------------------------------------------------------------------
+
+_IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".gif", ".bmp", ".webp")
+
+
+def _list_files(path: str) -> List[str]:
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if os.path.isfile(os.path.join(path, f))
+        )
+    else:
+        files = sorted(glob.glob(path))
+    return files
+
+
+def filesToDF(session, path: str, numPartitions: int = 4):
+    """Read files from a directory/glob → DataFrame (filePath, fileData).
+
+    Reference analog: ``imageIO.filesToDF`` over ``sc.binaryFiles``†.
+    """
+    from sparkdl_tpu.sql.session import TPUSession
+
+    session = session or TPUSession.getActiveSession()
+    rows = []
+    for f in _list_files(path):
+        with open(f, "rb") as fh:
+            rows.append((f, fh.read()))
+    return session.createDataFrame(
+        rows, ["filePath", "fileData"], numPartitions=numPartitions
+    )
+
+
+def readImages(path: str, session=None, numPartitions: int = 4):
+    """Read images from a directory/glob → DataFrame with an ``image``
+    struct column (Spark ``ImageSchema.readImages`` analog; undecodable
+    files are dropped)."""
+    return readImagesWithCustomFn(
+        path, decode_f=_decode_image_bytes, numPartitions=numPartitions, session=session
+    )
+
+
+def readImagesWithCustomFn(
+    path: str,
+    decode_f: Callable[[bytes, str], Optional[Row]],
+    numPartitions: int = 4,
+    session=None,
+):
+    from sparkdl_tpu.sql.session import TPUSession
+
+    session = session or TPUSession.getActiveSession()
+    files_df = filesToDF(session, path, numPartitions=numPartitions)
+
+    def decode_partition(part):
+        images, origins = [], []
+        for fp, raw in zip(part["filePath"], part["fileData"]):
+            struct = decode_f(raw, fp)
+            if struct is not None:
+                images.append(struct)
+                origins.append(fp)
+        return {"filePath": origins, "image": images}
+
+    schema = StructType(
+        [StructField("filePath", StringType()), StructField("image", imageSchema)]
+    )
+    return files_df.mapPartitions(decode_partition, schema=schema)
