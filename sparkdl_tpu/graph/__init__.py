@@ -1,0 +1,15 @@
+"""Graph toolkit — the core runtime layer (reference L3, SURVEY.md §1).
+
+Replaces the TF 1.x graph machinery (``TFInputGraph``, ``GraphFunction``,
+``IsolatedSession`` — ``python/sparkdl/graph/``†) with XLA-native
+equivalents: :class:`XlaFunction` is a serializable (StableHLO) jittable
+function + params pytree; composition replaces ``GraphFunction.fromList``'s
+``import_graph_def`` rewiring; prebuilt pieces replace
+``buildSpImageConverter``/``buildFlattener``.
+"""
+
+from sparkdl_tpu.graph.function import XlaFunction, GraphFunction
+from sparkdl_tpu.graph.builder import IsolatedSession
+from sparkdl_tpu.graph import pieces, utils
+
+__all__ = ["XlaFunction", "GraphFunction", "IsolatedSession", "pieces", "utils"]

@@ -1,0 +1,72 @@
+"""IsolatedSession — compatibility shim over the stateless JAX world.
+
+Reference analog: ``python/sparkdl/graph/builder.py``† ``IsolatedSession``
+(fresh ``tf.Graph``+``tf.Session`` per scope, ``asGraphFunction``) —
+SURVEY.md §2/§3.  JAX is functional, so isolation is the default and the
+"session" carries no hidden graph state; this shim exists so reference-shaped
+code (``with IsolatedSession() as issn: ... issn.asGraphFunction(...)``)
+ports over unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+from sparkdl_tpu.graph.function import XlaFunction
+
+
+class IsolatedSession:
+    """Context manager mirroring the reference's isolated TF session scope."""
+
+    def __init__(self, using_keras: bool = False):
+        self.using_keras = using_keras  # kept for signature parity
+        self._graph_fn: Optional[Callable] = None
+        self._params: Any = {}
+
+    def __enter__(self) -> "IsolatedSession":
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def run(self, fn: Callable, *args):
+        """Eagerly evaluate a jax-traceable callable (the ``sess.run`` analog)."""
+        import jax
+
+        return jax.jit(fn)(*args)
+
+    def importGraphFunction(self, gfn: XlaFunction, prefix: str = ""):
+        """Stage an existing XlaFunction in this scope (the
+        ``import_graph_def`` analog); returns its I/O names."""
+        self._graph_fn = gfn.apply_fn
+        self._params = gfn.params
+        return gfn.input_names, gfn.output_names
+
+    def makeGraphFunction(
+        self,
+        fn: Callable,
+        params: Any = None,
+        inputs: Sequence[str] = ("input",),
+        outputs: Sequence[str] = ("output",),
+        takes_params: bool = False,
+    ) -> XlaFunction:
+        return XlaFunction.from_callable(
+            fn,
+            params=params,
+            input_names=inputs,
+            output_names=outputs,
+            takes_params=takes_params,
+        )
+
+    def asGraphFunction(
+        self, inputs: Sequence[str], outputs: Sequence[str]
+    ) -> XlaFunction:
+        """Package what was staged in this scope as an XlaFunction."""
+        if self._graph_fn is None:
+            raise RuntimeError(
+                "Nothing staged in this session; use importGraphFunction or "
+                "makeGraphFunction"
+            )
+        return XlaFunction(
+            self._graph_fn, self._params, list(inputs), list(outputs)
+        )

@@ -1,0 +1,379 @@
+"""XlaFunction — serializable jittable function + params.
+
+The analog of the reference's two central graph abstractions (SURVEY.md §2):
+
+- ``TFInputGraph`` (``python/sparkdl/graph/input.py``†): a frozen ``GraphDef``
+  with feed/fetch maps, built by a *matrix of constructors* (graph / graphdef
+  / checkpoint / saved_model × with/without signature).  Here the serialized
+  artifact is **StableHLO** (via ``jax.export``) and the constructor matrix is
+  ``from_callable`` / ``from_flax`` / ``from_keras`` / ``from_saved_model`` /
+  ``from_npz`` / ``from_stablehlo`` / ``from_checkpoint`` (orbax).
+- ``GraphFunction`` (``python/sparkdl/graph/builder.py``†): a composable
+  (graphdef, inputs, outputs) value object with ``fromList`` pipelining.
+  Here composition is plain function chaining under one jit, so XLA fuses
+  across stage boundaries instead of stitching GraphDefs with ``input_map``.
+
+Design notes (TPU-first):
+- ``apply(params, *args) -> tuple`` is the canonical signature; params ride
+  separately so fine-tuning can donate/shard them, and are *frozen in* (the
+  ``convert_variables_to_constants`` analog) only at export time.
+- jit compilation is cached per concrete batch shape; callers batch+bucket
+  (see transformers) so the MXU sees a few static shapes, never per-row
+  shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _as_tuple(x) -> Tuple:
+    if isinstance(x, tuple):
+        return x
+    if isinstance(x, list):
+        return tuple(x)
+    return (x,)
+
+
+class XlaFunction:
+    def __init__(
+        self,
+        apply_fn: Callable,
+        params: Any = None,
+        input_names: Sequence[str] = ("input",),
+        output_names: Sequence[str] = ("output",),
+        name: str = "xla_function",
+    ):
+        """``apply_fn(params, *args)`` returns one array or a tuple matching
+        ``output_names``."""
+        self.apply_fn = apply_fn
+        self.params = {} if params is None else params
+        self.input_names = list(input_names)
+        self.output_names = list(output_names)
+        self.name = name
+        self._jit_cache: Dict[Tuple, Any] = {}
+
+    # ------------------------------------------------------------------
+    # calling
+    # ------------------------------------------------------------------
+    def apply(self, params, *args):
+        return _as_tuple(self.apply_fn(params, *args))
+
+    def _jitted(self):
+        key = ("__fn__",)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(self.apply)
+        return self._jit_cache[key]
+
+    def __call__(self, *args, params=None):
+        params = self.params if params is None else params
+        out = self._jitted()(params, *args)
+        return out[0] if len(self.output_names) == 1 else out
+
+    def lower(self, *arg_specs):
+        return jax.jit(self.apply).lower(self.params, *arg_specs)
+
+    # ------------------------------------------------------------------
+    # composition (GraphFunction.fromList analog)
+    # ------------------------------------------------------------------
+    def compose(self, other: "XlaFunction", name: Optional[str] = None) -> "XlaFunction":
+        """Feed this function's outputs into ``other`` (self ∘ then other)."""
+        first, second = self, other
+
+        def chained(params, *args):
+            mid = first.apply(params["f0"], *args)
+            return second.apply(params["f1"], *mid)
+
+        return XlaFunction(
+            chained,
+            {"f0": first.params, "f1": second.params},
+            first.input_names,
+            second.output_names,
+            name or f"{first.name}>>{second.name}",
+        )
+
+    @classmethod
+    def from_list(cls, functions: Sequence["XlaFunction"], name: str = "pipeline"):
+        """Pipeline stages: outputs of stage i feed inputs of stage i+1
+        positionally (the ``GraphFunction.fromList`` analog; one jit, so XLA
+        fuses the whole pipeline)."""
+        functions = list(functions)
+        if not functions:
+            raise ValueError("from_list requires at least one function")
+        params = {f"f{i}": f.params for i, f in enumerate(functions)}
+
+        def chained(p, *args):
+            cur = args
+            for i, f in enumerate(functions):
+                cur = f.apply(p[f"f{i}"], *cur)
+            return cur
+
+        return cls(
+            chained,
+            params,
+            functions[0].input_names,
+            functions[-1].output_names,
+            name,
+        )
+
+    # ------------------------------------------------------------------
+    # constructors (the TFInputGraph constructor-matrix analog)
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_callable(
+        cls,
+        fn: Callable,
+        params: Any = None,
+        input_names=("input",),
+        output_names=("output",),
+        name="callable",
+        takes_params: bool = False,
+    ) -> "XlaFunction":
+        """Wrap a jax-traceable callable. If ``takes_params`` is False, ``fn``
+        has signature ``fn(*args)`` and params are empty."""
+        if takes_params:
+            return cls(fn, params, input_names, output_names, name)
+        return cls(
+            lambda p, *args: fn(*args), {}, input_names, output_names, name
+        )
+
+    @classmethod
+    def from_flax(
+        cls,
+        module,
+        params: Any,
+        input_names=("input",),
+        output_names=("output",),
+        name: Optional[str] = None,
+        method: Optional[str] = None,
+        **apply_kwargs,
+    ) -> "XlaFunction":
+        """From a ``flax.linen.Module`` + params pytree."""
+
+        def apply_fn(p, *args):
+            kwargs = dict(apply_kwargs)
+            if method is not None:
+                kwargs["method"] = method
+            return module.apply(p, *args, **kwargs)
+
+        return cls(
+            apply_fn,
+            params,
+            input_names,
+            output_names,
+            name or type(module).__name__,
+        )
+
+    @classmethod
+    def from_keras(cls, model_or_path, name: Optional[str] = None) -> "XlaFunction":
+        """From a Keras model or saved .h5/.keras file.
+
+        Keras runs on its JAX backend here (enforced in ``sparkdl_tpu``'s
+        package init), so ``model.stateless_call`` is jax-traceable and the
+        whole model jits straight onto TPU — the analog of the reference's
+        "load .h5 → freeze to GraphDef" path (``keras_utils.KSessionWrap``†,
+        SURVEY.md §3.1) with no graph surgery.
+        """
+        import keras
+
+        if keras.config.backend() != "jax":
+            raise RuntimeError(
+                "Keras must use the JAX backend (set KERAS_BACKEND=jax before "
+                "importing keras; importing sparkdl_tpu first does this)."
+            )
+        if isinstance(model_or_path, (str, os.PathLike)):
+            model = keras.saving.load_model(model_or_path, compile=False)
+        else:
+            model = model_or_path
+        if not model.built:
+            raise ValueError("Keras model must be built (call it once or load from file)")
+
+        trainable = [v.value for v in model.trainable_variables]
+        non_trainable = [v.value for v in model.non_trainable_variables]
+        params = {"trainable": trainable, "non_trainable": non_trainable}
+
+        def apply_fn(p, *args):
+            outputs, _ = model.stateless_call(
+                p["trainable"], p["non_trainable"], *args, training=False
+            )
+            return outputs
+
+        return cls(
+            apply_fn,
+            params,
+            ("input",),
+            ("output",),
+            name or model.name,
+        )
+
+    @classmethod
+    def from_saved_model(
+        cls,
+        path: str,
+        signature: str = "serving_default",
+        input_names=("input",),
+        output_names=("output",),
+        name: Optional[str] = None,
+    ) -> "XlaFunction":
+        """From a TF SavedModel via ``jax2tf.call_tf`` (the
+        ``TFInputGraph.fromSavedModel[WithSignature]``† analog). The wrapped
+        fn is jax-jittable when the TF graph is XLA-lowerable."""
+        import tensorflow as tf  # noqa: F401
+        from jax.experimental import jax2tf
+
+        restored = tf.saved_model.load(path)
+        tf_fn = restored.signatures[signature]
+        out_keys = sorted(tf_fn.structured_outputs.keys())
+
+        def apply_fn(p, *args):
+            out = jax2tf.call_tf(tf_fn)(*args)
+            if isinstance(out, dict):
+                return tuple(out[k] for k in out_keys)
+            return out
+
+        fn = cls(apply_fn, {}, input_names, out_keys or output_names, name or "saved_model")
+        fn._keepalive = restored  # prevent GC of the TF objects
+        return fn
+
+    @classmethod
+    def from_npz(
+        cls,
+        npz_path: str,
+        apply_fn: Callable,
+        input_names=("input",),
+        output_names=("output",),
+        name: Optional[str] = None,
+    ) -> "XlaFunction":
+        """Params from a ``.npz`` archive (flat ``scope/var`` keys → nested
+        pytree) + a caller-supplied apply fn."""
+        flat = dict(np.load(npz_path))
+        params: Dict[str, Any] = {}
+        for key, value in flat.items():
+            node = params
+            parts = key.split("/")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = jnp.asarray(value)
+        return cls(apply_fn, params, input_names, output_names, name or "npz")
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        ckpt_dir: str,
+        apply_fn: Callable,
+        input_names=("input",),
+        output_names=("output",),
+        name: Optional[str] = None,
+    ) -> "XlaFunction":
+        """Params from an orbax checkpoint (the ``TFInputGraph.fromCheckpoint``†
+        analog — TF1 ``tf.train.Saver`` checkpoints → orbax)."""
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            params = ckptr.restore(os.path.abspath(ckpt_dir))
+        return cls(apply_fn, params, input_names, output_names, name or "checkpoint")
+
+    # ------------------------------------------------------------------
+    # serialization (the frozen-GraphDef analog)
+    # ------------------------------------------------------------------
+    def export_stablehlo(
+        self,
+        *input_specs,
+        batch_polymorphic: bool = True,
+        platforms: Sequence[str] = ("cpu", "tpu"),
+    ) -> bytes:
+        """Freeze params into the function (``convert_variables_to_constants``
+        analog) and serialize to portable StableHLO bytes.
+
+        ``input_specs``: per-input ``(shape, dtype)`` with shape[0] = batch;
+        if ``batch_polymorphic``, the batch dim is exported symbolically.
+        """
+        from jax import export as jax_export
+
+        specs = []
+        for i, (shape, dtype) in enumerate(input_specs):
+            if batch_polymorphic:
+                sym = jax_export.symbolic_shape(
+                    ",".join(["b"] + [str(int(d)) for d in shape[1:]])
+                )
+                specs.append(jax.ShapeDtypeStruct(sym, dtype))
+            else:
+                specs.append(jax.ShapeDtypeStruct(tuple(shape), dtype))
+
+        params = self.params
+
+        def frozen(*args):
+            return _as_tuple(self.apply_fn(params, *args))
+
+        exported = jax_export.export(
+            jax.jit(frozen), platforms=list(platforms)
+        )(*specs)
+        return bytes(exported.serialize())
+
+    def save(self, path: str, *input_specs, **export_kwargs):
+        """Save to a directory: StableHLO artifact + spec manifest."""
+        os.makedirs(path, exist_ok=True)
+        blob = self.export_stablehlo(*input_specs, **export_kwargs)
+        with open(os.path.join(path, "function.stablehlo"), "wb") as fh:
+            fh.write(blob)
+        manifest = {
+            "name": self.name,
+            "input_names": self.input_names,
+            "output_names": self.output_names,
+            "input_specs": [
+                [list(shape), np.dtype(dtype).name] for shape, dtype in input_specs
+            ],
+        }
+        with open(os.path.join(path, "manifest.json"), "w") as fh:
+            json.dump(manifest, fh)
+
+    @classmethod
+    def load(cls, path: str) -> "XlaFunction":
+        with open(os.path.join(path, "manifest.json")) as fh:
+            manifest = json.load(fh)
+        with open(os.path.join(path, "function.stablehlo"), "rb") as fh:
+            blob = fh.read()
+        return cls.from_stablehlo(
+            blob,
+            input_names=manifest["input_names"],
+            output_names=manifest["output_names"],
+            name=manifest["name"],
+        )
+
+    @classmethod
+    def from_stablehlo(
+        cls,
+        serialized: bytes,
+        input_names=("input",),
+        output_names=("output",),
+        name: str = "stablehlo",
+    ) -> "XlaFunction":
+        """Rehydrate a frozen function from StableHLO bytes."""
+        from jax import export as jax_export
+
+        exported = jax_export.deserialize(serialized)
+
+        def apply_fn(p, *args):
+            return exported.call(*args)
+
+        fn = cls(apply_fn, {}, input_names, output_names, name)
+        fn._exported = exported
+        return fn
+
+    def __repr__(self):
+        n_params = len(jax.tree_util.tree_leaves(self.params))
+        return (
+            f"XlaFunction(name={self.name!r}, inputs={self.input_names}, "
+            f"outputs={self.output_names}, param_leaves={n_params})"
+        )
+
+
+# API-parity alias: the reference's composable graph value object.
+GraphFunction = XlaFunction

@@ -1,0 +1,106 @@
+"""Prebuilt graph pieces (reference analog: ``python/sparkdl/graph/pieces.py``†
+``buildSpImageConverter`` / ``buildFlattener`` — SURVEY.md §2).
+
+Each piece is an :class:`XlaFunction` over *batched* arrays, composed with a
+model via ``XlaFunction.from_list`` so XLA fuses converter → preprocess →
+model into one TPU program (the reference stitched GraphDefs instead).
+The byte-level struct decode happens host-side in the transformers
+(``np.frombuffer`` is zero-copy); pieces start from uint8/float NHWC tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.graph.function import XlaFunction
+
+
+def build_sp_image_converter(
+    channel_order: str = "BGR", output_dtype=jnp.float32
+) -> XlaFunction:
+    """Stored image batch (NHWC uint8, Spark's BGR order) → float RGB batch.
+
+    ``channel_order`` describes the *stored* order being converted FROM
+    (Spark image structs store BGR; 'L' passes through single-channel).
+    """
+    order = channel_order.upper()
+    if order not in ("BGR", "RGB", "L"):
+        raise ValueError(f"Unsupported channel order {channel_order!r}")
+
+    def convert(x):
+        x = x.astype(output_dtype)
+        if order == "BGR":
+            x = x[..., ::-1]
+        return x
+
+    return XlaFunction.from_callable(
+        convert, name=f"spImageConverter[{order}]"
+    )
+
+
+def build_flattener() -> XlaFunction:
+    """Batch (N, ...) → (N, prod(...)) float32 (``buildFlattener``† analog)."""
+
+    def flatten(x):
+        return jnp.reshape(x, (x.shape[0], -1)).astype(jnp.float32)
+
+    return XlaFunction.from_callable(flatten, name="flattener")
+
+
+def build_resizer(size: Tuple[int, int], method: str = "bilinear") -> XlaFunction:
+    """Batched NHWC resize to ``size=(H, W)`` on device (the TF
+    ``resize_bilinear`` / Scala ``ImageUtils.resizeImage``† analog)."""
+
+    import jax.image
+
+    height, width = int(size[0]), int(size[1])
+
+    def resize(x):
+        n, _, _, c = x.shape
+        out = jax.image.resize(
+            x.astype(jnp.float32), (n, height, width, c), method=method
+        )
+        return jnp.clip(out, 0.0, 255.0)
+
+    return XlaFunction.from_callable(resize, name=f"resizer{size}")
+
+
+def build_preprocessor(mode: str = "tf") -> XlaFunction:
+    """Keras ``preprocess_input`` modes over float RGB batches:
+
+    - ``"tf"``: scale to [-1, 1]
+    - ``"torch"``: scale to [0,1], normalize by ImageNet mean/std
+    - ``"caffe"``: convert to BGR, subtract ImageNet BGR means
+    - ``"none"``: identity
+    """
+    mode = mode.lower()
+
+    if mode == "tf":
+
+        def pre(x):
+            return x / 127.5 - 1.0
+
+    elif mode == "torch":
+        mean = jnp.array([0.485, 0.456, 0.406], dtype=jnp.float32)
+        std = jnp.array([0.229, 0.224, 0.225], dtype=jnp.float32)
+
+        def pre(x):
+            return (x / 255.0 - mean) / std
+
+    elif mode == "caffe":
+        bgr_mean = jnp.array([103.939, 116.779, 123.68], dtype=jnp.float32)
+
+        def pre(x):
+            return x[..., ::-1] - bgr_mean
+
+    elif mode == "none":
+
+        def pre(x):
+            return x
+
+    else:
+        raise ValueError(f"Unknown preprocessing mode {mode!r}")
+
+    return XlaFunction.from_callable(pre, name=f"preprocess[{mode}]")

@@ -1,0 +1,69 @@
+"""Name-munging utilities (reference analog: ``python/sparkdl/graph/utils.py``†
+— ``tensor_name``/``op_name``/``validated_*`` — SURVEY.md §2).
+
+TF 1.x distinguished op names (``"x"``) from tensor names (``"x:0"``).
+XlaFunction I/O is addressed by plain names, but the same helpers are kept so
+API users (and ported code) can pass either form.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def tensor_name(name: str) -> str:
+    """Canonical tensor form: ``"x"`` → ``"x:0"``; ``"x:1"`` unchanged."""
+    if ":" in name:
+        base, idx = name.rsplit(":", 1)
+        if not idx.isdigit():
+            raise ValueError(f"Invalid tensor name {name!r}")
+        return name
+    return f"{name}:0"
+
+
+def op_name(name: str) -> str:
+    """Canonical op form: ``"x:0"`` → ``"x"``."""
+    if ":" in name:
+        base, idx = name.rsplit(":", 1)
+        if not idx.isdigit():
+            raise ValueError(f"Invalid tensor name {name!r}")
+        return base
+    return name
+
+
+def add_scope_to_name(scope: str, name: str) -> str:
+    return f"{scope}/{name}" if scope else name
+
+
+def validated_input(fn, name: str) -> str:
+    """Check ``name`` is an input of ``fn`` (XlaFunction)."""
+    base = op_name(name)
+    if base not in fn.input_names:
+        raise ValueError(
+            f"{base!r} is not an input of {fn.name!r} (inputs: {fn.input_names})"
+        )
+    return base
+
+
+def validated_output(fn, name: str) -> str:
+    base = op_name(name)
+    if base not in fn.output_names:
+        raise ValueError(
+            f"{base!r} is not an output of {fn.name!r} (outputs: {fn.output_names})"
+        )
+    return base
+
+
+def validated_graph(fn):
+    """Sanity-check an XlaFunction's surface (the ``validated_graph``† analog)."""
+    from sparkdl_tpu.graph.function import XlaFunction
+
+    if not isinstance(fn, XlaFunction):
+        raise TypeError(f"Expected XlaFunction, got {type(fn)}")
+    if not fn.input_names or not fn.output_names:
+        raise ValueError("XlaFunction must declare inputs and outputs")
+    if len(set(fn.input_names)) != len(fn.input_names):
+        raise ValueError("Duplicate input names")
+    if len(set(fn.output_names)) != len(fn.output_names):
+        raise ValueError("Duplicate output names")
+    return fn

@@ -1,0 +1,229 @@
+"""Graph toolkit tests — the constructor-matrix pattern from the reference
+(``python/tests/graph/test_input.py``†: every TFInputGraph constructor checked
+against one numpy oracle — SURVEY.md §4), rebuilt for XlaFunction.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.graph import IsolatedSession, XlaFunction, pieces, utils
+
+RNG = np.random.RandomState(7)
+X = RNG.rand(4, 10).astype(np.float32)
+W = RNG.rand(10, 3).astype(np.float32)
+B = RNG.rand(3).astype(np.float32)
+ORACLE = X @ W + B  # the single numpy oracle every constructor must match
+
+
+def _linear_apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def _check(fn: XlaFunction, params_included=True, atol=1e-5):
+    out = np.asarray(fn(X) if params_included else fn(X, params={"w": W, "b": B}))
+    np.testing.assert_allclose(out, ORACLE, atol=atol, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# constructor matrix
+# ---------------------------------------------------------------------------
+
+
+def test_from_callable_with_params():
+    fn = XlaFunction.from_callable(
+        _linear_apply, params={"w": W, "b": B}, takes_params=True
+    )
+    _check(fn)
+
+
+def test_from_callable_pure():
+    fn = XlaFunction.from_callable(lambda x: x @ W + B)
+    _check(fn)
+
+
+def test_from_flax():
+    import flax.linen as nn
+    import jax
+
+    class Dense(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(3)(x)
+
+    module = Dense()
+    params = module.init(jax.random.PRNGKey(0), X)
+    # inject oracle weights
+    params = {"params": {"Dense_0": {"kernel": jnp.asarray(W), "bias": jnp.asarray(B)}}}
+    fn = XlaFunction.from_flax(module, params)
+    _check(fn)
+
+
+def test_from_keras_model_and_file(tmp_path):
+    keras = pytest.importorskip("keras")
+    assert keras.config.backend() == "jax"
+    model = keras.Sequential(
+        [keras.layers.Input((10,)), keras.layers.Dense(3, name="lin")]
+    )
+    model.get_layer("lin").set_weights([W, B])
+    fn = XlaFunction.from_keras(model)
+    _check(fn)
+    # file roundtrip
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+    fn2 = XlaFunction.from_keras(path)
+    _check(fn2)
+
+
+def test_from_npz(tmp_path):
+    path = str(tmp_path / "params.npz")
+    np.savez(path, **{"w": W, "b": B})
+    fn = XlaFunction.from_npz(path, _linear_apply)
+    _check(fn)
+
+
+def test_from_checkpoint(tmp_path):
+    ocp = pytest.importorskip("orbax.checkpoint")
+    ckpt_dir = str(tmp_path / "ckpt")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(ckpt_dir, {"w": W, "b": B})
+        ckptr.wait_until_finished()
+    fn = XlaFunction.from_checkpoint(ckpt_dir, _linear_apply)
+    _check(fn)
+
+
+def test_stablehlo_roundtrip():
+    fn = XlaFunction.from_callable(
+        _linear_apply, params={"w": W, "b": B}, takes_params=True
+    )
+    blob = fn.export_stablehlo(((4, 10), np.float32))
+    assert isinstance(blob, bytes) and len(blob) > 0
+    fn2 = XlaFunction.from_stablehlo(blob)
+    _check(fn2)
+    # batch polymorphism: different batch size must work from the same export
+    out = np.asarray(fn2(np.vstack([X, X])))
+    np.testing.assert_allclose(out, np.vstack([ORACLE, ORACLE]), atol=1e-5)
+
+
+def test_save_load_dir(tmp_path):
+    fn = XlaFunction.from_callable(
+        _linear_apply, params={"w": W, "b": B}, takes_params=True, name="lin"
+    )
+    path = str(tmp_path / "exported")
+    fn.save(path, ((4, 10), np.float32))
+    fn2 = XlaFunction.load(path)
+    assert fn2.name == "lin"
+    _check(fn2)
+
+
+def test_from_saved_model(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    class Mod(tf.Module):
+        @tf.function(input_signature=[tf.TensorSpec([None, 10], tf.float32)])
+        def __call__(self, x):
+            return {"out": tf.matmul(x, W) + B}
+
+    path = str(tmp_path / "sm")
+    tf.saved_model.save(Mod(), path)
+    fn = XlaFunction.from_saved_model(path)
+    out = fn(X)
+    np.testing.assert_allclose(np.asarray(out), ORACLE, atol=1e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# composition + pieces
+# ---------------------------------------------------------------------------
+
+
+def test_compose_and_from_list():
+    lin = XlaFunction.from_callable(
+        _linear_apply, params={"w": W, "b": B}, takes_params=True
+    )
+    relu = XlaFunction.from_callable(lambda x: jnp.maximum(x, 0))
+    double = XlaFunction.from_callable(lambda x: x * 2)
+    piped = XlaFunction.from_list([lin, relu, double])
+    out = np.asarray(piped(X))
+    np.testing.assert_allclose(out, np.maximum(ORACLE, 0) * 2, atol=1e-5)
+    # compose pairs
+    out2 = np.asarray(lin.compose(relu)(X))
+    np.testing.assert_allclose(out2, np.maximum(ORACLE, 0), atol=1e-5)
+
+
+def test_sp_image_converter_piece():
+    bgr = RNG.randint(0, 255, (2, 4, 4, 3)).astype(np.uint8)
+    conv = pieces.build_sp_image_converter("BGR")
+    out = np.asarray(conv(bgr))
+    np.testing.assert_allclose(out, bgr[..., ::-1].astype(np.float32))
+
+
+def test_flattener_piece():
+    x = RNG.rand(3, 4, 5).astype(np.float32)
+    out = np.asarray(pieces.build_flattener()(x))
+    assert out.shape == (3, 20)
+
+
+def test_resizer_piece():
+    x = RNG.randint(0, 255, (2, 8, 8, 3)).astype(np.float32)
+    out = np.asarray(pieces.build_resizer((4, 4))(x))
+    assert out.shape == (2, 4, 4, 3)
+    assert out.min() >= 0 and out.max() <= 255
+
+
+def test_preprocessor_modes():
+    x = np.full((1, 2, 2, 3), 255.0, dtype=np.float32)
+    np.testing.assert_allclose(
+        np.asarray(pieces.build_preprocessor("tf")(x)), np.ones_like(x), atol=1e-6
+    )
+    caffe = np.asarray(pieces.build_preprocessor("caffe")(x))
+    np.testing.assert_allclose(
+        caffe[0, 0, 0], 255.0 - np.array([103.939, 116.779, 123.68]), atol=1e-4
+    )
+
+
+def test_pipeline_converter_model_flatten():
+    """The reference's flagship composition: spImageConverter → model →
+    flattener (SURVEY.md §3.1)."""
+    imgs = RNG.randint(0, 255, (3, 4, 4, 3)).astype(np.uint8)
+    conv = pieces.build_sp_image_converter("BGR")
+    model = XlaFunction.from_callable(lambda x: x.mean(axis=3, keepdims=True))
+    flat = pieces.build_flattener()
+    piped = XlaFunction.from_list([conv, model, flat])
+    out = np.asarray(piped(imgs))
+    assert out.shape == (3, 16)
+    np.testing.assert_allclose(
+        out, imgs[..., ::-1].astype(np.float32).mean(3).reshape(3, -1), atol=1e-4
+    )
+
+
+# ---------------------------------------------------------------------------
+# utils + builder shim
+# ---------------------------------------------------------------------------
+
+
+def test_name_utils():
+    assert utils.tensor_name("x") == "x:0"
+    assert utils.tensor_name("x:1") == "x:1"
+    assert utils.op_name("x:0") == "x"
+    assert utils.op_name("x") == "x"
+    with pytest.raises(ValueError):
+        utils.tensor_name("x:bad")
+
+
+def test_validated_io():
+    fn = XlaFunction.from_callable(lambda x: x, input_names=["a"], output_names=["b"])
+    assert utils.validated_input(fn, "a:0") == "a"
+    assert utils.validated_output(fn, "b") == "b"
+    with pytest.raises(ValueError):
+        utils.validated_input(fn, "zz")
+    utils.validated_graph(fn)
+
+
+def test_isolated_session_shim():
+    with IsolatedSession() as issn:
+        gfn = issn.makeGraphFunction(lambda x: x * 3)
+        imported_io = issn.importGraphFunction(gfn)
+        assert imported_io == (["input"], ["output"])
+        packaged = issn.asGraphFunction(["input"], ["output"])
+    np.testing.assert_allclose(np.asarray(packaged(X)), X * 3, atol=1e-6)
