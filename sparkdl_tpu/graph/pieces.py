@@ -77,30 +77,18 @@ def build_preprocessor(mode: str = "tf") -> XlaFunction:
     """
     mode = mode.lower()
 
-    if mode == "tf":
-
-        def pre(x):
-            return x / 127.5 - 1.0
-
-    elif mode == "torch":
-        mean = jnp.array([0.485, 0.456, 0.406], dtype=jnp.float32)
-        std = jnp.array([0.229, 0.224, 0.225], dtype=jnp.float32)
-
-        def pre(x):
-            return (x / 255.0 - mean) / std
-
-    elif mode == "caffe":
-        bgr_mean = jnp.array([103.939, 116.779, 123.68], dtype=jnp.float32)
-
-        def pre(x):
-            return x[..., ::-1] - bgr_mean
-
-    elif mode == "none":
+    if mode == "none":
 
         def pre(x):
             return x
 
     else:
-        raise ValueError(f"Unknown preprocessing mode {mode!r}")
+        # Single source of truth for the mode math/constants.
+        from sparkdl_tpu.models.registry import preprocess_input
+
+        preprocess_input(jnp.zeros((1, 1, 1, 3)), mode)  # validate mode now
+
+        def pre(x):
+            return preprocess_input(x, mode)
 
     return XlaFunction.from_callable(pre, name=f"preprocess[{mode}]")

@@ -1,0 +1,17 @@
+"""TPU-native model zoo (the ``keras_applications.py``† registry analog).
+
+The reference delegated architectures to ``keras.applications`` and only kept
+a registry (name -> constructor, input size, preprocessing, featurize cut
+point) in ``python/sparkdl/transformers/keras_applications.py``†.  Here the
+architectures themselves are re-implemented in Flax (NHWC, bfloat16-capable,
+jit/shard-friendly) with a Keras-weight importer for pretrained parity.
+"""
+
+from sparkdl_tpu.models.registry import (  # noqa: F401
+    KERAS_APPLICATION_MODELS,
+    SUPPORTED_MODELS,
+    KerasApplicationModel,
+    getKerasApplicationModel,
+    get_keras_application_model,
+)
+from sparkdl_tpu.models.keras_port import port_keras_weights  # noqa: F401

@@ -1,0 +1,132 @@
+"""InceptionV3 in Flax (NHWC, TPU-native).
+
+Architecture parity target: ``keras.applications.inception_v3`` — the model
+the reference's flagship ``DeepImageFeaturizer(modelName="InceptionV3")``
+wraps (``python/sparkdl/transformers/keras_applications.py``†).  Layer names
+are the normalized Keras auto-names (``conv2d``, ``conv2d_1``, ...,
+``batch_normalization_N``) in Keras code-creation order so
+``keras_port.port_keras_weights`` output drops straight in.
+
+Cut point for featurization (``DeepImageFeaturizer``): global-average-pool
+output, 2048 features.  Default input 299x299x3, "tf" preprocessing
+(x/127.5 - 1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sparkdl_tpu.models.layers import avg_pool, global_avg_pool, max_pool
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    include_top: bool = True
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        counter = [0]
+
+        def conv_bn(y, filters, kh, kw, strides=(1, 1), padding="SAME"):
+            i = counter[0]
+            counter[0] += 1
+            conv_name = "conv2d" if i == 0 else f"conv2d_{i}"
+            bn_name = (
+                "batch_normalization" if i == 0 else f"batch_normalization_{i}"
+            )
+            y = nn.Conv(
+                filters,
+                (kh, kw),
+                strides=strides,
+                padding=padding,
+                use_bias=False,
+                dtype=self.dtype,
+                name=conv_name,
+            )(y)
+            y = nn.BatchNorm(
+                use_running_average=not train,
+                use_scale=False,
+                epsilon=1e-3,
+                dtype=self.dtype,
+                name=bn_name,
+            )(y)
+            return nn.relu(y)
+
+        # ---- stem ----
+        x = conv_bn(x, 32, 3, 3, strides=(2, 2), padding="VALID")
+        x = conv_bn(x, 32, 3, 3, padding="VALID")
+        x = conv_bn(x, 64, 3, 3)
+        x = max_pool(x, 3, 2)
+        x = conv_bn(x, 80, 1, 1, padding="VALID")
+        x = conv_bn(x, 192, 3, 3, padding="VALID")
+        x = max_pool(x, 3, 2)
+
+        # ---- mixed0..mixed2 (35x35) ----
+        for pool_features in (32, 64, 64):
+            b1 = conv_bn(x, 64, 1, 1)
+            b5 = conv_bn(x, 48, 1, 1)
+            b5 = conv_bn(b5, 64, 5, 5)
+            b3d = conv_bn(x, 64, 1, 1)
+            b3d = conv_bn(b3d, 96, 3, 3)
+            b3d = conv_bn(b3d, 96, 3, 3)
+            bp = avg_pool(x, 3, 1, "SAME")
+            bp = conv_bn(bp, pool_features, 1, 1)
+            x = jnp.concatenate([b1, b5, b3d, bp], axis=-1)
+
+        # ---- mixed3 (reduce to 17x17) ----
+        b3 = conv_bn(x, 384, 3, 3, strides=(2, 2), padding="VALID")
+        b3d = conv_bn(x, 64, 1, 1)
+        b3d = conv_bn(b3d, 96, 3, 3)
+        b3d = conv_bn(b3d, 96, 3, 3, strides=(2, 2), padding="VALID")
+        bp = max_pool(x, 3, 2)
+        x = jnp.concatenate([b3, b3d, bp], axis=-1)
+
+        # ---- mixed4..mixed7 (17x17, factorized 7x7) ----
+        for c in (128, 160, 160, 192):
+            b1 = conv_bn(x, 192, 1, 1)
+            b7 = conv_bn(x, c, 1, 1)
+            b7 = conv_bn(b7, c, 1, 7)
+            b7 = conv_bn(b7, 192, 7, 1)
+            b7d = conv_bn(x, c, 1, 1)
+            b7d = conv_bn(b7d, c, 7, 1)
+            b7d = conv_bn(b7d, c, 1, 7)
+            b7d = conv_bn(b7d, c, 7, 1)
+            b7d = conv_bn(b7d, 192, 1, 7)
+            bp = avg_pool(x, 3, 1, "SAME")
+            bp = conv_bn(bp, 192, 1, 1)
+            x = jnp.concatenate([b1, b7, b7d, bp], axis=-1)
+
+        # ---- mixed8 (reduce to 8x8) ----
+        b3 = conv_bn(x, 192, 1, 1)
+        b3 = conv_bn(b3, 320, 3, 3, strides=(2, 2), padding="VALID")
+        b7x3 = conv_bn(x, 192, 1, 1)
+        b7x3 = conv_bn(b7x3, 192, 1, 7)
+        b7x3 = conv_bn(b7x3, 192, 7, 1)
+        b7x3 = conv_bn(b7x3, 192, 3, 3, strides=(2, 2), padding="VALID")
+        bp = max_pool(x, 3, 2)
+        x = jnp.concatenate([b3, b7x3, bp], axis=-1)
+
+        # ---- mixed9, mixed10 (8x8, expanded filter banks) ----
+        for _ in range(2):
+            b1 = conv_bn(x, 320, 1, 1)
+            b3 = conv_bn(x, 384, 1, 1)
+            b3_1 = conv_bn(b3, 384, 1, 3)
+            b3_2 = conv_bn(b3, 384, 3, 1)
+            b3 = jnp.concatenate([b3_1, b3_2], axis=-1)
+            b3d = conv_bn(x, 448, 1, 1)
+            b3d = conv_bn(b3d, 384, 3, 3)
+            b3d_1 = conv_bn(b3d, 384, 1, 3)
+            b3d_2 = conv_bn(b3d, 384, 3, 1)
+            b3d = jnp.concatenate([b3d_1, b3d_2], axis=-1)
+            bp = avg_pool(x, 3, 1, "SAME")
+            bp = conv_bn(bp, 192, 1, 1)
+            x = jnp.concatenate([b1, b3, b3d, bp], axis=-1)
+
+        x = global_avg_pool(x)
+        if features_only or not self.include_top:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="predictions")(x)

@@ -1,0 +1,137 @@
+"""Keras -> Flax weight porting.
+
+The reference reused ``keras.applications`` weights directly (its models *were*
+Keras models, frozen to GraphDefs — ``keras_applications.py``†,
+``keras_utils.py``†).  Here pretrained/user Keras weights are ported into the
+Flax model zoo's parameter pytrees.
+
+Mapping strategy: Keras auto-generated layer names (``conv2d_37``,
+``batch_normalization_5``...) shift by a global uid offset between
+constructions, but their per-type *ordering* in ``model.layers`` is stable.
+``normalized_layer_names`` renumbers each auto-named type from zero in layer
+order, which yields deterministic names the Flax modules hardcode.  Explicitly
+named layers (``conv1_conv``, ``block1_sepconv1``...) pass through unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+# Keras auto-name prefixes that get renumbered per type.
+_AUTO_PREFIXES = frozenset(
+    {
+        "conv2d",
+        "batch_normalization",
+        "dense",
+        "depthwise_conv2d",
+        "separable_conv2d",
+        "activation",
+        "concatenate",
+        "max_pooling2d",
+        "average_pooling2d",
+        "global_average_pooling2d",
+        "dropout",
+        "input_layer",
+        "zero_padding2d",
+        "add",
+        "flatten",
+        "rescaling",
+    }
+)
+
+_SUFFIX_RE = re.compile(r"^(.*?)(?:_(\d+))?$")
+
+
+def normalized_layer_names(model) -> Dict[str, str]:
+    """Map each Keras layer's session-dependent name to a deterministic one.
+
+    Keras uid suffixes increment in layer *creation* order (which matches the
+    application code order the Flax modules mirror), while ``model.layers`` is
+    topologically sorted — so normalization subtracts the per-prefix minimum
+    suffix rather than renumbering by list position.
+    """
+    minima: Dict[str, int] = {}
+    parsed: Dict[str, tuple] = {}
+    for layer in model.layers:
+        m = _SUFFIX_RE.match(layer.name)
+        base, suffix = m.group(1), int(m.group(2) or 0)
+        parsed[layer.name] = (base, suffix)
+        if base in _AUTO_PREFIXES:
+            minima[base] = min(minima.get(base, suffix), suffix)
+    out: Dict[str, str] = {}
+    for layer in model.layers:
+        base, suffix = parsed[layer.name]
+        if base in _AUTO_PREFIXES:
+            idx = suffix - minima[base]
+            out[layer.name] = base if idx == 0 else f"{base}_{idx}"
+        else:
+            out[layer.name] = layer.name
+    return out
+
+
+def port_keras_weights(model) -> Dict[str, Any]:
+    """Convert a built Keras model's weights to Flax variable collections.
+
+    Returns ``{"params": {...}, "batch_stats": {...}}`` keyed by normalized
+    layer name, with per-layer leaves following Flax conventions
+    (``kernel``/``bias`` for convs and dense, ``scale``/``bias`` +
+    ``mean``/``var`` for batch norm, ``depthwise_kernel``/``pointwise_kernel``
+    for separable convs).
+    """
+    names = normalized_layer_names(model)
+    params: Dict[str, Any] = {}
+    batch_stats: Dict[str, Any] = {}
+    for layer in model.layers:
+        weights = layer.get_weights()
+        if not weights:
+            continue
+        name = names[layer.name]
+        cls = type(layer).__name__
+        if cls == "Conv2D":
+            entry = {"kernel": jnp.asarray(weights[0])}
+            if getattr(layer, "use_bias", False):
+                entry["bias"] = jnp.asarray(weights[1])
+            params[name] = entry
+        elif cls == "DepthwiseConv2D":
+            # Keras (kh, kw, cin, mult=1) -> flax grouped-conv HWIO (kh, kw, 1, cin)
+            kernel = weights[0]
+            entry = {"kernel": jnp.asarray(kernel.transpose(0, 1, 3, 2))}
+            if getattr(layer, "use_bias", False):
+                entry["bias"] = jnp.asarray(weights[1])
+            params[name] = entry
+        elif cls == "SeparableConv2D":
+            entry = {
+                "depthwise_kernel": jnp.asarray(weights[0].transpose(0, 1, 3, 2)),
+                "pointwise_kernel": jnp.asarray(weights[1]),
+            }
+            if getattr(layer, "use_bias", False):
+                entry["bias"] = jnp.asarray(weights[2])
+            params[name] = entry
+        elif cls == "Dense":
+            entry = {"kernel": jnp.asarray(weights[0])}
+            if getattr(layer, "use_bias", False):
+                entry["bias"] = jnp.asarray(weights[1])
+            params[name] = entry
+        elif cls == "BatchNormalization":
+            idx = 0
+            entry = {}
+            if layer.scale:
+                entry["scale"] = jnp.asarray(weights[idx])
+                idx += 1
+            if layer.center:
+                entry["bias"] = jnp.asarray(weights[idx])
+                idx += 1
+            batch_stats[name] = {
+                "mean": jnp.asarray(weights[idx]),
+                "var": jnp.asarray(weights[idx + 1]),
+            }
+            if entry:
+                params[name] = entry
+        else:
+            raise NotImplementedError(
+                f"No porting rule for Keras layer {layer.name} of type {cls}"
+            )
+    return {"params": params, "batch_stats": batch_stats}

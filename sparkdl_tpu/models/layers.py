@@ -1,0 +1,112 @@
+"""Shared Flax building blocks for the model zoo.
+
+Conventions (chosen for exact numerics parity with the Keras oracles):
+- NHWC layout everywhere (TPU-native; matches Keras channels_last).
+- ``'SAME'``/``'VALID'`` string padding has TensorFlow semantics in lax, so
+  stride-2 SAME pads asymmetrically exactly like Keras.
+- Average pooling excludes padded cells from the divisor (TF behavior).
+- Layer *names* are the normalized Keras layer names produced by
+  ``keras_port.normalized_layer_names`` so ported weights drop straight in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+PadLike = Union[str, Sequence[Tuple[int, int]]]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+class SeparableConv(nn.Module):
+    """Keras ``SeparableConv2D``: depthwise conv then 1x1 pointwise conv.
+
+    Parameters are registered as ``depthwise_kernel`` (kh, kw, 1, cin) and
+    ``pointwise_kernel`` (1, 1, cin, cout) matching the ported Keras shapes.
+    """
+
+    features: int
+    kernel_size: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: PadLike = "SAME"
+    use_bias: bool = False
+    dtype: Optional[Dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cin = x.shape[-1]
+        kh, kw = _pair(self.kernel_size)
+        dw_kernel = self.param(
+            "depthwise_kernel", nn.initializers.lecun_normal(), (kh, kw, 1, cin)
+        )
+        pw_kernel = self.param(
+            "pointwise_kernel",
+            nn.initializers.lecun_normal(),
+            (1, 1, cin, self.features),
+        )
+        dtype = self.dtype or x.dtype
+        x = jnp.asarray(x, dtype)
+        x = _depthwise(
+            x, jnp.asarray(dw_kernel, dtype), _pair(self.strides), self.padding
+        )
+        x = _pointwise(x, jnp.asarray(pw_kernel, dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features,))
+            x = x + jnp.asarray(bias, dtype)
+        return x
+
+
+def _depthwise(x, kernel, strides, padding):
+    import jax.lax as lax
+
+    cin = x.shape[-1]
+    # kernel (kh, kw, 1, cin) = lax HWIO with feature_group_count=cin
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=strides,
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=cin,
+    )
+
+
+def _pointwise(x, kernel):
+    import jax.lax as lax
+
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def max_pool(x, window=3, strides=2, padding="VALID"):
+    return nn.max_pool(
+        x, window_shape=_pair(window), strides=_pair(strides), padding=padding
+    )
+
+
+def avg_pool(x, window=3, strides=1, padding="SAME"):
+    # TF/Keras average pooling divides by the count of *non-padded* cells.
+    return nn.avg_pool(
+        x,
+        window_shape=_pair(window),
+        strides=_pair(strides),
+        padding=padding,
+        count_include_pad=False,
+    )
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))

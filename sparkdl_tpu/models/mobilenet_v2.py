@@ -1,0 +1,93 @@
+"""MobileNetV2 (alpha=1.0) in Flax.
+
+Parity target: ``keras.applications.mobilenet_v2.MobileNetV2`` — explicit
+stable layer names (``Conv1``, ``expanded_conv_*``, ``block_N_expand`` /
+``_depthwise`` / ``_project`` + ``_BN`` suffixes, ``Conv_1``).  ReLU6
+activations, BN epsilon 1e-3.  Stride-2 depthwise convs use TF-SAME
+asymmetric padding (equal to Keras's explicit ``correct_pad`` zero-padding
+for the even feature-map sizes this net produces from square inputs).
+Featurization cut point: global-average-pool output, 1280 features.
+Input 224x224x3, "tf" preprocessing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sparkdl_tpu.models.layers import global_avg_pool
+
+# (out_filters, stride, expansion) per inverted-residual block, block_id 0..16.
+_BLOCKS = (
+    (16, 1, 1),
+    (24, 2, 6), (24, 1, 6),
+    (32, 2, 6), (32, 1, 6), (32, 1, 6),
+    (64, 2, 6), (64, 1, 6), (64, 1, 6), (64, 1, 6),
+    (96, 1, 6), (96, 1, 6), (96, 1, 6),
+    (160, 2, 6), (160, 1, 6), (160, 1, 6),
+    (320, 1, 6),
+)
+
+
+def _relu6(x):
+    return jnp.minimum(nn.relu(x), 6.0)
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1000
+    include_top: bool = True
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        def bn(y, name):
+            return nn.BatchNorm(
+                use_running_average=not train,
+                epsilon=1e-3,
+                dtype=self.dtype,
+                name=name,
+            )(y)
+
+        def depthwise(y, stride, name):
+            cin = y.shape[-1]
+            return nn.Conv(
+                cin,
+                (3, 3),
+                strides=(stride, stride),
+                padding="SAME",
+                feature_group_count=cin,
+                use_bias=False,
+                dtype=self.dtype,
+                name=name,
+            )(y)
+
+        x = nn.Conv(32, (3, 3), strides=(2, 2), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="Conv1")(x)
+        x = _relu6(bn(x, "bn_Conv1"))
+
+        for block_id, (filters, stride, expansion) in enumerate(_BLOCKS):
+            prefix = "expanded_conv" if block_id == 0 else f"block_{block_id}"
+            inputs = x
+            cin = x.shape[-1]
+            if expansion != 1:
+                x = nn.Conv(expansion * cin, (1, 1), use_bias=False,
+                            dtype=self.dtype, name=f"{prefix}_expand")(x)
+                x = _relu6(bn(x, f"{prefix}_expand_BN"))
+            x = depthwise(x, stride, f"{prefix}_depthwise")
+            x = _relu6(bn(x, f"{prefix}_depthwise_BN"))
+            x = nn.Conv(filters, (1, 1), use_bias=False,
+                        dtype=self.dtype, name=f"{prefix}_project")(x)
+            x = bn(x, f"{prefix}_project_BN")
+            if stride == 1 and cin == filters:
+                x = inputs + x
+
+        x = nn.Conv(1280, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="Conv_1")(x)
+        x = _relu6(bn(x, "Conv_1_bn"))
+
+        x = global_avg_pool(x)
+        if features_only or not self.include_top:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="predictions")(x)

@@ -1,0 +1,177 @@
+"""Model registry — the ``keras_applications.py``† analog.
+
+Maps model name -> Flax module constructor, Keras oracle constructor, input
+geometry, preprocessing mode, and featurization cut-point size, mirroring the
+reference's ``KERAS_APPLICATION_MODELS`` / ``getKerasApplicationModel`` and
+its ``SUPPORTED_MODELS`` list (``python/sparkdl/transformers/named_image.py``†
+consumed the same registry).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.models.inception_v3 import InceptionV3
+from sparkdl_tpu.models.mobilenet_v2 import MobileNetV2
+from sparkdl_tpu.models.resnet import ResNet50
+from sparkdl_tpu.models.vgg import VGG16, VGG19
+from sparkdl_tpu.models.xception import Xception
+
+_CAFFE_MEAN_BGR = (103.939, 116.779, 123.68)
+_TORCH_MEAN = (0.485, 0.456, 0.406)
+_TORCH_STD = (0.229, 0.224, 0.225)
+
+
+def preprocess_input(x, mode: str):
+    """Keras ``preprocess_input`` parity, jnp-traceable.
+
+    ``x``: float RGB in [0, 255], NHWC.
+    """
+    if mode == "tf":
+        return x / 127.5 - 1.0
+    if mode == "caffe":
+        x = x[..., ::-1]  # RGB -> BGR
+        return x - jnp.asarray(_CAFFE_MEAN_BGR, dtype=x.dtype)
+    if mode == "torch":
+        x = x / 255.0
+        return (x - jnp.asarray(_TORCH_MEAN, dtype=x.dtype)) / jnp.asarray(
+            _TORCH_STD, dtype=x.dtype
+        )
+    raise ValueError(f"Unknown preprocessing mode: {mode!r}")
+
+
+class KerasApplicationModel:
+    """One registry entry: everything the transformers need to run a named
+    pretrained CNN (the per-model class pattern of ``keras_applications.py``†).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        flax_cls,
+        keras_name: str,
+        input_size: Tuple[int, int],
+        feature_size: int,
+        preprocess_mode: str,
+        num_classes: int = 1000,
+    ):
+        self.name = name
+        self.flax_cls = flax_cls
+        self.keras_name = keras_name
+        self.input_size = input_size
+        self.feature_size = feature_size
+        self.preprocess_mode = preprocess_mode
+        self.num_classes = num_classes
+
+    # -- geometry / preprocessing ------------------------------------
+    def inputShape(self) -> Tuple[int, int]:
+        return self.input_size
+
+    def preprocess(self, x):
+        return preprocess_input(x, self.preprocess_mode)
+
+    # -- model construction ------------------------------------------
+    def make_module(self, dtype: Optional[Any] = None, include_top: bool = True):
+        return self.flax_cls(include_top=include_top, dtype=dtype)
+
+    def keras_model(self, weights: Optional[str] = "imagenet"):
+        """Build the Keras oracle/weight-source model (lazy keras import)."""
+        import keras
+
+        ctor = getattr(keras.applications, self.keras_name)
+        return ctor(weights=weights, classifier_activation=None)
+
+    def load_variables(self, weights="imagenet"):
+        """Flax variables for this model.
+
+        ``weights``: ``"imagenet"`` / ``None`` (delegated to Keras) or an
+        already-built Keras model to port from.
+        """
+        from sparkdl_tpu.models.keras_port import port_keras_weights
+
+        model = (
+            weights
+            if not isinstance(weights, (str, type(None)))
+            else self.keras_model(weights)
+        )
+        return port_keras_weights(model)
+
+    def __repr__(self):
+        return (
+            f"KerasApplicationModel({self.name}, input={self.input_size}, "
+            f"features={self.feature_size}, mode={self.preprocess_mode!r})"
+        )
+
+
+KERAS_APPLICATION_MODELS: Dict[str, KerasApplicationModel] = {
+    m.name: m
+    for m in [
+        KerasApplicationModel("InceptionV3", InceptionV3, "InceptionV3",
+                              (299, 299), 2048, "tf"),
+        KerasApplicationModel("Xception", Xception, "Xception",
+                              (299, 299), 2048, "tf"),
+        KerasApplicationModel("ResNet50", ResNet50, "ResNet50",
+                              (224, 224), 2048, "caffe"),
+        KerasApplicationModel("VGG16", VGG16, "VGG16",
+                              (224, 224), 4096, "caffe"),
+        KerasApplicationModel("VGG19", VGG19, "VGG19",
+                              (224, 224), 4096, "caffe"),
+        KerasApplicationModel("MobileNetV2", MobileNetV2, "MobileNetV2",
+                              (224, 224), 1280, "tf"),
+    ]
+}
+
+# The reference's SUPPORTED_MODELS (named_image.py†) plus MobileNetV2.
+SUPPORTED_MODELS = tuple(KERAS_APPLICATION_MODELS)
+
+
+def get_keras_application_model(name: str) -> KerasApplicationModel:
+    if name not in KERAS_APPLICATION_MODELS:
+        raise ValueError(
+            f"Unsupported model: {name!r}. Supported: {sorted(SUPPORTED_MODELS)}"
+        )
+    return KERAS_APPLICATION_MODELS[name]
+
+
+# Reference-spelling alias (sparkdl.transformers.keras_applications†).
+getKerasApplicationModel = get_keras_application_model
+
+
+def decode_predictions(preds, top: int = 5):
+    """``imagenet_utils.decode_predictions`` analog.
+
+    Uses Keras's cached class index when available; otherwise falls back to
+    synthetic ``class_<idx>`` labels (this environment has no network).
+    Accepts logits or probabilities, shape (batch, 1000).
+    """
+    import numpy as np
+
+    preds = np.asarray(preds)
+    class_index = None
+    try:  # pragma: no cover - depends on local keras cache
+        import json
+        import os
+
+        path = os.path.expanduser(
+            "~/.keras/models/imagenet_class_index.json"
+        )
+        if os.path.exists(path):
+            with open(path) as fh:
+                class_index = json.load(fh)
+    except Exception:
+        class_index = None
+
+    results = []
+    for row in preds:
+        top_idx = row.argsort()[-top:][::-1]
+        entries = []
+        for i in top_idx:
+            if class_index is not None:
+                wnid, label = class_index[str(int(i))]
+            else:
+                wnid, label = f"n{int(i):08d}", f"class_{int(i)}"
+            entries.append((wnid, label, float(row[i])))
+        results.append(entries)
+    return results

@@ -1,0 +1,80 @@
+"""ResNet50 (v1, post-activation) in Flax.
+
+Parity target: ``keras.applications.resnet.ResNet50`` — explicit stable layer
+names (``conv1_conv``, ``conv{S}_block{B}_{i}_conv`` / ``_bn``), convs with
+bias, BN epsilon 1.001e-5, stride carried by the first 1x1 conv of each
+block (Keras v1 convention).  Featurization cut point: global-average-pool
+output (``avg_pool``), 2048 features.  Input 224x224x3, "caffe"
+preprocessing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sparkdl_tpu.models.layers import global_avg_pool, max_pool
+
+_BN_EPS = 1.001e-5
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    include_top: bool = True
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        def conv(y, filters, kernel, name, strides=1, padding="VALID"):
+            return nn.Conv(
+                filters,
+                (kernel, kernel),
+                strides=(strides, strides),
+                padding=padding,
+                use_bias=True,
+                dtype=self.dtype,
+                name=name,
+            )(y)
+
+        def bn(y, name):
+            return nn.BatchNorm(
+                use_running_average=not train,
+                epsilon=_BN_EPS,
+                dtype=self.dtype,
+                name=name,
+            )(y)
+
+        def block(y, filters, name, stride=1, conv_shortcut=True):
+            if conv_shortcut:
+                shortcut = conv(y, 4 * filters, 1, f"{name}_0_conv", strides=stride)
+                shortcut = bn(shortcut, f"{name}_0_bn")
+            else:
+                shortcut = y
+            y = nn.relu(bn(conv(y, filters, 1, f"{name}_1_conv", strides=stride),
+                           f"{name}_1_bn"))
+            y = nn.relu(bn(conv(y, filters, 3, f"{name}_2_conv", padding="SAME"),
+                           f"{name}_2_bn"))
+            y = bn(conv(y, 4 * filters, 1, f"{name}_3_conv"), f"{name}_3_bn")
+            return nn.relu(shortcut + y)
+
+        def stack(y, filters, n_blocks, name, stride1=2):
+            y = block(y, filters, f"{name}_block1", stride=stride1)
+            for i in range(2, n_blocks + 1):
+                y = block(y, filters, f"{name}_block{i}", conv_shortcut=False)
+            return y
+
+        x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+        x = conv(x, 64, 7, "conv1_conv", strides=2)
+        x = nn.relu(bn(x, "conv1_bn"))
+        x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+        x = max_pool(x, 3, 2)
+        x = stack(x, 64, 3, "conv2", stride1=1)
+        x = stack(x, 128, 4, "conv3")
+        x = stack(x, 256, 6, "conv4")
+        x = stack(x, 512, 3, "conv5")
+        x = global_avg_pool(x)
+        if features_only or not self.include_top:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="predictions")(x)

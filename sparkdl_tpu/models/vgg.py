@@ -1,0 +1,62 @@
+"""VGG16 / VGG19 in Flax.
+
+Parity target: ``keras.applications.vgg16`` / ``vgg19`` (explicit stable layer
+names ``blockN_convM``, ``fc1``, ``fc2``, ``predictions``).  The reference's
+``DeepImageFeaturizer`` cut point for VGG is the ``fc2`` output (4096
+features, after its inline ReLU) — ``keras_applications.py``†.  Input
+224x224x3, "caffe" preprocessing (BGR, mean subtraction).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sparkdl_tpu.models.layers import max_pool
+
+
+class _VGG(nn.Module):
+    blocks: Sequence[int]
+    num_classes: int = 1000
+    include_top: bool = True
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        filters = (64, 128, 256, 512, 512)
+        for b, (n_convs, f) in enumerate(zip(self.blocks, filters), start=1):
+            for c in range(1, n_convs + 1):
+                x = nn.Conv(
+                    f,
+                    (3, 3),
+                    padding="SAME",
+                    dtype=self.dtype,
+                    name=f"block{b}_conv{c}",
+                )(x)
+                x = nn.relu(x)
+            x = max_pool(x, 2, 2)
+        if not self.include_top:
+            if features_only:
+                # The VGG cut point IS fc2; without the top there is nothing
+                # to cut at — fail loudly instead of returning a conv map.
+                raise ValueError(
+                    "VGG featurization (features_only=True) requires "
+                    "include_top=True: the cut point is the fc2 output."
+                )
+            return x
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        if features_only:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="predictions")(x)
+
+
+class VGG16(_VGG):
+    blocks: Sequence[int] = (2, 2, 3, 3, 3)
+
+
+class VGG19(_VGG):
+    blocks: Sequence[int] = (2, 2, 4, 4, 4)

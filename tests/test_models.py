@@ -1,0 +1,121 @@
+"""Model-zoo oracle tests.
+
+Reference test pattern (SURVEY.md §4): framework output is compared against
+directly calling the same Keras model on the same arrays — the oracle is
+single-process Keras (``python/tests/transformers/named_image_test.py``†).
+Here the Keras models carry random (``weights=None``) initialization because
+the environment has no network for pretrained downloads; the *porting map* is
+what's under test, and any mis-wiring shows up as a numeric mismatch.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sparkdl_tpu.models import (
+    KERAS_APPLICATION_MODELS,
+    SUPPORTED_MODELS,
+    get_keras_application_model,
+    port_keras_weights,
+)
+from sparkdl_tpu.models.registry import decode_predictions, preprocess_input
+
+keras = pytest.importorskip("keras")
+
+ALL_MODELS = ["InceptionV3", "Xception", "ResNet50", "VGG16", "VGG19",
+              "MobileNetV2"]
+
+
+@pytest.fixture(scope="module")
+def oracle_cache():
+    return {}
+
+
+def _oracle(name, cache):
+    if name not in cache:
+        entry = get_keras_application_model(name)
+        km = entry.keras_model(weights=None)
+        cache[name] = (entry, km, entry.load_variables(km))
+    return cache[name]
+
+
+def test_registry_surface():
+    assert set(SUPPORTED_MODELS) == set(ALL_MODELS)
+    for name in SUPPORTED_MODELS:
+        entry = KERAS_APPLICATION_MODELS[name]
+        h, w = entry.inputShape()
+        assert h == w and h in (224, 299)
+        assert entry.feature_size in (1280, 2048, 4096)
+    with pytest.raises(ValueError):
+        get_keras_application_model("NoSuchNet")
+
+
+@pytest.mark.parametrize("name", ALL_MODELS)
+def test_logits_match_keras_oracle(name, oracle_cache):
+    entry, km, variables = _oracle(name, oracle_cache)
+    h, w = entry.input_size
+    x = np.random.RandomState(0).rand(2, h, w, 3).astype("float32") * 2 - 1
+    expected = np.asarray(km(x, training=False))
+    fm = entry.make_module()
+    got = np.asarray(jax.jit(fm.apply)(variables, jnp.asarray(x)))
+    assert got.shape == (2, 1000)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("name", ["InceptionV3", "VGG16"])
+def test_feature_cut_point(name, oracle_cache):
+    """DeepImageFeaturizer cut points: GAP for the CNNs, fc2 for VGG."""
+    entry, km, variables = _oracle(name, oracle_cache)
+    h, w = entry.input_size
+    x = np.random.RandomState(1).rand(1, h, w, 3).astype("float32")
+    fm = entry.make_module()
+    feats = np.asarray(
+        jax.jit(lambda v, a: fm.apply(v, a, features_only=True))(
+            variables, jnp.asarray(x)
+        )
+    )
+    assert feats.shape == (1, entry.feature_size)
+    # Keras-side oracle for the cut: penultimate layer of the same model.
+    cut_layer = "avg_pool" if name != "VGG16" else "fc2"
+    sub = keras.Model(km.inputs, km.get_layer(cut_layer).output)
+    expected = np.asarray(sub(x, training=False))
+    np.testing.assert_allclose(feats, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_init_shapes_match_ported_shapes(oracle_cache):
+    entry, km, variables = _oracle("MobileNetV2", oracle_cache)
+    fm = entry.make_module()
+    init = jax.eval_shape(
+        fm.init, jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3))
+    )
+    got = jax.tree_util.tree_map(lambda v: tuple(v.shape), variables)
+    want = jax.tree_util.tree_map(lambda v: tuple(v.shape), init)
+    assert got == want
+
+
+def test_preprocess_modes():
+    x = jnp.full((1, 2, 2, 3), 255.0)
+    tf_out = preprocess_input(x, "tf")
+    np.testing.assert_allclose(np.asarray(tf_out), 1.0)
+    caffe = np.asarray(preprocess_input(x, "caffe"))
+    np.testing.assert_allclose(
+        caffe[0, 0, 0], [255 - 103.939, 255 - 116.779, 255 - 123.68]
+    )
+    torch_out = np.asarray(preprocess_input(x, "torch"))
+    np.testing.assert_allclose(
+        torch_out[0, 0, 0], (1.0 - np.array([0.485, 0.456, 0.406]))
+        / np.array([0.229, 0.224, 0.225]), rtol=1e-6
+    )
+    with pytest.raises(ValueError):
+        preprocess_input(x, "nope")
+
+
+def test_decode_predictions_fallback():
+    preds = np.zeros((1, 1000), dtype=np.float32)
+    preds[0, 7] = 5.0
+    preds[0, 3] = 4.0
+    out = decode_predictions(preds, top=2)
+    assert len(out) == 1 and len(out[0]) == 2
+    wnid, label, score = out[0][0]
+    assert score == 5.0 and (label == "class_7" or wnid.startswith("n"))
