@@ -1,0 +1,186 @@
+"""LogisticRegression — the transfer-learning head.
+
+The reference's flagship flow pairs ``DeepImageFeaturizer`` with Spark
+MLlib's ``LogisticRegression`` (tf-flowers example in the README†;
+BASELINE.json north star).  MLlib is external to the reference repo, so this
+is a minimal API-compatible head: multinomial logistic regression trained
+full-batch with optax on device (feature matrices here are small —
+N x 1024..4096 — so one jitted ``fori``-style loop beats a sharded pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from sparkdl_tpu.ml.base import Estimator, Model
+from sparkdl_tpu.ml.linalg import DenseVector
+from sparkdl_tpu.param.base import Param, TypeConverters, keyword_only
+from sparkdl_tpu.param.shared import HasInputCol, HasLabelCol
+
+
+class LogisticRegressionModel(Model):
+    def __init__(self, weights, bias, featuresCol, predictionCol,
+                 probabilityCol):
+        super().__init__()
+        self.weights = weights  # (D, K) float32
+        self.bias = bias  # (K,)
+        self._features_col = featuresCol
+        self._prediction_col = predictionCol
+        self._probability_col = probabilityCol
+
+    @property
+    def numClasses(self) -> int:
+        return int(self.weights.shape[1])
+
+    def _transform(self, dataset):
+        w = jnp.asarray(self.weights)
+        b = jnp.asarray(self.bias)
+        features_col = self._features_col
+        prediction_col = self._prediction_col
+        probability_col = self._probability_col
+
+        @jax.jit
+        def forward(x):
+            logits = x @ w + b
+            return jax.nn.softmax(logits, axis=-1)
+
+        def process_partition(part):
+            out = dict(part)
+            feats = part[features_col]
+            if not feats:
+                out[prediction_col] = []
+                if probability_col:
+                    out[probability_col] = []
+                return out
+            x = np.stack([np.asarray(v, dtype=np.float32) for v in feats])
+            probs = np.asarray(forward(jnp.asarray(x)))
+            out[prediction_col] = [float(p.argmax()) for p in probs]
+            if probability_col:
+                out[probability_col] = [
+                    DenseVector(p.astype(np.float64)) for p in probs
+                ]
+            return out
+
+        return dataset.mapPartitions(process_partition)
+
+
+class LogisticRegression(Estimator, HasInputCol, HasLabelCol):
+    featuresCol = Param(
+        "undefined", "featuresCol", "features column name",
+        TypeConverters.toString,
+    )
+    predictionCol = Param(
+        "undefined", "predictionCol", "prediction column name",
+        TypeConverters.toString,
+    )
+    probabilityCol = Param(
+        "undefined", "probabilityCol", "probability column name",
+        TypeConverters.toString,
+    )
+    maxIter = Param(
+        "undefined", "maxIter", "max optimization steps", TypeConverters.toInt
+    )
+    regParam = Param(
+        "undefined", "regParam", "L2 regularization strength",
+        TypeConverters.toFloat,
+    )
+    stepSize = Param(
+        "undefined", "stepSize", "optimizer learning rate",
+        TypeConverters.toFloat,
+    )
+
+    @keyword_only
+    def __init__(
+        self,
+        featuresCol: str = "features",
+        labelCol: str = "label",
+        predictionCol: str = "prediction",
+        probabilityCol: str = "probability",
+        maxIter: int = 100,
+        regParam: float = 0.0,
+        stepSize: float = 0.1,
+    ):
+        super().__init__()
+        self._setDefault(
+            featuresCol="features",
+            labelCol="label",
+            predictionCol="prediction",
+            probabilityCol="probability",
+            maxIter=100,
+            regParam=0.0,
+            stepSize=0.1,
+        )
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(
+        self,
+        featuresCol: str = "features",
+        labelCol: str = "label",
+        predictionCol: str = "prediction",
+        probabilityCol: str = "probability",
+        maxIter: int = 100,
+        regParam: float = 0.0,
+        stepSize: float = 0.1,
+    ):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    def _fit(self, dataset) -> LogisticRegressionModel:
+        features_col = self.getOrDefault(self.featuresCol)
+        label_col = self.getOrDefault(self.labelCol)
+        rows = dataset.select(features_col, label_col).collect()
+        x = np.stack(
+            [np.asarray(r[features_col], dtype=np.float32) for r in rows]
+        )
+        y = np.asarray([int(r[label_col]) for r in rows], dtype=np.int32)
+        n, d = x.shape
+        k = int(y.max()) + 1 if len(y) else 2
+        max_iter = self.getOrDefault(self.maxIter)
+        reg = self.getOrDefault(self.regParam)
+        lr = self.getOrDefault(self.stepSize)
+
+        params = {
+            "w": jnp.zeros((d, k), jnp.float32),
+            "b": jnp.zeros((k,), jnp.float32),
+        }
+        tx = optax.adam(lr)
+        opt_state = tx.init(params)
+
+        def loss_fn(p, xb, yb):
+            logits = xb @ p["w"] + p["b"]
+            nll = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb
+            ).mean()
+            return nll + reg * (p["w"] ** 2).sum()
+
+        # data rides as arguments (not closed-over constants) so the compiled
+        # program is dataset-independent and CrossValidator folds reuse it
+        @jax.jit
+        def train(p, s, xb, yb):
+            def step(carry, _):
+                p, s = carry
+                grads = jax.grad(loss_fn)(p, xb, yb)
+                updates, s = tx.update(grads, s, p)
+                return (optax.apply_updates(p, updates), s), None
+
+            (p, s), _ = jax.lax.scan(step, (p, s), None, length=max_iter)
+            return p
+
+        params = train(params, opt_state, jnp.asarray(x), jnp.asarray(y))
+        return self._copyValues(
+            LogisticRegressionModel(
+                np.asarray(params["w"]),
+                np.asarray(params["b"]),
+                features_col,
+                self.getOrDefault(self.predictionCol),
+                self.getOrDefault(self.probabilityCol),
+            )
+        )

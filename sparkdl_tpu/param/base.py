@@ -31,8 +31,10 @@ def keyword_only(func: Callable) -> Callable:
             raise TypeError(
                 "Method %s only takes keyword arguments." % func.__name__
             )
+        # RLock: @keyword_only __init__ calls @keyword_only setParams while
+        # holding the lock (pyspark's decorator is reentrant the same way).
         self._input_kwargs_lock = getattr(
-            self, "_input_kwargs_lock", threading.Lock()
+            self, "_input_kwargs_lock", threading.RLock()
         )
         with self._input_kwargs_lock:
             self._input_kwargs = kwargs

@@ -1,0 +1,8 @@
+"""Model transformers — Spark-ML-pipeline-stage analogs over the TPU engine.
+
+Reference analog: ``python/sparkdl/transformers/``† (SURVEY.md §2):
+``TFImageTransformer`` → :class:`~sparkdl_tpu.transformers.tf_image.TFImageTransformer`,
+``DeepImageFeaturizer``/``DeepImagePredictor`` → ``named_image``,
+``KerasImageFileTransformer`` → ``keras_image``, ``TFTransformer`` →
+``tf_tensor``, ``KerasTransformer`` → ``keras_tensor``.
+"""

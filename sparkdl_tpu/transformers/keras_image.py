@@ -1,0 +1,104 @@
+"""KerasImageFileTransformer — URI column → loaded images → Keras model.
+
+Reference analog: ``python/sparkdl/transformers/keras_image.py``† (SURVEY.md
+§2): a user ``imageLoader(uri) -> ndarray`` loads + preprocesses each file;
+the ``.h5``/``.keras`` model (Keras 3 on its JAX backend) then runs jitted on
+TPU — the reference's load-h5-freeze-to-GraphDef step
+(``keras_utils.KSessionWrap``†) has no analog because ``stateless_call`` is
+already jax-traceable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+from sparkdl_tpu.graph.function import XlaFunction
+from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.ml.linalg import DenseVector
+from sparkdl_tpu.param.base import Param, TypeConverters, keyword_only
+from sparkdl_tpu.param.shared import (
+    CanLoadImage,
+    HasInputCol,
+    HasKerasModel,
+    HasOutputCol,
+    HasOutputMode,
+)
+from sparkdl_tpu.transformers.utils import (
+    DEFAULT_BATCH_SIZE,
+    place_params,
+    run_batched,
+)
+from sparkdl_tpu.image import imageIO
+
+
+class KerasImageFileTransformer(
+    Transformer, HasInputCol, HasOutputCol, HasOutputMode, CanLoadImage,
+    HasKerasModel
+):
+    batchSize = Param(
+        "undefined", "batchSize", "rows per device batch", TypeConverters.toInt
+    )
+
+    @keyword_only
+    def __init__(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        modelFile: Optional[str] = None,
+        imageLoader=None,
+        outputMode: str = "vector",
+        batchSize: int = DEFAULT_BATCH_SIZE,
+    ):
+        super().__init__()
+        self._setDefault(outputMode="vector", batchSize=DEFAULT_BATCH_SIZE)
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        modelFile: Optional[str] = None,
+        imageLoader=None,
+        outputMode: str = "vector",
+        batchSize: int = DEFAULT_BATCH_SIZE,
+    ):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    def _transform(self, dataset):
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+        loader = self.getImageLoader()
+        mode = self.getOutputMode()
+        batch_size = self.getOrDefault(self.batchSize)
+
+        fn = XlaFunction.from_keras(self.getModelFile())
+        params = place_params(fn.params)
+        jitted = jax.jit(lambda x: fn.apply(params, x)[0])
+
+        def process_partition(part):
+            uris = part[input_col]
+            out = dict(part)
+            if not uris:
+                out[output_col] = []
+                return out
+            arrays = [np.asarray(loader(u), dtype=np.float32) for u in uris]
+            batch = np.stack(arrays)
+            result = run_batched(jitted, batch, batch_size)
+            if mode == "vector":
+                flat = result.reshape(result.shape[0], -1).astype(np.float64)
+                out[output_col] = [DenseVector(v) for v in flat]
+            else:
+                out[output_col] = [
+                    imageIO.imageArrayToStruct(np.asarray(r, dtype=np.float32))
+                    for r in result
+                ]
+            return out
+
+        return dataset.mapPartitions(process_partition)

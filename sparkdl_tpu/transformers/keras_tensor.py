@@ -1,0 +1,58 @@
+"""KerasTransformer — a saved Keras model over a 1-D array column.
+
+Reference analog: ``python/sparkdl/transformers/keras_tensor.py``† (SURVEY.md
+§2): loads a ``.h5`` model, freezes it to a TF graph, delegates to
+TFTransformer.  Here the load is :meth:`XlaFunction.from_keras` (jax-backend
+``stateless_call``) and execution delegates to :class:`TFTransformer`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from sparkdl_tpu.graph.function import XlaFunction
+from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.param.base import Param, TypeConverters, keyword_only
+from sparkdl_tpu.param.shared import HasInputCol, HasKerasModel, HasOutputCol
+from sparkdl_tpu.transformers.tf_tensor import TFTransformer
+from sparkdl_tpu.transformers.utils import DEFAULT_BATCH_SIZE
+
+
+class KerasTransformer(Transformer, HasInputCol, HasOutputCol, HasKerasModel):
+    batchSize = Param(
+        "undefined", "batchSize", "rows per device batch", TypeConverters.toInt
+    )
+
+    @keyword_only
+    def __init__(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        modelFile: Optional[str] = None,
+        batchSize: int = DEFAULT_BATCH_SIZE,
+    ):
+        super().__init__()
+        self._setDefault(batchSize=DEFAULT_BATCH_SIZE)
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        modelFile: Optional[str] = None,
+        batchSize: int = DEFAULT_BATCH_SIZE,
+    ):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    def _transform(self, dataset):
+        fn = XlaFunction.from_keras(self.getModelFile())
+        delegate = TFTransformer(
+            tfInputGraph=fn,
+            inputMapping={self.getInputCol(): fn.input_names[0]},
+            outputMapping={fn.output_names[0]: self.getOutputCol()},
+            batchSize=self.getOrDefault(self.batchSize),
+        )
+        return delegate._transform(dataset)

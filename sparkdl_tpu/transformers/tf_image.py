@@ -1,0 +1,179 @@
+"""TFImageTransformer — run an arbitrary XlaFunction over an image column.
+
+Reference analog: ``python/sparkdl/transformers/tf_image.py``† (SURVEY.md §2,
+§3.1): applies a TF graph to an image-struct column via TensorFrames,
+outputting an MLlib Vector or a new image struct.  Here the graph is an
+:class:`~sparkdl_tpu.graph.function.XlaFunction`; decode happens host-side
+(zero-copy ``frombuffer``), resize + channel handling + model run happen
+on-device in one jitted program per batch shape.
+
+The reference name is kept (``TFImageTransformer``); ``TPUImageTransformer``
+is the native spelling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.ml.linalg import DenseVector
+from sparkdl_tpu.param.base import Param, TypeConverters, keyword_only
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
+from sparkdl_tpu.param.shared import (
+    HasInputCol,
+    HasOutputCol,
+    HasOutputMode,
+)
+from sparkdl_tpu.transformers.utils import (
+    DEFAULT_BATCH_SIZE,
+    device_resize,
+    normalize_channels,
+    place_params,
+    run_batched,
+)
+
+
+class TFImageTransformer(Transformer, HasInputCol, HasOutputCol, HasOutputMode):
+    """Applies an :class:`XlaFunction` to an image-struct column.
+
+    ``channelOrder`` is the order the function expects its input channels in
+    ('RGB', 'BGR', or 'L'); stored image structs are BGR (Spark convention),
+    and the conversion happens on device.
+    """
+
+    graph = Param(
+        "undefined",
+        "graph",
+        "XlaFunction to apply to the image column",
+        SparkDLTypeConverters.toXlaFunction,
+    )
+    inputShape = Param(
+        "undefined",
+        "inputShape",
+        "(height, width) the function expects; images are resized on device. "
+        "None runs images at their stored size (must then be uniform).",
+    )
+    channelOrder = Param(
+        "undefined",
+        "channelOrder",
+        "channel order the function expects: 'RGB', 'BGR' or 'L'",
+        SparkDLTypeConverters.toChannelOrder,
+    )
+    batchSize = Param(
+        "undefined",
+        "batchSize",
+        "rows per device batch (one XLA program per batch shape)",
+        TypeConverters.toInt,
+    )
+
+    @keyword_only
+    def __init__(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        graph=None,
+        inputShape: Optional[Tuple[int, int]] = None,
+        channelOrder: str = "RGB",
+        outputMode: str = "vector",
+        batchSize: int = DEFAULT_BATCH_SIZE,
+    ):
+        super().__init__()
+        self._setDefault(
+            inputShape=None,
+            channelOrder="RGB",
+            outputMode="vector",
+            batchSize=DEFAULT_BATCH_SIZE,
+        )
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        graph=None,
+        inputShape: Optional[Tuple[int, int]] = None,
+        channelOrder: str = "RGB",
+        outputMode: str = "vector",
+        batchSize: int = DEFAULT_BATCH_SIZE,
+    ):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    def setGraph(self, value):
+        return self._set(graph=value)
+
+    def getGraph(self):
+        return self.getOrDefault(self.graph)
+
+    # ------------------------------------------------------------------
+    def _transform(self, dataset):
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+        fn = self.getGraph()
+        size = self.getOrDefault(self.inputShape)
+        order = self.getOrDefault(self.channelOrder)
+        mode = self.getOutputMode()
+        batch_size = self.getOrDefault(self.batchSize)
+
+        if len(fn.output_names) != 1:
+            raise ValueError(
+                "TFImageTransformer requires a single-output XlaFunction "
+                f"(got outputs {fn.output_names}); use TFTransformer with an "
+                "outputMapping for multi-output functions."
+            )
+        params = place_params(fn.params)
+        want_bgr = order == "BGR"
+
+        def model_fn(x):
+            # stored order is BGR; flip on device if the fn wants RGB
+            if not want_bgr and x.shape[-1] == 3:
+                x = x[..., ::-1]
+            return fn.apply(params, x)[0]
+
+        jitted = jax.jit(model_fn)
+
+        def process_partition(part):
+            rows = part[input_col]
+            if not rows:
+                out = dict(part)
+                out[output_col] = []
+                return out
+            n_channels = 1 if order == "L" else 3
+            images = [
+                normalize_channels(
+                    imageIO.imageStructToArray(r).astype(np.float32),
+                    n_channels,
+                )
+                for r in rows
+            ]
+            if size is not None:
+                batch = device_resize(images, size)
+            else:
+                batch = np.stack(images)
+            result = run_batched(jitted, batch, batch_size)
+            out = dict(part)
+            if mode == "vector":
+                flat = result.reshape(result.shape[0], -1).astype(np.float64)
+                out[output_col] = [DenseVector(v) for v in flat]
+            else:  # "image"
+                out[output_col] = [
+                    imageIO.imageArrayToStruct(
+                        np.asarray(img, dtype=np.float32), origin=""
+                    )
+                    for img in result
+                ]
+            return out
+
+        return dataset.mapPartitions(process_partition)
+
+
+# Native spelling.
+TPUImageTransformer = TFImageTransformer

@@ -1,0 +1,117 @@
+"""TFTransformer — arbitrary XlaFunction over tensor (1-D array) columns.
+
+Reference analog: ``python/sparkdl/transformers/tf_tensor.py``† (SURVEY.md
+§2): maps DataFrame array columns through a ``TFInputGraph`` via TensorFrames.
+Here ``inputMapping`` routes columns to the function's named inputs and
+``outputMapping`` routes named outputs back to columns; execution is batched
+and jitted.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.ml.base import Transformer
+from sparkdl_tpu.param.base import Param, TypeConverters, keyword_only
+from sparkdl_tpu.param.converters import SparkDLTypeConverters
+from sparkdl_tpu.transformers.utils import (
+    DEFAULT_BATCH_SIZE,
+    place_params,
+    run_batched_multi,
+)
+
+
+class TFTransformer(Transformer):
+    tfInputGraph = Param(
+        "undefined",
+        "tfInputGraph",
+        "XlaFunction to run over the tensor columns",
+        SparkDLTypeConverters.toXlaFunction,
+    )
+    inputMapping = Param(
+        "undefined",
+        "inputMapping",
+        "dict: DataFrame column name -> function input name",
+    )
+    outputMapping = Param(
+        "undefined",
+        "outputMapping",
+        "dict: function output name -> new DataFrame column name",
+    )
+    batchSize = Param(
+        "undefined", "batchSize", "rows per device batch", TypeConverters.toInt
+    )
+
+    @keyword_only
+    def __init__(
+        self,
+        tfInputGraph=None,
+        inputMapping: Optional[Dict[str, str]] = None,
+        outputMapping: Optional[Dict[str, str]] = None,
+        batchSize: int = DEFAULT_BATCH_SIZE,
+    ):
+        super().__init__()
+        self._setDefault(batchSize=DEFAULT_BATCH_SIZE)
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(
+        self,
+        tfInputGraph=None,
+        inputMapping: Optional[Dict[str, str]] = None,
+        outputMapping: Optional[Dict[str, str]] = None,
+        batchSize: int = DEFAULT_BATCH_SIZE,
+    ):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    def _transform(self, dataset):
+        fn = self.getOrDefault(self.tfInputGraph)
+        input_mapping = dict(self.getOrDefault(self.inputMapping))
+        output_mapping = dict(self.getOrDefault(self.outputMapping))
+        batch_size = self.getOrDefault(self.batchSize)
+
+        unknown_in = set(input_mapping.values()) - set(fn.input_names)
+        unknown_out = set(output_mapping) - set(fn.output_names)
+        if unknown_in:
+            raise ValueError(f"Unknown function inputs: {sorted(unknown_in)}")
+        if unknown_out:
+            raise ValueError(f"Unknown function outputs: {sorted(unknown_out)}")
+
+        # column order aligned to the function's positional inputs
+        col_for_input = {v: k for k, v in input_mapping.items()}
+        ordered_cols = [col_for_input[name] for name in fn.input_names]
+
+        params = place_params(fn.params)
+        jitted = jax.jit(lambda *xs: fn.apply(params, *xs))
+
+        def process_partition(part):
+            out = dict(part)
+            n = len(part[ordered_cols[0]]) if ordered_cols else 0
+            if n == 0:
+                for col in output_mapping.values():
+                    out[col] = []
+                return out
+            columns = [
+                np.stack(
+                    [np.asarray(v, dtype=np.float32) for v in part[c]]
+                )
+                for c in ordered_cols
+            ]
+            results = run_batched_multi(jitted, columns, batch_size)
+            by_name = dict(zip(fn.output_names, results))
+            for name, col in output_mapping.items():
+                out[col] = [np.asarray(v) for v in by_name[name]]
+            return out
+
+        return dataset.mapPartitions(process_partition)
+
+
+# Native spelling.
+TPUTransformer = TFTransformer

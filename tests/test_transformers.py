@@ -1,0 +1,348 @@
+"""Transformer integration tests (local engine + CPU jax).
+
+Reference pattern (SURVEY.md §4): transformer output is compared against
+directly running the same model on the same decoded arrays — the oracle is
+plain Keras / numpy, tolerance-based (``named_image_test.py``†,
+``tf_image_test.py``†, ``keras_tensor_test.py``†).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sparkdl_tpu.graph.function import XlaFunction
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml.classification import LogisticRegression
+from sparkdl_tpu.ml.evaluation import MulticlassClassificationEvaluator
+from sparkdl_tpu.ml.pipeline import Pipeline
+from sparkdl_tpu.models import get_keras_application_model
+
+keras = pytest.importorskip("keras")
+
+
+@pytest.fixture(scope="module")
+def mobilenet_oracle():
+    entry = get_keras_application_model("MobileNetV2")
+    km = entry.keras_model(weights=None)
+    return entry, km, entry.load_variables(km)
+
+
+@pytest.fixture()
+def image_df(tpu_session, image_dir):
+    return imageIO.readImages(image_dir, tpu_session, numPartitions=2)
+
+
+def _decoded_rgb_images(df, input_col="image"):
+    out = []
+    for row in df.collect():
+        arr = imageIO.imageStructToArray(row[input_col]).astype(np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.shape[-1] == 1:
+            arr = np.repeat(arr, 3, axis=-1)
+        arr = arr[:, :, ::-1]  # stored BGR -> RGB
+        out.append(arr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TFImageTransformer
+# ---------------------------------------------------------------------------
+
+
+def test_tf_image_transformer_vector_vs_numpy_oracle(image_df):
+    from sparkdl_tpu.transformers.tf_image import TFImageTransformer
+
+    fn = XlaFunction.from_callable(
+        lambda x: jnp.mean(x, axis=(1, 2)), name="chan_mean"
+    )
+    t = TFImageTransformer(
+        inputCol="image",
+        outputCol="out",
+        graph=fn,
+        inputShape=(64, 64),
+        channelOrder="RGB",
+        batchSize=4,
+    )
+    result = t.transform(image_df)
+    got = {r["filePath"]: np.asarray(r["out"]) for r in
+           result.select("filePath", "out").collect()}
+
+    # oracle: same decode -> same resize -> channel mean, plain jax on host
+    from sparkdl_tpu.transformers.utils import normalize_channels
+
+    rows = image_df.collect()
+    for row in rows:
+        arr = normalize_channels(
+            imageIO.imageStructToArray(row["image"]).astype(np.float32), 3
+        )
+        rgb = arr[:, :, ::-1]
+        resized = np.asarray(
+            jax.image.resize(
+                jnp.asarray(rgb)[None],
+                (1, 64, 64, rgb.shape[-1]),
+                "bilinear",
+            )
+        )[0]
+        want = resized.mean(axis=(0, 1))
+        np.testing.assert_allclose(
+            got[row["filePath"]], want, rtol=1e-4, atol=1e-3
+        )
+
+
+def test_tf_image_transformer_image_output_mode(image_df):
+    from sparkdl_tpu.transformers.tf_image import TFImageTransformer
+
+    fn = XlaFunction.from_callable(lambda x: x * 0.5, name="halve")
+    t = TFImageTransformer(
+        inputCol="image",
+        outputCol="out",
+        graph=fn,
+        inputShape=(32, 32),
+        outputMode="image",
+    )
+    # only 3-channel rows: drop the grayscale fixture
+    df = image_df.filter(lambda r: r["image"]["nChannels"] == 3)
+    out_rows = t.transform(df).collect()
+    assert out_rows
+    for r in out_rows:
+        struct = r["out"]
+        assert struct["height"] == 32 and struct["width"] == 32
+        arr = imageIO.imageStructToArray(struct)
+        assert arr.dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# DeepImageFeaturizer / DeepImagePredictor
+# ---------------------------------------------------------------------------
+
+
+def test_deep_image_featurizer_vs_keras_oracle(image_df, mobilenet_oracle):
+    from sparkdl_tpu.transformers.named_image import DeepImageFeaturizer
+
+    entry, km, variables = mobilenet_oracle
+    featurizer = DeepImageFeaturizer(
+        inputCol="image",
+        outputCol="features",
+        modelName="MobileNetV2",
+        modelWeights=variables,
+        computeDtype="float32",
+        batchSize=4,
+    )
+    result = featurizer.transform(image_df)
+    got = {r["filePath"]: np.asarray(r["features"]) for r in
+           result.select("filePath", "features").collect()}
+    assert all(v.shape == (entry.feature_size,) for v in got.values())
+
+    # oracle: same decode -> jax resize -> preprocess -> features cut
+    rows = image_df.collect()
+    h, w = entry.input_size
+    for row in rows:
+        arr = imageIO.imageStructToArray(row["image"]).astype(np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.shape[-1] == 1:
+            arr = np.repeat(arr, 3, axis=-1)
+        rgb = arr[:, :, ::-1]
+        resized = np.asarray(
+            jax.image.resize(jnp.asarray(rgb)[None], (1, h, w, 3), "bilinear")
+        )
+        pre = np.asarray(entry.preprocess(jnp.asarray(resized)))
+        fm = entry.make_module()
+        want = np.asarray(
+            jax.jit(lambda v, a: fm.apply(v, a, features_only=True))(
+                variables, jnp.asarray(pre)
+            )
+        )[0]
+        np.testing.assert_allclose(
+            got[row["filePath"]], want, rtol=1e-3, atol=1e-3
+        )
+
+
+def test_deep_image_predictor_decoded(image_df, mobilenet_oracle):
+    from sparkdl_tpu.transformers.named_image import DeepImagePredictor
+
+    entry, km, variables = mobilenet_oracle
+    predictor = DeepImagePredictor(
+        inputCol="image",
+        outputCol="preds",
+        modelName="MobileNetV2",
+        modelWeights=variables,
+        decodePredictions=True,
+        topK=3,
+        computeDtype="float32",
+    )
+    rows = predictor.transform(image_df).collect()
+    for r in rows:
+        preds = r["preds"]
+        assert len(preds) == 3
+        probs = [p["probability"] for p in preds]
+        assert probs == sorted(probs, reverse=True)
+        assert all(0.0 <= p <= 1.0 for p in probs)
+
+
+def test_named_transformer_rejects_unknown_model():
+    from sparkdl_tpu.transformers.named_image import DeepImageFeaturizer
+
+    with pytest.raises(ValueError, match="Unsupported model name"):
+        DeepImageFeaturizer(
+            inputCol="image", outputCol="f", modelName="NopeNet"
+        )._build_forward()
+
+
+# ---------------------------------------------------------------------------
+# TFTransformer / KerasTransformer (tensor columns)
+# ---------------------------------------------------------------------------
+
+
+def test_tf_transformer_mappings(tpu_session):
+    from sparkdl_tpu.transformers.tf_tensor import TFTransformer
+
+    rng = np.random.RandomState(0)
+    vecs = [rng.rand(8).astype(np.float32) for _ in range(11)]
+    df = tpu_session.createDataFrame([{"x": v} for v in vecs])
+
+    fn = XlaFunction.from_callable(
+        lambda x: (x * 2.0, jnp.sum(x, axis=-1)),
+        input_names=("inp",),
+        output_names=("doubled", "total"),
+        name="double_sum",
+    )
+    t = TFTransformer(
+        tfInputGraph=fn,
+        inputMapping={"x": "inp"},
+        outputMapping={"doubled": "x2", "total": "sum"},
+        batchSize=4,
+    )
+    rows = t.transform(df).collect()
+    for row, v in zip(rows, vecs):
+        np.testing.assert_allclose(row["x2"], v * 2, rtol=1e-6)
+        np.testing.assert_allclose(row["sum"], v.sum(), rtol=1e-5)
+
+
+def test_tf_transformer_bad_mapping(tpu_session):
+    from sparkdl_tpu.transformers.tf_tensor import TFTransformer
+
+    df = tpu_session.createDataFrame([{"x": np.zeros(3, np.float32)}])
+    fn = XlaFunction.from_callable(lambda x: x, name="id")
+    with pytest.raises(ValueError, match="Unknown function outputs"):
+        TFTransformer(
+            tfInputGraph=fn,
+            inputMapping={"x": "input"},
+            outputMapping={"nope": "y"},
+        ).transform(df)
+
+
+def test_keras_transformer_vs_keras_oracle(tpu_session, tmp_path):
+    from sparkdl_tpu.transformers.keras_tensor import KerasTransformer
+
+    model = keras.Sequential(
+        [
+            keras.layers.Input(shape=(10,)),
+            keras.layers.Dense(7, activation="relu"),
+            keras.layers.Dense(3),
+        ]
+    )
+    path = str(tmp_path / "model.keras")
+    model.save(path)
+
+    rng = np.random.RandomState(1)
+    vecs = [rng.rand(10).astype(np.float32) for _ in range(9)]
+    df = tpu_session.createDataFrame([{"x": v} for v in vecs])
+    t = KerasTransformer(inputCol="x", outputCol="y", modelFile=path,
+                         batchSize=4)
+    rows = t.transform(df).collect()
+    want = np.asarray(model(np.stack(vecs)))
+    got = np.stack([np.asarray(r["y"]) for r in rows])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_keras_image_file_transformer(tpu_session, image_dir, tmp_path):
+    from sparkdl_tpu.transformers.keras_image import KerasImageFileTransformer
+    from PIL import Image
+
+    model = keras.Sequential(
+        [
+            keras.layers.Input(shape=(16, 16, 3)),
+            keras.layers.Flatten(),
+            keras.layers.Dense(4),
+        ]
+    )
+    path = str(tmp_path / "img_model.keras")
+    model.save(path)
+
+    def loader(uri):
+        img = Image.open(uri).convert("RGB").resize((16, 16))
+        return np.asarray(img, dtype=np.float32) / 255.0
+
+    df = imageIO.filesToDF(tpu_session, image_dir, numPartitions=2)
+    t = KerasImageFileTransformer(
+        inputCol="filePath",
+        outputCol="out",
+        modelFile=path,
+        imageLoader=loader,
+        batchSize=4,
+    )
+    rows = t.transform(df).select("filePath", "out").collect()
+    for r in rows:
+        want = np.asarray(model(loader(r["filePath"])[None]))[0]
+        np.testing.assert_allclose(
+            np.asarray(r["out"]), want, rtol=1e-4, atol=1e-5
+        )
+
+
+# ---------------------------------------------------------------------------
+# LogisticRegression head + flagship pipeline slice
+# ---------------------------------------------------------------------------
+
+
+def test_logistic_regression_separable(tpu_session):
+    rng = np.random.RandomState(0)
+    x0 = rng.randn(30, 4).astype(np.float32) + 3
+    x1 = rng.randn(30, 4).astype(np.float32) - 3
+    data = [{"features": v, "label": 0} for v in x0] + [
+        {"features": v, "label": 1} for v in x1
+    ]
+    df = tpu_session.createDataFrame(data).repartition(3)
+    lr = LogisticRegression(maxIter=200, stepSize=0.5)
+    model = lr.fit(df)
+    pred = model.transform(df)
+    acc = MulticlassClassificationEvaluator(metricName="accuracy").evaluate(pred)
+    assert acc == 1.0
+    f1 = MulticlassClassificationEvaluator(metricName="f1").evaluate(pred)
+    assert f1 == 1.0
+
+
+def test_flagship_pipeline_featurizer_plus_lr(image_df, mobilenet_oracle):
+    """The minimum end-to-end slice (SURVEY.md §7 step 4): DeepImageFeaturizer
+    -> LogisticRegression as a Pipeline, mirroring the reference's tf-flowers
+    transfer-learning flow."""
+    from sparkdl_tpu.transformers.named_image import DeepImageFeaturizer
+
+    entry, km, variables = mobilenet_oracle
+    labeled = image_df.withColumn(
+        "label", lambda p: hash(p) % 2, "filePath"
+    )
+    pipeline = Pipeline(
+        stages=[
+            DeepImageFeaturizer(
+                inputCol="image",
+                outputCol="features",
+                modelName="MobileNetV2",
+                modelWeights=variables,
+                computeDtype="float32",
+            ),
+            LogisticRegression(maxIter=100, stepSize=0.5),
+        ]
+    )
+    model = pipeline.fit(labeled)
+    scored = model.transform(labeled)
+    assert "prediction" in scored.columns and "features" in scored.columns
+    # plumbing correctness, not learning quality (random-noise fixtures give
+    # near-identical GAP features — the reference's estimator tests assert
+    # plumbing the same way, SURVEY.md §4)
+    preds = {r["prediction"] for r in scored.collect()}
+    assert preds <= {0.0, 1.0}
+    acc = MulticlassClassificationEvaluator().evaluate(scored)
+    assert 0.0 <= acc <= 1.0
