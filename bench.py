@@ -1,0 +1,99 @@
+"""Headline benchmark: DeepImageFeaturizer (InceptionV3) images/sec/chip.
+
+Measures sustained on-chip throughput of the flagship featurizer's fused
+device program (uint8 decode -> BGR flip -> preprocess -> InceptionV3 ->
+2048-d features, bf16 compute) — the hot loop of the reference's
+``DeepImageFeaturizer.transform`` (SURVEY.md §3.1) rebuilt for TPU.
+
+Methodology: K model applications run inside one jitted ``lax.scan`` over
+distinct pre-staged batches, returning a scalar reduction fetched to host.
+This amortizes the PJRT-tunnel round trip (~200ms through the loopback
+relay, which also acks dispatch before completion — ``block_until_ready``
+alone under-measures) and forces real execution of every batch.
+
+Baseline (``BASELINE.md``): the reference publishes no numbers; the
+driver-defined target is ">= V100 images/sec/chip".  ``V100_IMAGES_PER_SEC``
+uses 1000 img/s — the commonly cited TF-fp32 InceptionV3 V100 batch-inference
+figure — so ``vs_baseline = measured / 1000``.
+
+Prints exactly one JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+V100_IMAGES_PER_SEC = 1000.0
+BATCH = 512
+SCAN_LEN = 4
+REPEATS = 3
+
+
+def main():
+    from sparkdl_tpu.models import get_keras_application_model
+
+    entry = get_keras_application_model("InceptionV3")
+    module = entry.make_module(dtype=jnp.bfloat16)
+    shapes = jax.eval_shape(
+        module.init, jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3),
+                                                      jnp.float32)
+    )
+    # deterministic nonzero weights; values don't change the FLOP rate
+    variables = jax.tree_util.tree_map(
+        lambda l: jnp.full(l.shape, 0.01, l.dtype), shapes
+    )
+    device = jax.devices()[0]
+    variables = jax.device_put(variables, device)
+
+    rng = np.random.RandomState(0)
+    stack = jax.device_put(
+        jnp.asarray(
+            (rng.rand(SCAN_LEN, BATCH, 299, 299, 3) * 255).astype(np.uint8)
+        ),
+        device,
+    )
+
+    def forward(v, x):
+        x = x[..., ::-1]  # stored BGR -> RGB
+        x = entry.preprocess(x.astype(jnp.bfloat16))
+        return module.apply(
+            v, x.astype(jnp.bfloat16), features_only=True
+        ).astype(jnp.float32)
+
+    @jax.jit
+    def run_many(v, stack):
+        def body(carry, xb):
+            return carry + forward(v, xb).sum(), None
+
+        acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), stack)
+        return acc
+
+    np.asarray(run_many(variables, stack))  # compile + warm
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        np.asarray(run_many(variables, stack))  # host fetch forces completion
+        times.append(time.perf_counter() - t0)
+
+    images_per_sec = SCAN_LEN * BATCH / min(times)
+    print(
+        json.dumps(
+            {
+                "metric": "DeepImageFeaturizer(InceptionV3) bf16 batch "
+                "inference throughput",
+                "value": round(images_per_sec, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(images_per_sec / V100_IMAGES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,7 @@ N x 1024..4096 — so one jitted ``fori``-style loop beats a sharded pipeline).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import numpy as np
@@ -68,6 +69,34 @@ class LogisticRegressionModel(Model):
             return out
 
         return dataset.mapPartitions(process_partition)
+
+
+@functools.lru_cache(maxsize=32)
+def _training_program(max_iter: int, reg: float, lr: float):
+    """Jitted full-batch training loop, cached per hyperparameter point —
+    data rides as arguments, so CrossValidator folds with matching shapes
+    genuinely share one compiled XLA program."""
+    tx = optax.adam(lr)
+
+    def loss_fn(p, xb, yb):
+        logits = xb @ p["w"] + p["b"]
+        nll = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb
+        ).mean()
+        return nll + reg * (p["w"] ** 2).sum()
+
+    @jax.jit
+    def train(p, s, xb, yb):
+        def step(carry, _):
+            p, s = carry
+            grads = jax.grad(loss_fn)(p, xb, yb)
+            updates, s = tx.update(grads, s, p)
+            return (optax.apply_updates(p, updates), s), None
+
+        (p, s), _ = jax.lax.scan(step, (p, s), None, length=max_iter)
+        return p
+
+    return train, tx
 
 
 class LogisticRegression(Estimator, HasInputCol, HasLabelCol):
@@ -137,12 +166,16 @@ class LogisticRegression(Estimator, HasInputCol, HasLabelCol):
         features_col = self.getOrDefault(self.featuresCol)
         label_col = self.getOrDefault(self.labelCol)
         rows = dataset.select(features_col, label_col).collect()
+        if not rows:
+            raise ValueError(
+                "LogisticRegression.fit received an empty dataset"
+            )
         x = np.stack(
             [np.asarray(r[features_col], dtype=np.float32) for r in rows]
         )
         y = np.asarray([int(r[label_col]) for r in rows], dtype=np.int32)
         n, d = x.shape
-        k = int(y.max()) + 1 if len(y) else 2
+        k = int(y.max()) + 1
         max_iter = self.getOrDefault(self.maxIter)
         reg = self.getOrDefault(self.regParam)
         lr = self.getOrDefault(self.stepSize)
@@ -151,30 +184,10 @@ class LogisticRegression(Estimator, HasInputCol, HasLabelCol):
             "w": jnp.zeros((d, k), jnp.float32),
             "b": jnp.zeros((k,), jnp.float32),
         }
-        tx = optax.adam(lr)
-        opt_state = tx.init(params)
-
-        def loss_fn(p, xb, yb):
-            logits = xb @ p["w"] + p["b"]
-            nll = optax.softmax_cross_entropy_with_integer_labels(
-                logits, yb
-            ).mean()
-            return nll + reg * (p["w"] ** 2).sum()
-
-        # data rides as arguments (not closed-over constants) so the compiled
-        # program is dataset-independent and CrossValidator folds reuse it
-        @jax.jit
-        def train(p, s, xb, yb):
-            def step(carry, _):
-                p, s = carry
-                grads = jax.grad(loss_fn)(p, xb, yb)
-                updates, s = tx.update(grads, s, p)
-                return (optax.apply_updates(p, updates), s), None
-
-            (p, s), _ = jax.lax.scan(step, (p, s), None, length=max_iter)
-            return p
-
-        params = train(params, opt_state, jnp.asarray(x), jnp.asarray(y))
+        train, tx = _training_program(max_iter, reg, lr)
+        params = train(
+            params, tx.init(params), jnp.asarray(x), jnp.asarray(y)
+        )
         return self._copyValues(
             LogisticRegressionModel(
                 np.asarray(params["w"]),

@@ -1,0 +1,17 @@
+"""Distributed execution: device meshes, DP/TP sharding, collective training.
+
+The reference has no in-repo distributed-training backend (SURVEY.md §2
+"Parallelism strategies": training is driver-local Keras; NCCL/MPI/Horovod
+appear nowhere).  This package supplies what the north star asks for instead:
+``jax.sharding.Mesh`` + ``shard_map`` data parallelism with ``lax.pmean``
+gradient allreduce over ICI — the NCCL-allreduce analog — and the control
+plane via ``jax.distributed`` for multi-host.
+"""
+
+from sparkdl_tpu.parallel.trainer import (  # noqa: F401
+    TrainState,
+    init_train_state,
+    make_mesh,
+    make_train_step,
+    shard_batch,
+)

@@ -1,0 +1,143 @@
+"""Mesh construction + data-parallel training step.
+
+Replaces the reference's driver-local ``keras model.fit`` hot loop
+(``keras_image_file_estimator.py``† — SURVEY.md §3.2: "training never leaves
+the driver") with the TPU-native design: the batch is sharded over the
+``data`` mesh axis, each device computes grads on its shard under
+``shard_map``, and ``lax.pmean`` allreduces them over ICI before the optax
+update.  Multi-host runs reuse the same step — ``jax.distributed`` initializes
+the global mesh and per-host data loading feeds each host's addressable
+shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import optax
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = ("data",),
+    axis_shape: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a device mesh.  Default: all local devices on one ``data`` axis
+    (pure DP).  For DP x TP pass e.g. ``axis_names=("data", "model"),
+    axis_shape=(2, 4)``."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    if axis_shape is None:
+        axis_shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    return Mesh(
+        np.asarray(devices).reshape(tuple(axis_shape)),
+        axis_names=tuple(axis_names),
+    )
+
+
+@dataclass
+class TrainState:
+    """Carries everything a training step mutates (flax/optax convention)."""
+
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+    batch_stats: Any = None
+
+    def tree_flatten(self):  # pragma: no cover - registered below
+        return (
+            (self.params, self.opt_state, self.step, self.batch_stats),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):  # pragma: no cover
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.params, s.opt_state, s.step, s.batch_stats), None),
+    lambda aux, c: TrainState(*c),
+)
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Place a host batch onto the mesh sharded along its leading dim."""
+    spec = P(axis)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P(*([axis] + [None] * (x.ndim - 1))))
+        ),
+        batch,
+    )
+
+
+def make_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    data_axis: str = "data",
+    donate: bool = True,
+):
+    """Build the jitted DP training step.
+
+    ``loss_fn(params, batch) -> scalar loss`` computes the *per-shard* loss;
+    the step averages gradients across the ``data`` axis with ``lax.pmean``
+    (the NCCL-allreduce analog, riding ICI) and applies the optax update
+    identically on every device, keeping params replicated.
+    """
+
+    n_shards = int(mesh.shape[data_axis])
+
+    def step(state: TrainState, batch):
+        def sharded_grads(params, local_batch):
+            # params enter replicated (in_spec P()), so shard_map's AD
+            # transposes the implicit broadcast into a psum over the data
+            # axis: ``grads`` already carries the cross-device allreduce
+            # (the NCCL-allreduce analog, riding ICI).  Dividing by the
+            # shard count turns the summed per-shard mean-loss grads into
+            # the global-mean gradient.  (Do NOT add lax.pmean here — that
+            # is the pmap-era pattern and double-counts by n_shards.)
+            loss, grads = jax.value_and_grad(loss_fn)(params, local_batch)
+            grads = jax.tree_util.tree_map(lambda g: g / n_shards, grads)
+            loss = jax.lax.pmean(loss, axis_name=data_axis)
+            return loss, grads
+
+        batch_spec = jax.tree_util.tree_map(
+            lambda x: P(*([data_axis] + [None] * (x.ndim - 1))), batch
+        )
+        loss, grads = shard_map(
+            sharded_grads,
+            mesh=mesh,
+            in_specs=(P(), batch_spec),
+            out_specs=(P(), P()),
+        )(state.params, batch)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params, opt_state, state.step + 1, state.batch_stats),
+            loss,
+        )
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def init_train_state(params, tx: optax.GradientTransformation) -> TrainState:
+    return TrainState(
+        params=params,
+        opt_state=tx.init(params),
+        step=jnp.zeros((), jnp.int32),
+    )

@@ -16,7 +16,6 @@ import numpy as np
 
 import jax
 
-from sparkdl_tpu.graph.function import XlaFunction
 from sparkdl_tpu.ml.base import Transformer
 from sparkdl_tpu.ml.linalg import DenseVector
 from sparkdl_tpu.param.base import Param, TypeConverters, keyword_only
@@ -29,6 +28,7 @@ from sparkdl_tpu.param.shared import (
 )
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
+    load_keras_function,
     place_params,
     run_batched,
 )
@@ -78,9 +78,12 @@ class KerasImageFileTransformer(
         mode = self.getOutputMode()
         batch_size = self.getOrDefault(self.batchSize)
 
-        fn = XlaFunction.from_keras(self.getModelFile())
+        fn = load_keras_function(self.getModelFile())
         params = place_params(fn.params)
-        jitted = jax.jit(lambda x: fn.apply(params, x)[0])
+        inner = fn._jitted()  # per-instance jit cache -> compile once
+
+        def jitted(x):
+            return inner(params, x)[0]
 
         def process_partition(part):
             uris = part[input_col]

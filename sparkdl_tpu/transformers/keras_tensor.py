@@ -10,12 +10,14 @@ from __future__ import annotations
 
 from typing import Optional
 
-from sparkdl_tpu.graph.function import XlaFunction
 from sparkdl_tpu.ml.base import Transformer
 from sparkdl_tpu.param.base import Param, TypeConverters, keyword_only
 from sparkdl_tpu.param.shared import HasInputCol, HasKerasModel, HasOutputCol
 from sparkdl_tpu.transformers.tf_tensor import TFTransformer
-from sparkdl_tpu.transformers.utils import DEFAULT_BATCH_SIZE
+from sparkdl_tpu.transformers.utils import (
+    DEFAULT_BATCH_SIZE,
+    load_keras_function,
+)
 
 
 class KerasTransformer(Transformer, HasInputCol, HasOutputCol, HasKerasModel):
@@ -48,7 +50,7 @@ class KerasTransformer(Transformer, HasInputCol, HasOutputCol, HasKerasModel):
         return self._set(**kwargs)
 
     def _transform(self, dataset):
-        fn = XlaFunction.from_keras(self.getModelFile())
+        fn = load_keras_function(self.getModelFile())
         delegate = TFTransformer(
             tfInputGraph=fn,
             inputMapping={self.getInputCol(): fn.input_names[0]},

@@ -44,6 +44,10 @@ logger = logging.getLogger(__name__)
 # modelName -> variables pytree, shared across transformer instances.
 _VARIABLES_CACHE: Dict[str, Any] = {}
 
+# id(keras model) -> (model, ported variables); the strong model ref keeps
+# the id stable.
+_PORTED_CACHE: Dict[int, Tuple[Any, Any]] = {}
+
 # (modelName, dtype, featurize, id(variables)) -> jitted forward.  Keeps the
 # XLA executable alive across _transform calls (fit → score → new stages), so
 # the CNN compiles once per process instead of once per transform.
@@ -103,8 +107,13 @@ def _resolve_variables(model_name: str, spec) -> Any:
         return variables
     if isinstance(spec, dict):  # Flax variables pytree
         return spec
-    # assume a built Keras model
-    return entry.load_variables(spec)
+    # A built Keras model: port once per model object so repeated
+    # _build_forward calls (fit -> transform, CV folds) reuse the same
+    # pytree — and therefore the same _FORWARD_CACHE entry / XLA program.
+    key = id(spec)
+    if key not in _PORTED_CACHE or _PORTED_CACHE[key][0] is not spec:
+        _PORTED_CACHE[key] = (spec, entry.load_variables(spec))
+    return _PORTED_CACHE[key][1]
 
 
 class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):

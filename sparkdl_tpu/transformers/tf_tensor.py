@@ -84,12 +84,26 @@ class TFTransformer(Transformer):
         if unknown_out:
             raise ValueError(f"Unknown function outputs: {sorted(unknown_out)}")
 
-        # column order aligned to the function's positional inputs
+        # column order aligned to the function's positional inputs; the
+        # mapping must cover every input exactly once
         col_for_input = {v: k for k, v in input_mapping.items()}
+        if len(col_for_input) != len(input_mapping):
+            raise ValueError(
+                "inputMapping maps multiple columns to the same function "
+                f"input: {input_mapping}"
+            )
+        missing = set(fn.input_names) - set(col_for_input)
+        if missing:
+            raise ValueError(
+                f"inputMapping does not cover function inputs {sorted(missing)}"
+            )
         ordered_cols = [col_for_input[name] for name in fn.input_names]
 
         params = place_params(fn.params)
-        jitted = jax.jit(lambda *xs: fn.apply(params, *xs))
+        inner = fn._jitted()  # per-instance jit cache -> compile once
+
+        def jitted(*xs):
+            return inner(params, *xs)
 
         def process_partition(part):
             out = dict(part)

@@ -173,3 +173,20 @@ def place_params(params, device=None):
     """Pin a params pytree to the accelerator once per transform."""
     device = device or jax.devices()[0]
     return jax.device_put(params, device)
+
+
+_KERAS_FN_CACHE: Dict[Tuple[str, float], Any] = {}
+
+
+def load_keras_function(path: str):
+    """``XlaFunction.from_keras`` cached per (path, mtime): repeated
+    transforms of the same saved model reuse one XlaFunction instance — and
+    therefore its per-instance jit cache / compiled XLA program."""
+    import os
+
+    from sparkdl_tpu.graph.function import XlaFunction
+
+    key = (os.path.abspath(path), os.path.getmtime(path))
+    if key not in _KERAS_FN_CACHE:
+        _KERAS_FN_CACHE[key] = XlaFunction.from_keras(path)
+    return _KERAS_FN_CACHE[key]

@@ -1,0 +1,114 @@
+"""Distributed trainer tests on the virtual 8-device CPU mesh.
+
+What the reference never had (SURVEY.md §4 "Implication"): real multi-device
+DP tests — the mesh here is the 8-way CPU platform from conftest, exercising
+the same shard_map + lax.pmean path that runs over ICI on a pod.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from flax import linen as nn
+
+from sparkdl_tpu.parallel import (
+    init_train_state,
+    make_mesh,
+    make_train_step,
+    shard_batch,
+)
+
+
+class TinyNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        return nn.Dense(4)(x)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+    return make_mesh()
+
+
+def test_dp_training_decreases_loss(mesh):
+    module = TinyNet()
+    rng = np.random.RandomState(0)
+    params = module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+
+    def loss_fn(params, batch):
+        logits = module.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+
+    tx = optax.adam(1e-2)
+    state = init_train_state(params, tx)
+    step = make_train_step(loss_fn, tx, mesh)
+
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    batch = shard_batch({"x": jnp.asarray(x), "y": jnp.asarray(y)}, mesh)
+
+    losses = []
+    for _ in range(30):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+    assert int(state.step) == 30
+
+
+def test_dp_grads_match_single_device(mesh):
+    """DP over 8 shards must equal full-batch single-device gradients —
+    the correctness invariant of pmean-allreduce data parallelism."""
+    module = TinyNet()
+    params = module.init(jax.random.PRNGKey(1), jnp.zeros((1, 8)))
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = rng.randint(0, 4, size=(32,)).astype(np.int32)
+
+    def loss_fn(p, batch):
+        logits = module.apply(p, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+
+    tx = optax.sgd(0.1)
+    # one DP step
+    state = init_train_state(jax.tree_util.tree_map(jnp.copy, params), tx)
+    step = make_train_step(loss_fn, tx, mesh, donate=False)
+    batch = shard_batch({"x": jnp.asarray(x), "y": jnp.asarray(y)}, mesh)
+    dp_state, dp_loss = step(state, batch)
+
+    # single-device oracle
+    loss, grads = jax.value_and_grad(loss_fn)(
+        params, {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+    )
+    updates, _ = tx.update(grads, tx.init(params), params)
+    want = optax.apply_updates(params, updates)
+
+    np.testing.assert_allclose(float(dp_loss), float(loss), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(dp_state.params),
+        jax.tree_util.tree_leaves(want),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=1e-6)
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as graft
+
+    graft.dryrun_multichip(8)
+
+
+def test_graft_entry_lowers():
+    import __graft_entry__ as graft
+
+    fn, args = graft.entry()
+    lowered = jax.jit(fn).lower(*args)  # lowering succeeded; full compile
+    assert lowered.out_info is not None  # is the driver's job
