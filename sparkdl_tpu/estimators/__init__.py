@@ -1,0 +1,12 @@
+"""Estimators — trainable pipeline stages.
+
+Reference analog: ``python/sparkdl/estimators/``† (SURVEY.md §2, §3.2) — one
+estimator, ``KerasImageFileEstimator``.  The structural difference is the
+point of the whole build: the reference trains driver-local (``model.fit`` on
+collected numpy), this package trains data-parallel over a TPU mesh via
+``sparkdl_tpu.parallel``.
+"""
+
+from sparkdl_tpu.estimators.keras_image_file_estimator import (  # noqa: F401
+    KerasImageFileEstimator,
+)

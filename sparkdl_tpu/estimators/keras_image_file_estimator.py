@@ -1,0 +1,253 @@
+"""KerasImageFileEstimator — fine-tune a saved Keras model over image files.
+
+Reference analog: ``python/sparkdl/estimators/keras_image_file_estimator.py``†
+(SURVEY.md §2, §3.2).  Same param surface (``imageLoader`` / ``modelFile`` /
+``kerasOptimizer`` / ``kerasLoss`` / ``kerasFitParams``) and the same outer
+flow — collect (URI, label) rows, load/preprocess images via the user's
+``imageLoader``, train, return a fitted :class:`KerasImageFileTransformer` —
+but the training core is rebuilt TPU-first:
+
+- the reference ran ``keras model.fit`` **driver-local** ("training never
+  leaves the driver", §3.2) — here every step is a jitted data-parallel
+  shard_map program over the device mesh with ICI gradient allreduce
+  (:mod:`sparkdl_tpu.parallel.keras_train`);
+- mid-training checkpoint/resume (orbax) replaces the reference's
+  nothing-at-all (its only persistence was the final ``.h5``);
+- ``fitMultiple`` (inherited) still yields one model per param map for
+  CrossValidator grids, matching ``_fitInParallel``†.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.estimators.losses import get_loss_fn, get_optimizer
+from sparkdl_tpu.ml.base import Estimator
+from sparkdl_tpu.param.base import Param, keyword_only
+from sparkdl_tpu.param.shared import (
+    CanLoadImage,
+    HasInputCol,
+    HasKerasLoss,
+    HasKerasModel,
+    HasKerasOptimizer,
+    HasLabelCol,
+    HasOutputCol,
+)
+from sparkdl_tpu.parallel.keras_train import (
+    KerasTrainState,
+    init_keras_train_state,
+    make_keras_train_step,
+)
+from sparkdl_tpu.parallel.trainer import make_mesh, shard_batch
+from sparkdl_tpu.transformers.keras_image import KerasImageFileTransformer
+
+logger = logging.getLogger(__name__)
+
+
+class KerasImageFileEstimator(
+    Estimator,
+    HasInputCol,
+    HasOutputCol,
+    HasLabelCol,
+    CanLoadImage,
+    HasKerasModel,
+    HasKerasOptimizer,
+    HasKerasLoss,
+):
+    checkpointDir = Param(
+        "undefined",
+        "checkpointDir",
+        "orbax checkpoint directory for mid-training save/resume "
+        "(None disables checkpointing)",
+    )
+
+    @keyword_only
+    def __init__(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        labelCol: Optional[str] = None,
+        imageLoader=None,
+        modelFile: Optional[str] = None,
+        kerasOptimizer: str = "adam",
+        kerasLoss: Optional[str] = None,
+        kerasFitParams: Optional[Dict[str, Any]] = None,
+        checkpointDir: Optional[str] = None,
+    ):
+        super().__init__()
+        self._setDefault(
+            kerasOptimizer="adam",
+            kerasFitParams={"epochs": 1, "batch_size": 32},
+            checkpointDir=None,
+        )
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        labelCol: Optional[str] = None,
+        imageLoader=None,
+        modelFile: Optional[str] = None,
+        kerasOptimizer: str = "adam",
+        kerasLoss: Optional[str] = None,
+        kerasFitParams: Optional[Dict[str, Any]] = None,
+        checkpointDir: Optional[str] = None,
+    ):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    # ------------------------------------------------------------------
+    def _validateParams(self):
+        for p in (self.inputCol, self.labelCol, self.imageLoader,
+                  self.modelFile, self.kerasLoss, self.outputCol):
+            if not self.isDefined(p):
+                raise ValueError(f"Required param not set: {p.name}")
+        return True
+
+    def _getNumpyFeaturesAndLabels(self, dataset):
+        """Collect (URI, label) rows to the host and load images via the
+        user ``imageLoader`` (reference ``_getNumpyFeaturesAndLabels``†; IO
+        parallelized with a thread pool)."""
+        input_col = self.getInputCol()
+        label_col = self.getLabelCol()
+        rows = dataset.select(input_col, label_col).collect()
+        if not rows:
+            raise ValueError("fit() received an empty dataset")
+        loader = self.getImageLoader()
+        uris = [r[input_col] for r in rows]
+        with ThreadPoolExecutor(max_workers=16) as pool:
+            images = list(pool.map(
+                lambda u: np.asarray(loader(u), dtype=np.float32), uris
+            ))
+        x = np.stack(images)
+        labels = [r[label_col] for r in rows]
+        first = np.asarray(labels[0])
+        if first.ndim == 0:
+            y = np.asarray(labels, dtype=np.int32)
+        else:
+            y = np.stack([np.asarray(l, dtype=np.float32) for l in labels])
+        return x, y
+
+    # ------------------------------------------------------------------
+    def _fit(self, dataset):
+        self._validateParams()
+        import keras
+
+        x, y = self._getNumpyFeaturesAndLabels(dataset)
+        fit_params = dict(self.getKerasFitParams() or {})
+        epochs = int(fit_params.get("epochs", 1))
+        batch_size = int(fit_params.get("batch_size", 32))
+        learning_rate = fit_params.get("learning_rate")
+        seed = int(fit_params.get("seed", 0))
+
+        model = keras.saving.load_model(self.getModelFile(), compile=False)
+        loss_fn = get_loss_fn(self.getKerasLoss())
+        tx = get_optimizer(self.getKerasOptimizer(), learning_rate)
+
+        mesh = make_mesh()
+        n_dev = int(mesh.devices.size)
+        # global batch must split evenly across the mesh
+        batch_size = max(batch_size - batch_size % n_dev, n_dev)
+
+        state = init_keras_train_state(model, tx)
+        step_fn = make_keras_train_step(model, loss_fn, tx, mesh)
+
+        ckpt_dir = self.getOrDefault(self.checkpointDir)
+        start_epoch, state = self._maybe_restore(ckpt_dir, state)
+
+        n = x.shape[0]
+        rng = np.random.RandomState(seed)
+        last_loss = None
+        for epoch in range(start_epoch, epochs):
+            order = rng.permutation(n)
+            for lo in range(0, n, batch_size):
+                idx = order[lo : lo + batch_size]
+                if len(idx) < batch_size:  # wrap-around pad for even shards
+                    idx = np.concatenate([idx, order[: batch_size - len(idx)]])
+                batch = shard_batch(
+                    {"x": jnp.asarray(x[idx]), "y": jnp.asarray(y[idx])}, mesh
+                )
+                state, loss = step_fn(state, batch)
+            last_loss = float(loss)
+            logger.info("epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss)
+            if ckpt_dir:
+                self._save_checkpoint(ckpt_dir, epoch + 1, state)
+
+        # write tuned weights back into the Keras model and persist it
+        for var, val in zip(model.trainable_variables, state.trainable):
+            var.assign(np.asarray(val))
+        for var, val in zip(model.non_trainable_variables, state.non_trainable):
+            var.assign(np.asarray(val))
+        tuned_path = os.path.join(
+            tempfile.mkdtemp(prefix="sparkdl_tuned_"), "model.keras"
+        )
+        model.save(tuned_path)
+
+        transformer = KerasImageFileTransformer(
+            inputCol=self.getInputCol(),
+            outputCol=self.getOutputCol(),
+            modelFile=tuned_path,
+            imageLoader=self.getImageLoader(),
+        )
+        transformer._training_loss = last_loss
+        return transformer
+
+    # ------------------------------------------------------------------
+    # orbax checkpoint / resume (SURVEY.md §5.4 — absent in the reference)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _ckpt_payload(state: KerasTrainState):
+        return {
+            "trainable": list(state.trainable),
+            "non_trainable": list(state.non_trainable),
+            "opt_state": state.opt_state,
+            "step": state.step,
+        }
+
+    def _save_checkpoint(self, ckpt_dir: str, epoch: int, state):
+        import orbax.checkpoint as ocp
+
+        path = os.path.join(os.path.abspath(ckpt_dir), f"epoch_{epoch}")
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, self._ckpt_payload(state), force=True)
+
+    def _maybe_restore(self, ckpt_dir: Optional[str], state):
+        if not ckpt_dir:
+            return 0, state
+        root = os.path.abspath(ckpt_dir)
+        if not os.path.isdir(root):
+            return 0, state
+        epochs = sorted(
+            int(d.split("_")[1])
+            for d in os.listdir(root)
+            if d.startswith("epoch_") and d.split("_")[1].isdigit()
+        )
+        if not epochs:
+            return 0, state
+        latest = epochs[-1]
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(
+                os.path.join(root, f"epoch_{latest}"),
+                self._ckpt_payload(state),
+            )
+        logger.info("resuming from checkpoint epoch %d", latest)
+        return latest, KerasTrainState(
+            trainable=restored["trainable"],
+            non_trainable=restored["non_trainable"],
+            opt_state=restored["opt_state"],
+            step=restored["step"],
+        )

@@ -1,0 +1,108 @@
+"""Keras loss/optimizer names -> jax/optax implementations.
+
+Reference analog: the ``toKerasLoss`` / ``toKerasOptimizer`` converter
+surface (``param/converters.py``†) — there the names were passed to Keras
+``model.compile``; here they resolve to jnp loss callables (Keras
+``from_logits=False`` conventions: losses consume the model's *outputs*) and
+optax gradient transformations with Keras default learning rates.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax.numpy as jnp
+import optax
+
+_EPS = 1e-7
+
+
+def _clip(p):
+    return jnp.clip(p, _EPS, 1.0 - _EPS)
+
+
+def categorical_crossentropy(y_true, y_pred):
+    return -jnp.sum(y_true * jnp.log(_clip(y_pred)), axis=-1).mean()
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    y_true = y_true.astype(jnp.int32)
+    picked = jnp.take_along_axis(
+        _clip(y_pred), y_true[..., None], axis=-1
+    )[..., 0]
+    return -jnp.log(picked).mean()
+
+
+def binary_crossentropy(y_true, y_pred):
+    p = _clip(y_pred)
+    return -(
+        y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p)
+    ).mean()
+
+
+def mean_squared_error(y_true, y_pred):
+    return jnp.mean((y_pred - y_true) ** 2)
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+_LOSSES = {
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "binary_crossentropy": binary_crossentropy,
+    "mean_squared_error": mean_squared_error,
+    "mse": mean_squared_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mae": mean_absolute_error,
+}
+
+# Keras default learning rates per optimizer name.
+_DEFAULT_LR = {
+    "sgd": 0.01,
+    "adam": 0.001,
+    "adamw": 0.001,
+    "rmsprop": 0.001,
+    "adagrad": 0.001,
+    "nadam": 0.001,
+    "lamb": 0.001,
+    "lion": 1e-4,
+}
+
+_OPTIMIZERS = {
+    "sgd": optax.sgd,
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "rmsprop": optax.rmsprop,
+    "adagrad": optax.adagrad,
+    "nadam": optax.nadam,
+    "lamb": optax.lamb,
+    "lion": optax.lion,
+}
+
+
+def get_loss_fn(loss: Union[str, Callable]) -> Callable:
+    """``loss(y_true, y_pred) -> scalar`` from a Keras loss name or callable."""
+    if callable(loss):
+        return loss
+    name = loss.lower()
+    if name not in _LOSSES:
+        raise ValueError(f"Unknown loss {loss!r}; supported: {sorted(_LOSSES)}")
+    return _LOSSES[name]
+
+
+def get_optimizer(
+    optimizer, learning_rate: Optional[float] = None
+) -> optax.GradientTransformation:
+    """optax transformation from a Keras optimizer name (Keras-default lr
+    unless overridden) or a pre-built ``GradientTransformation``."""
+    if hasattr(optimizer, "init") and hasattr(optimizer, "update"):
+        return optimizer
+    name = str(optimizer).lower()
+    if name not in _OPTIMIZERS:
+        raise ValueError(
+            f"Unknown optimizer {optimizer!r}; supported: {sorted(_OPTIMIZERS)}"
+        )
+    lr = learning_rate if learning_rate is not None else _DEFAULT_LR[name]
+    return _OPTIMIZERS[name](lr)

@@ -1,0 +1,149 @@
+"""Hyperparameter tuning (pyspark.ml.tuning subset).
+
+Reference dependency: ``CrossValidator(parallelism=k)`` driving
+``KerasImageFileEstimator.fitMultiple`` is the reference's
+*hyperparameter-parallel training* strategy (SURVEY.md §2 "Parallelism
+strategies") — MLlib is external to the reference repo, so the API is
+re-implemented here with identical semantics: k-fold split, thread-pool
+parallel ``fitMultiple`` fan-out, metric averaging, best-model refit on the
+full dataset.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from sparkdl_tpu.ml.base import Estimator, Model
+from sparkdl_tpu.param.base import Param, TypeConverters, keyword_only
+
+
+class ParamGridBuilder:
+    """Builds a cartesian grid of param maps (pyspark-identical API)."""
+
+    def __init__(self):
+        self._param_grid: Dict[Param, List[Any]] = {}
+
+    def addGrid(self, param: Param, values: Sequence[Any]) -> "ParamGridBuilder":
+        self._param_grid[param] = list(values)
+        return self
+
+    def baseOn(self, *args) -> "ParamGridBuilder":
+        if len(args) == 1 and isinstance(args[0], dict):
+            args = tuple(args[0].items())
+        for param, value in args:
+            self.addGrid(param, [value])
+        return self
+
+    def build(self) -> List[Dict[Param, Any]]:
+        keys = list(self._param_grid.keys())
+        grids: List[Dict[Param, Any]] = [{}]
+        for key in keys:
+            grids = [
+                {**g, key: v} for g in grids for v in self._param_grid[key]
+            ]
+        return grids
+
+
+class CrossValidatorModel(Model):
+    def __init__(self, bestModel: Model, avgMetrics: List[float]):
+        super().__init__()
+        self.bestModel = bestModel
+        self.avgMetrics = list(avgMetrics)
+
+    def _transform(self, dataset):
+        return self.bestModel.transform(dataset)
+
+
+class CrossValidator(Estimator):
+    estimator = Param("undefined", "estimator", "estimator to cross-validate")
+    estimatorParamMaps = Param("undefined", "estimatorParamMaps", "param grid")
+    evaluator = Param("undefined", "evaluator", "metric evaluator")
+    numFolds = Param(
+        "undefined", "numFolds", "number of folds", TypeConverters.toInt
+    )
+    parallelism = Param(
+        "undefined", "parallelism", "number of threads for parallel fits",
+        TypeConverters.toInt,
+    )
+    seed = Param("undefined", "seed", "random seed")
+
+    @keyword_only
+    def __init__(
+        self,
+        estimator: Optional[Estimator] = None,
+        estimatorParamMaps: Optional[List[Dict[Param, Any]]] = None,
+        evaluator=None,
+        numFolds: int = 3,
+        parallelism: int = 1,
+        seed: Optional[int] = None,
+    ):
+        super().__init__()
+        self._setDefault(numFolds=3, parallelism=1, seed=None)
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(
+        self,
+        estimator: Optional[Estimator] = None,
+        estimatorParamMaps: Optional[List[Dict[Param, Any]]] = None,
+        evaluator=None,
+        numFolds: int = 3,
+        parallelism: int = 1,
+        seed: Optional[int] = None,
+    ):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    def getEstimator(self) -> Estimator:
+        return self.getOrDefault(self.estimator)
+
+    def getEstimatorParamMaps(self):
+        return self.getOrDefault(self.estimatorParamMaps)
+
+    def getEvaluator(self):
+        return self.getOrDefault(self.evaluator)
+
+    def _fit(self, dataset) -> CrossValidatorModel:
+        est = self.getEstimator()
+        param_maps = self.getEstimatorParamMaps()
+        evaluator = self.getEvaluator()
+        n_folds = self.getOrDefault(self.numFolds)
+        parallelism = max(1, self.getOrDefault(self.parallelism))
+        seed = self.getOrDefault(self.seed)
+
+        folds = dataset.randomSplit([1.0] * n_folds, seed=seed)
+        n_params = len(param_maps)
+        metrics = np.zeros((n_params,), dtype=np.float64)
+        lock = threading.Lock()
+
+        for fold_idx in range(n_folds):
+            validation = folds[fold_idx]
+            train = None
+            for j, f in enumerate(folds):
+                if j != fold_idx:
+                    train = f if train is None else train.union(f)
+
+            fit_iter = est.fitMultiple(train, param_maps)
+
+            def consume_one(_):
+                index, model = next(fit_iter)
+                metric = evaluator.evaluate(model.transform(validation))
+                with lock:
+                    metrics[index] += metric
+
+            with ThreadPoolExecutor(max_workers=parallelism) as pool:
+                list(pool.map(consume_one, range(n_params)))
+
+        metrics /= n_folds
+        best_index = (
+            int(np.argmax(metrics))
+            if evaluator.isLargerBetter()
+            else int(np.argmin(metrics))
+        )
+        best_model = est.fit(dataset, param_maps[best_index])
+        return CrossValidatorModel(best_model, metrics.tolist())

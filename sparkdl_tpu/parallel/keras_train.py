@@ -1,0 +1,102 @@
+"""Data-parallel training step for Keras-3 models (JAX backend).
+
+The estimator-side replacement for the reference's driver-local
+``keras model.fit`` hot loop (SURVEY.md §3.2): the model's
+``stateless_call`` is jax-traceable, so the whole update — forward,
+loss, backward, ICI gradient allreduce, optax update — runs as one jitted
+shard_map program over the ``data`` mesh axis.
+
+Non-trainable variables (BN moving stats etc.) are carried through the step:
+float stats are ``pmean``-averaged across shards (the standard non-sync-BN
+DP approximation); non-float state (RNG seeds, counters) advances identically
+on every shard and passes through.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import optax
+
+from sparkdl_tpu.parallel.trainer import Mesh
+
+
+class KerasTrainState(NamedTuple):
+    trainable: Sequence
+    non_trainable: Sequence
+    opt_state: optax.OptState
+    step: jnp.ndarray
+
+
+def init_keras_train_state(model, tx: optax.GradientTransformation):
+    trainable = [jnp.asarray(v.value) for v in model.trainable_variables]
+    non_trainable = [
+        jnp.asarray(v.value) for v in model.non_trainable_variables
+    ]
+    return KerasTrainState(
+        trainable=trainable,
+        non_trainable=non_trainable,
+        opt_state=tx.init(trainable),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_keras_train_step(
+    model,
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    data_axis: str = "data",
+):
+    """``step(state, batch) -> (state, loss)`` with ``batch = {"x": ...,
+    "y": ...}`` sharded along the ``data`` axis; params stay replicated."""
+    n_shards = int(mesh.shape[data_axis])
+
+    def step(state: KerasTrainState, batch):
+        def sharded(trainable, non_trainable, local_batch):
+            def local_loss(tr):
+                outputs, new_nt = model.stateless_call(
+                    tr, non_trainable, local_batch["x"], training=True
+                )
+                return loss_fn(local_batch["y"], outputs), new_nt
+
+            (loss, new_nt), grads = jax.value_and_grad(
+                local_loss, has_aux=True
+            )(trainable)
+            # replicated-param transpose already psum'd the grads over the
+            # data axis (see trainer.make_train_step); normalize to the mean
+            grads = jax.tree_util.tree_map(lambda g: g / n_shards, grads)
+            loss = jax.lax.pmean(loss, axis_name=data_axis)
+            # float stats (BN moving averages) averaged across shards;
+            # integer state (RNG counters) is shard-invariant already
+            new_nt = jax.tree_util.tree_map(
+                lambda v: jax.lax.pmean(v, axis_name=data_axis)
+                if jnp.issubdtype(v.dtype, jnp.floating)
+                else v,
+                new_nt,
+            )
+            return loss, new_nt, grads
+
+        batch_spec = jax.tree_util.tree_map(
+            lambda x: P(*([data_axis] + [None] * (x.ndim - 1))), batch
+        )
+        loss, new_nt, grads = jax.shard_map(
+            sharded,
+            mesh=mesh,
+            in_specs=(P(), P(), batch_spec),
+            out_specs=(P(), P(), P()),
+        )(list(state.trainable), list(state.non_trainable), batch)
+        updates, opt_state = tx.update(
+            grads, state.opt_state, list(state.trainable)
+        )
+        trainable = optax.apply_updates(list(state.trainable), updates)
+        return (
+            KerasTrainState(trainable, new_nt, opt_state, state.step + 1),
+            loss,
+        )
+
+    return jax.jit(step, donate_argnums=(0,))
