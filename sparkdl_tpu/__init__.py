@@ -39,6 +39,7 @@ _EXPORTS = {
     "KerasTransformer": "sparkdl_tpu.transformers.keras_tensor",
     "KerasImageFileEstimator": "sparkdl_tpu.estimators.keras_image_file_estimator",
     "registerKerasImageUDF": "sparkdl_tpu.udf.keras_image_model",
+    "makeGraphUDF": "sparkdl_tpu.graph.tensorframes_udf",
     "TPUSession": "sparkdl_tpu.sql.session",
 }
 

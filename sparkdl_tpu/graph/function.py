@@ -205,13 +205,23 @@ class XlaFunction:
             )
             return outputs
 
-        return cls(
+        fn = cls(
             apply_fn,
             params,
             ("input",),
             ("output",),
             name or model.name,
         )
+        # static NHWC spatial input size, when the model declares one —
+        # image-serving callers (udf.keras_image_model) use it to resize
+        inputs = getattr(model, "inputs", None)
+        shape = inputs[0].shape if inputs else None
+        fn.input_hw = (
+            (int(shape[1]), int(shape[2]))
+            if shape is not None and len(shape) == 4 and shape[1] and shape[2]
+            else None
+        )
+        return fn
 
     @classmethod
     def from_saved_model(

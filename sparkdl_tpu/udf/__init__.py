@@ -1,0 +1,9 @@
+"""Model-serving SQL UDFs (the reference's L4 layer — SURVEY.md §2, §3.3).
+
+``registerKerasImageUDF`` registers a Keras model as a named SQL UDF over an
+image-struct (or file-path) column; ``makeGraphUDF`` registers an arbitrary
+:class:`~sparkdl_tpu.graph.function.XlaFunction` over tensor columns.
+"""
+
+from sparkdl_tpu.udf.keras_image_model import registerKerasImageUDF  # noqa: F401
+from sparkdl_tpu.graph.tensorframes_udf import makeGraphUDF  # noqa: F401

@@ -1,0 +1,116 @@
+"""registerKerasImageUDF — serve a Keras model as a SQL UDF over images.
+
+Reference analog: ``python/sparkdl/udf/keras_image_model.py``†
+``registerKerasImageUDF(name, model_or_file, preprocessor)`` (SURVEY.md §3.3):
+the reference composed (optional file-loader UDF) → spImage-converter graph
+piece → frozen Keras GraphDef and registered it through TensorFrames.  Here
+the same pipeline — struct decode, channel-order fix, resize, model forward —
+runs as one vectorized engine UDF whose model math is a single jitted XLA
+program (resize + CNN fuse; params device-resident), batched through the same
+``run_batched`` hot loop as the pipeline transformers.
+
+Semantics:
+
+- without ``preprocessor``: the UDF consumes an image-struct column (Spark
+  ImageSchema layout, stored BGR).  Structs are decoded, grayscale/RGBA
+  normalized to 3 channels, flipped BGR→RGB, resized to the model's spatial
+  input size, and fed to the model as float32 in ``[0, 255]`` scale (exactly
+  what direct Keras on the decoded arrays would see — the oracle contract).
+- with ``preprocessor``: the UDF consumes a file-path column;
+  ``preprocessor(path) -> ndarray`` does all loading/preprocessing and its
+  output is fed to the model unchanged (the reference's file-loader mode).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from sparkdl_tpu.graph.function import XlaFunction
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.ml.linalg import DenseVector
+from sparkdl_tpu.sql.functions import UserDefinedFunction
+from sparkdl_tpu.transformers.utils import (
+    DEFAULT_BATCH_SIZE,
+    device_resize,
+    load_keras_function,
+    normalize_channels,
+    place_params,
+    run_batched,
+)
+
+
+def _resolve_model(model_or_file) -> XlaFunction:
+    if isinstance(model_or_file, (str, os.PathLike)):
+        # shared (abspath, mtime) cache: one XlaFunction (and one compiled
+        # XLA program) per saved model across transformers and UDFs
+        return load_keras_function(model_or_file)
+    return XlaFunction.from_keras(model_or_file)
+
+
+def registerKerasImageUDF(
+    udfName: str,
+    keras_model_or_file: Any,
+    preprocessor: Optional[Callable[[str], np.ndarray]] = None,
+    session=None,
+    batchSize: int = DEFAULT_BATCH_SIZE,
+) -> UserDefinedFunction:
+    """Register ``udfName`` so ``SELECT udfName(image) FROM view`` runs the
+    model.  Returns the :class:`UserDefinedFunction` (also usable directly in
+    ``DataFrame.select``).  Output rows are ``DenseVector``s of the flattened
+    model output."""
+    fn = _resolve_model(keras_model_or_file)
+    size = getattr(fn, "input_hw", None)
+    params = place_params(fn.params)
+    inner = fn._jitted()
+
+    def forward(x):
+        return inner(params, x)[0]
+
+    def evaluate(values):
+        if not values:
+            return []
+        if preprocessor is not None:
+            # file-loader mode: the preprocessor owns the whole input
+            # contract — its output is fed to the model unchanged
+            arrays = [
+                np.asarray(preprocessor(v), dtype=np.float32) for v in values
+            ]
+            shapes = {a.shape for a in arrays}
+            if len(shapes) > 1:
+                raise ValueError(
+                    f"UDF {udfName!r}: preprocessor produced mixed shapes "
+                    f"{sorted(shapes)}; it must emit one fixed shape"
+                )
+            batch = np.stack(arrays)
+        else:
+            arrays = [
+                normalize_channels(
+                    imageIO.imageStructToArray(v).astype(np.float32), 3
+                )[..., ::-1]  # stored BGR -> model RGB
+                for v in values
+            ]
+            if size is not None:
+                batch = device_resize(arrays, size)
+            else:
+                shapes = {a.shape for a in arrays}
+                if len(shapes) > 1:
+                    raise ValueError(
+                        f"UDF {udfName!r}: model input size is dynamic and "
+                        f"the column holds mixed shapes {sorted(shapes)}; "
+                        "resize in a preprocessor or use a fixed-input-size "
+                        "model"
+                    )
+                batch = np.stack(arrays)
+        result = run_batched(forward, batch, batchSize)
+        flat = result.reshape(result.shape[0], -1).astype(np.float64)
+        return [DenseVector(v) for v in flat]
+
+    udf = UserDefinedFunction(evaluate, name=udfName, vectorized=True)
+    from sparkdl_tpu.sql.session import TPUSession
+
+    session = session or TPUSession.getActiveSession()
+    session.udf.register(udfName, udf)
+    return udf

@@ -1,0 +1,178 @@
+"""SQL-UDF model-serving tests (the reference's L4 layer).
+
+Oracle pattern from the reference (``tests/udf/keras_image_model_test.py``†,
+SURVEY.md §4): register the UDF, run a SQL query, compare against directly
+calling the same Keras model on the same decoded arrays.
+"""
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.transformers.utils import device_resize, normalize_channels
+
+INPUT_SIZE = 24
+
+
+@pytest.fixture(scope="module")
+def keras_model():
+    import keras
+
+    rng = np.random.RandomState(7)
+    model = keras.Sequential(
+        [
+            keras.layers.Input((INPUT_SIZE, INPUT_SIZE, 3)),
+            keras.layers.Conv2D(4, 3, activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(5),
+        ]
+    )
+    # deterministic weights
+    model.set_weights([rng.randn(*w.shape).astype(np.float32) * 0.1
+                       for w in model.get_weights()])
+    return model
+
+
+@pytest.fixture(scope="module")
+def keras_model_file(keras_model, tmp_path_factory):
+    path = tmp_path_factory.mktemp("udf_models") / "small_cnn.keras"
+    keras_model.save(path)
+    return str(path)
+
+
+@pytest.fixture()
+def image_df(tpu_session, image_dir):
+    return imageIO.readImages(image_dir, tpu_session, numPartitions=2)
+
+
+def _oracle(keras_model, image_rows, input_col="image"):
+    """Direct Keras on decoded BGR->RGB resized arrays."""
+    arrays = [
+        normalize_channels(
+            imageIO.imageStructToArray(r[input_col]).astype(np.float32), 3
+        )[..., ::-1]
+        for r in image_rows
+    ]
+    batch = device_resize(arrays, (INPUT_SIZE, INPUT_SIZE))
+    return np.asarray(keras_model(batch))
+
+
+def test_register_keras_image_udf_sql_oracle(
+    tpu_session, image_df, keras_model, keras_model_file
+):
+    from sparkdl_tpu.udf import registerKerasImageUDF
+
+    registerKerasImageUDF("small_cnn_udf", keras_model_file)
+    image_df.createOrReplaceTempView("images_udf")
+    out = tpu_session.sql(
+        "SELECT filePath, small_cnn_udf(image) AS preds FROM images_udf"
+    ).collect()
+
+    rows = image_df.collect()
+    want = _oracle(keras_model, rows)
+    by_path = {r.filePath: np.asarray(r.preds) for r in out}
+    assert len(out) == len(rows)
+    for row, w in zip(rows, want):
+        np.testing.assert_allclose(by_path[row.filePath], w, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_register_keras_image_udf_model_object(tpu_session, image_df, keras_model):
+    """Registering a built in-memory model (not a file) works identically."""
+    from sparkdl_tpu.udf import registerKerasImageUDF
+
+    udf = registerKerasImageUDF("small_cnn_obj_udf", keras_model)
+    out = image_df.select(udf("image").alias("preds")).collect()
+    want = _oracle(keras_model, image_df.collect())
+    got = np.stack([np.asarray(r.preds) for r in out])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_register_keras_image_udf_with_preprocessor(
+    tpu_session, image_dir, keras_model, keras_model_file
+):
+    """File-path mode: preprocessor(path) -> ndarray feeds the model raw."""
+    from PIL import Image
+
+    from sparkdl_tpu.udf import registerKerasImageUDF
+
+    def preprocessor(path):
+        img = Image.open(path).convert("RGB").resize((INPUT_SIZE, INPUT_SIZE))
+        return np.asarray(img, dtype=np.float32)
+
+    registerKerasImageUDF(
+        "small_cnn_file_udf", keras_model_file, preprocessor=preprocessor
+    )
+    files_df = imageIO.filesToDF(tpu_session, image_dir)
+    files_df.createOrReplaceTempView("files_udf")
+    out = tpu_session.sql(
+        "SELECT filePath, small_cnn_file_udf(filePath) AS preds FROM files_udf"
+    ).collect()
+
+    paths = [r.filePath for r in files_df.collect()]
+    batch = np.stack([preprocessor(p) for p in paths])
+    want = np.asarray(keras_model(batch))
+    by_path = {r.filePath: np.asarray(r.preds) for r in out}
+    for p, w in zip(paths, want):
+        np.testing.assert_allclose(by_path[p], w, rtol=1e-4, atol=1e-4)
+
+
+def test_make_graph_udf_single_output(tpu_session):
+    from sparkdl_tpu.graph.function import XlaFunction
+    from sparkdl_tpu.udf import makeGraphUDF
+
+    fn = XlaFunction.from_callable(lambda x: (x * 2.0).sum(axis=-1))
+    makeGraphUDF(fn, "double_sum")
+    df = tpu_session.createDataFrame(
+        [([1.0, 2.0, 3.0],), ([4.0, 5.0, 6.0],)], ["v"]
+    )
+    df.createOrReplaceTempView("vectors_udf")
+    out = tpu_session.sql("SELECT double_sum(v) AS s FROM vectors_udf").collect()
+    assert [r.s for r in out] == [12.0, 30.0]
+
+
+def test_make_graph_udf_vector_output_and_params(tpu_session):
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.graph.function import XlaFunction
+    from sparkdl_tpu.udf import makeGraphUDF
+
+    w = np.arange(6, dtype=np.float32).reshape(3, 2)
+    fn = XlaFunction.from_callable(
+        lambda p, x: x @ p["w"],
+        params={"w": jnp.asarray(w)},
+        takes_params=True,
+    )
+    udf = makeGraphUDF(fn, "matmul_udf", register=False)
+    df = tpu_session.createDataFrame([([1.0, 0.0, 1.0],)], ["v"])
+    out = df.select(udf("v").alias("y")).collect()
+    np.testing.assert_allclose(
+        np.asarray(out[0].y), np.array([1, 0, 1], np.float32) @ w
+    )
+    # register=False must not have polluted the session registry
+    assert "matmul_udf" not in tpu_session.udf
+
+
+def test_make_graph_udf_multi_output(tpu_session):
+    from sparkdl_tpu.graph.function import XlaFunction
+    from sparkdl_tpu.udf import makeGraphUDF
+
+    fn = XlaFunction.from_callable(
+        lambda x: (x.sum(axis=-1), x.max(axis=-1)),
+        output_names=("total", "peak"),
+    )
+    makeGraphUDF(fn, "stats_udf")
+    df = tpu_session.createDataFrame([([1.0, 5.0],), ([2.0, 2.0],)], ["v"])
+    df.createOrReplaceTempView("stats_in")
+    out = tpu_session.sql("SELECT stats_udf(v) AS st FROM stats_in").collect()
+    assert out[0].st.total == 6.0 and out[0].st.peak == 5.0
+    assert out[1].st.total == 4.0 and out[1].st.peak == 2.0
+
+
+def test_package_export_resolves():
+    """Round-1 regression: the façade advertised sparkdl_tpu.udf but the
+    module didn't exist (VERDICT.md Missing #2)."""
+    import sparkdl_tpu
+
+    assert callable(sparkdl_tpu.registerKerasImageUDF)
+    assert callable(sparkdl_tpu.makeGraphUDF)
