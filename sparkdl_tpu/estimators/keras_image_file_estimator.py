@@ -30,7 +30,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sparkdl_tpu.estimators.losses import get_loss_fn, get_optimizer
+from sparkdl_tpu.estimators.losses import (
+    get_loss_fn,
+    get_optimizer,
+    get_per_sample_loss_fn,
+)
 from sparkdl_tpu.ml.base import Estimator
 from sparkdl_tpu.param.base import Param, keyword_only
 from sparkdl_tpu.param.shared import (
@@ -153,7 +157,10 @@ class KerasImageFileEstimator(
         seed = int(fit_params.get("seed", 0))
 
         model = keras.saving.load_model(self.getModelFile(), compile=False)
-        loss_fn = get_loss_fn(self.getKerasLoss())
+        loss_spec = self.getKerasLoss()
+        per_sample_loss = get_per_sample_loss_fn(loss_spec)
+        weighted = per_sample_loss is not None
+        loss_fn = per_sample_loss if weighted else get_loss_fn(loss_spec)
         tx = get_optimizer(self.getKerasOptimizer(), learning_rate)
 
         mesh = make_mesh()
@@ -162,7 +169,9 @@ class KerasImageFileEstimator(
         batch_size = max(batch_size - batch_size % n_dev, n_dev)
 
         state = init_keras_train_state(model, tx)
-        step_fn = make_keras_train_step(model, loss_fn, tx, mesh)
+        step_fn = make_keras_train_step(
+            model, loss_fn, tx, mesh, weighted=weighted
+        )
 
         ckpt_dir = self.getOrDefault(self.checkpointDir)
         start_epoch, state = self._maybe_restore(ckpt_dir, state)
@@ -174,11 +183,21 @@ class KerasImageFileEstimator(
             order = rng.permutation(n)
             for lo in range(0, n, batch_size):
                 idx = order[lo : lo + batch_size]
-                if len(idx) < batch_size:  # wrap-around pad for even shards
-                    idx = np.concatenate([idx, order[: batch_size - len(idx)]])
-                batch = shard_batch(
-                    {"x": jnp.asarray(x[idx]), "y": jnp.asarray(y[idx])}, mesh
-                )
+                k = len(idx)
+                if k < batch_size:
+                    # pad cyclically to the full batch so the chunk always
+                    # splits evenly across the mesh (even when n < batch);
+                    # with a known loss the pad rows carry zero weight, so
+                    # the update is the exact mean over the k real rows
+                    idx = np.concatenate(
+                        [idx, np.resize(order, batch_size - k)]
+                    )
+                batch = {"x": jnp.asarray(x[idx]), "y": jnp.asarray(y[idx])}
+                if weighted:
+                    w = np.zeros(batch_size, np.float32)
+                    w[:k] = 1.0
+                    batch["w"] = jnp.asarray(w)
+                batch = shard_batch(batch, mesh)
                 state, loss = step_fn(state, batch)
             last_loss = float(loss)
             logger.info("epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss)
@@ -216,17 +235,43 @@ class KerasImageFileEstimator(
             "step": state.step,
         }
 
+    def _ckpt_namespace(self) -> str:
+        """Deterministic subdirectory per training configuration, so fits
+        with different param maps (fitMultiple / CrossValidator grids) or
+        unrelated runs sharing one checkpointDir never restore each other's
+        state — while re-runs of the same configuration still resume."""
+        import hashlib
+        import json
+
+        fit_params = self.getKerasFitParams() or {}
+        payload = json.dumps(
+            {
+                "modelFile": os.path.abspath(str(self.getModelFile())),
+                "optimizer": repr(self.getKerasOptimizer()),
+                "loss": repr(self.getKerasLoss()),
+                "fitParams": sorted(
+                    (str(k), repr(v)) for k, v in fit_params.items()
+                ),
+                "labelCol": self.getLabelCol(),
+                "inputCol": self.getInputCol(),
+            },
+            sort_keys=True,
+        )
+        return "fit_" + hashlib.sha256(payload.encode()).hexdigest()[:12]
+
     def _save_checkpoint(self, ckpt_dir: str, epoch: int, state):
         import orbax.checkpoint as ocp
 
-        path = os.path.join(os.path.abspath(ckpt_dir), f"epoch_{epoch}")
+        path = os.path.join(
+            os.path.abspath(ckpt_dir), self._ckpt_namespace(), f"epoch_{epoch}"
+        )
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(path, self._ckpt_payload(state), force=True)
 
     def _maybe_restore(self, ckpt_dir: Optional[str], state):
         if not ckpt_dir:
             return 0, state
-        root = os.path.abspath(ckpt_dir)
+        root = os.path.join(os.path.abspath(ckpt_dir), self._ckpt_namespace())
         if not os.path.isdir(root):
             return 0, state
         epochs = sorted(

@@ -21,23 +21,53 @@ def _clip(p):
     return jnp.clip(p, _EPS, 1.0 - _EPS)
 
 
-def categorical_crossentropy(y_true, y_pred):
-    return -jnp.sum(y_true * jnp.log(_clip(y_pred)), axis=-1).mean()
+def _reduce_sample_dims(x):
+    """Mean over every axis but the leading batch axis -> shape (batch,)."""
+    return x.reshape(x.shape[0], -1).mean(axis=-1)
 
 
-def sparse_categorical_crossentropy(y_true, y_pred):
+# Per-sample forms: loss(y_true, y_pred) -> (batch,).  The mean forms below
+# derive from these; the estimator uses the per-sample forms directly so
+# padded rows in a ragged final batch can be masked out exactly.
+def per_sample_categorical_crossentropy(y_true, y_pred):
+    return _reduce_sample_dims(
+        -jnp.sum(y_true * jnp.log(_clip(y_pred)), axis=-1)[..., None]
+    )
+
+
+def per_sample_sparse_categorical_crossentropy(y_true, y_pred):
     y_true = y_true.astype(jnp.int32)
     picked = jnp.take_along_axis(
         _clip(y_pred), y_true[..., None], axis=-1
     )[..., 0]
-    return -jnp.log(picked).mean()
+    return _reduce_sample_dims(-jnp.log(picked)[..., None])
+
+
+def per_sample_binary_crossentropy(y_true, y_pred):
+    p = _clip(y_pred)
+    return _reduce_sample_dims(
+        -(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
+    )
+
+
+def per_sample_mean_squared_error(y_true, y_pred):
+    return _reduce_sample_dims((y_pred - y_true) ** 2)
+
+
+def per_sample_mean_absolute_error(y_true, y_pred):
+    return _reduce_sample_dims(jnp.abs(y_pred - y_true))
+
+
+def categorical_crossentropy(y_true, y_pred):
+    return per_sample_categorical_crossentropy(y_true, y_pred).mean()
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    return per_sample_sparse_categorical_crossentropy(y_true, y_pred).mean()
 
 
 def binary_crossentropy(y_true, y_pred):
-    p = _clip(y_pred)
-    return -(
-        y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p)
-    ).mean()
+    return per_sample_binary_crossentropy(y_true, y_pred).mean()
 
 
 def mean_squared_error(y_true, y_pred):
@@ -56,6 +86,16 @@ _LOSSES = {
     "mse": mean_squared_error,
     "mean_absolute_error": mean_absolute_error,
     "mae": mean_absolute_error,
+}
+
+_PER_SAMPLE_LOSSES = {
+    "categorical_crossentropy": per_sample_categorical_crossentropy,
+    "sparse_categorical_crossentropy": per_sample_sparse_categorical_crossentropy,
+    "binary_crossentropy": per_sample_binary_crossentropy,
+    "mean_squared_error": per_sample_mean_squared_error,
+    "mse": per_sample_mean_squared_error,
+    "mean_absolute_error": per_sample_mean_absolute_error,
+    "mae": per_sample_mean_absolute_error,
 }
 
 # Keras default learning rates per optimizer name.
@@ -90,6 +130,15 @@ def get_loss_fn(loss: Union[str, Callable]) -> Callable:
     if name not in _LOSSES:
         raise ValueError(f"Unknown loss {loss!r}; supported: {sorted(_LOSSES)}")
     return _LOSSES[name]
+
+
+def get_per_sample_loss_fn(loss: Union[str, Callable]) -> Optional[Callable]:
+    """``loss(y_true, y_pred) -> (batch,)`` per-sample losses for a known
+    Keras loss name; ``None`` for custom callables (no per-sample form is
+    derivable, so callers fall back to unweighted batches)."""
+    if callable(loss):
+        return None
+    return _PER_SAMPLE_LOSSES.get(loss.lower())
 
 
 def get_optimizer(

@@ -59,6 +59,9 @@ class XlaFunction:
         self.output_names = list(output_names)
         self.name = name
         self._jit_cache: Dict[Tuple, Any] = {}
+        # per-input (shape, dtype) with shape[0]=batch, when known — lets
+        # save()/persistence export without the caller re-supplying specs
+        self.input_specs: Optional[List[Tuple[Tuple[int, ...], Any]]] = None
 
     # ------------------------------------------------------------------
     # calling
@@ -221,6 +224,10 @@ class XlaFunction:
             if shape is not None and len(shape) == 4 and shape[1] and shape[2]
             else None
         )
+        if shape is not None and all(d is not None for d in shape[1:]):
+            fn.input_specs = [
+                ((1,) + tuple(int(d) for d in shape[1:]), np.float32)
+            ]
         return fn
 
     @classmethod
@@ -328,9 +335,24 @@ class XlaFunction:
         return bytes(exported.serialize())
 
     def save(self, path: str, *input_specs, **export_kwargs):
-        """Save to a directory: StableHLO artifact + spec manifest."""
+        """Save to a directory: StableHLO artifact + spec manifest.
+
+        ``input_specs`` default to specs recorded by the constructor (e.g.
+        ``from_keras``); pass them explicitly for hand-built functions.  A
+        function rehydrated by :meth:`load` re-serializes its stored artifact
+        verbatim (no re-export needed)."""
+        if not input_specs and self.input_specs:
+            input_specs = tuple(self.input_specs)
+        if input_specs:
+            blob = self.export_stablehlo(*input_specs, **export_kwargs)
+        elif getattr(self, "_exported", None) is not None:
+            blob = bytes(self._exported.serialize())
+        else:
+            raise ValueError(
+                f"XlaFunction {self.name!r} has no recorded input specs; "
+                "pass (shape, dtype) per input to save()"
+            )
         os.makedirs(path, exist_ok=True)
-        blob = self.export_stablehlo(*input_specs, **export_kwargs)
         with open(os.path.join(path, "function.stablehlo"), "wb") as fh:
             fh.write(blob)
         manifest = {
@@ -338,7 +360,8 @@ class XlaFunction:
             "input_names": self.input_names,
             "output_names": self.output_names,
             "input_specs": [
-                [list(shape), np.dtype(dtype).name] for shape, dtype in input_specs
+                [list(shape), np.dtype(dtype).name]
+                for shape, dtype in input_specs
             ],
         }
         with open(os.path.join(path, "manifest.json"), "w") as fh:
@@ -350,12 +373,17 @@ class XlaFunction:
             manifest = json.load(fh)
         with open(os.path.join(path, "function.stablehlo"), "rb") as fh:
             blob = fh.read()
-        return cls.from_stablehlo(
+        fn = cls.from_stablehlo(
             blob,
             input_names=manifest["input_names"],
             output_names=manifest["output_names"],
             name=manifest["name"],
         )
+        fn.input_specs = [
+            (tuple(shape), np.dtype(dtype))
+            for shape, dtype in manifest.get("input_specs", [])
+        ] or None
+        return fn
 
     @classmethod
     def from_stablehlo(

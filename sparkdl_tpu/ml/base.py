@@ -12,10 +12,11 @@ import abc
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
+from sparkdl_tpu.ml.util import MLReadable, MLWritable
 from sparkdl_tpu.param.base import Param, Params
 
 
-class Transformer(Params, metaclass=abc.ABCMeta):
+class Transformer(Params, MLWritable, MLReadable, metaclass=abc.ABCMeta):
     def transform(self, dataset, params: Optional[Dict[Param, Any]] = None):
         if params is None:
             params = {}
@@ -34,7 +35,7 @@ class Model(Transformer, metaclass=abc.ABCMeta):
     """A Transformer produced by an Estimator."""
 
 
-class Estimator(Params, metaclass=abc.ABCMeta):
+class Estimator(Params, MLWritable, MLReadable, metaclass=abc.ABCMeta):
     @abc.abstractmethod
     def _fit(self, dataset) -> Model:
         ...
@@ -82,7 +83,7 @@ class Estimator(Params, metaclass=abc.ABCMeta):
         return _Iter()
 
 
-class Evaluator(Params, metaclass=abc.ABCMeta):
+class Evaluator(Params, MLWritable, MLReadable, metaclass=abc.ABCMeta):
     @abc.abstractmethod
     def _evaluate(self, dataset) -> float:
         ...

@@ -39,6 +39,36 @@ class LogisticRegressionModel(Model):
     def numClasses(self) -> int:
         return int(self.weights.shape[1])
 
+    # -- persistence (weights npz + column names) ----------------------
+    def _save_artifacts(self, path: str):
+        import os
+
+        np.savez(
+            os.path.join(path, "lr_model.npz"),
+            weights=np.asarray(self.weights),
+            bias=np.asarray(self.bias),
+        )
+        return {
+            "featuresCol": self._features_col,
+            "predictionCol": self._prediction_col,
+            "probabilityCol": self._probability_col,
+        }
+
+    @classmethod
+    def _load_instance(cls, metadata, path: str):
+        import os
+
+        extra = metadata["extra"]
+        with np.load(os.path.join(path, "lr_model.npz")) as data:
+            weights, bias = data["weights"], data["bias"]
+        return cls(
+            weights,
+            bias,
+            extra["featuresCol"],
+            extra["predictionCol"],
+            extra["probabilityCol"],
+        )
+
     def _transform(self, dataset):
         w = jnp.asarray(self.weights)
         b = jnp.asarray(self.bias)

@@ -6,10 +6,25 @@ Chains Transformers/Estimators; used by the flagship transfer-learning flow
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional
 
 from sparkdl_tpu.ml.base import Estimator, Model, Transformer
+from sparkdl_tpu.ml.util import load_stage
 from sparkdl_tpu.param.base import Param, Params, keyword_only
+
+
+def _save_stages(stages, path: str) -> List[str]:
+    refs = []
+    for i, stage in enumerate(stages):
+        ref = os.path.join("stages", f"{i}_{stage.uid}")
+        stage.write().overwrite().save(os.path.join(path, ref))
+        refs.append(ref)
+    return refs
+
+
+def _load_stages(refs, path: str):
+    return [load_stage(os.path.join(path, ref)) for ref in refs]
 
 
 class Pipeline(Estimator):
@@ -65,6 +80,15 @@ class Pipeline(Estimator):
             that._set(stages=[s.copy() for s in that.getStages()])
         return that
 
+    # -- persistence: each stage saved as its own sub-stage directory ----
+    _exclude_params_from_save = ("stages",)
+
+    def _save_artifacts(self, path: str):
+        return {"stages": _save_stages(self.getStages(), path)}
+
+    def _load_artifacts(self, extra, path: str):
+        self._set(stages=_load_stages(extra["stages"], path))
+
 
 class PipelineModel(Model):
     def __init__(self, stages: List[Transformer]):
@@ -78,3 +102,10 @@ class PipelineModel(Model):
 
     def copy(self, extra=None):
         return PipelineModel([s.copy() for s in self.stages])
+
+    def _save_artifacts(self, path: str):
+        return {"stages": _save_stages(self.stages, path)}
+
+    @classmethod
+    def _load_instance(cls, metadata, path: str):
+        return cls(_load_stages(metadata["extra"]["stages"], path))

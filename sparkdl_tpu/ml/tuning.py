@@ -11,6 +11,7 @@ full dataset.
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Sequence
@@ -18,7 +19,50 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from sparkdl_tpu.ml.base import Estimator, Model
-from sparkdl_tpu.param.base import Param, TypeConverters, keyword_only
+from sparkdl_tpu.ml.util import load_stage
+from sparkdl_tpu.param.base import Param, Params, TypeConverters, keyword_only
+
+
+def _walk_params_objects(root):
+    """root + nested stages (Pipeline) — the search space for param owners."""
+    yield root
+    if hasattr(root, "getStages"):
+        try:
+            for stage in root.getStages():
+                yield from _walk_params_objects(stage)
+        except KeyError:
+            pass
+
+
+def _encode_param_maps(param_maps) -> List[List[Dict[str, Any]]]:
+    encoded = []
+    for pmap in param_maps:
+        entries = []
+        for param, value in pmap.items():
+            entries.append(
+                {"parent": param.parent, "name": param.name, "value": value}
+            )
+        encoded.append(entries)
+    return encoded
+
+
+def _decode_param_maps(encoded, estimator) -> List[Dict[Param, Any]]:
+    owners = list(_walk_params_objects(estimator))
+    maps: List[Dict[Param, Any]] = []
+    for entries in encoded:
+        pmap: Dict[Param, Any] = {}
+        for entry in entries:
+            owner = next(
+                (o for o in owners if o.uid == entry["parent"]), None
+            )
+            if owner is None:
+                raise ValueError(
+                    f"Cannot resolve param {entry['name']!r} of "
+                    f"{entry['parent']!r} against the restored estimator"
+                )
+            pmap[owner.getParam(entry["name"])] = entry["value"]
+        maps.append(pmap)
+    return maps
 
 
 class ParamGridBuilder:
@@ -56,6 +100,19 @@ class CrossValidatorModel(Model):
 
     def _transform(self, dataset):
         return self.bestModel.transform(dataset)
+
+    def _save_artifacts(self, path: str):
+        self.bestModel.write().overwrite().save(
+            os.path.join(path, "bestModel")
+        )
+        return {"avgMetrics": [float(m) for m in self.avgMetrics]}
+
+    @classmethod
+    def _load_instance(cls, metadata, path: str):
+        return cls(
+            load_stage(os.path.join(path, "bestModel")),
+            metadata["extra"]["avgMetrics"],
+        )
 
 
 class CrossValidator(Estimator):
@@ -146,4 +203,47 @@ class CrossValidator(Estimator):
             else int(np.argmin(metrics))
         )
         best_model = est.fit(dataset, param_maps[best_index])
-        return CrossValidatorModel(best_model, metrics.tolist())
+        return self._copyValues(
+            CrossValidatorModel(best_model, metrics.tolist())
+        )
+
+    # -- persistence ----------------------------------------------------
+    _exclude_params_from_save = (
+        "estimator",
+        "evaluator",
+        "estimatorParamMaps",
+    )
+
+    def _save_artifacts(self, path: str):
+        extra: Dict[str, Any] = {}
+        if self.isDefined(self.estimator):
+            self.getEstimator().write().overwrite().save(
+                os.path.join(path, "estimator")
+            )
+            extra["estimator"] = "estimator"
+        if self.isDefined(self.evaluator):
+            self.getEvaluator().write().overwrite().save(
+                os.path.join(path, "evaluator")
+            )
+            extra["evaluator"] = "evaluator"
+        if self.isDefined(self.estimatorParamMaps):
+            extra["estimatorParamMaps"] = _encode_param_maps(
+                self.getEstimatorParamMaps()
+            )
+        return extra
+
+    def _load_artifacts(self, extra, path: str):
+        if "estimator" in extra:
+            self._set(
+                estimator=load_stage(os.path.join(path, extra["estimator"]))
+            )
+        if "evaluator" in extra:
+            self._set(
+                evaluator=load_stage(os.path.join(path, extra["evaluator"]))
+            )
+        if "estimatorParamMaps" in extra:
+            self._set(
+                estimatorParamMaps=_decode_param_maps(
+                    extra["estimatorParamMaps"], self.getEstimator()
+                )
+            )

@@ -51,9 +51,18 @@ def make_keras_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     data_axis: str = "data",
+    weighted: bool = False,
 ):
     """``step(state, batch) -> (state, loss)`` with ``batch = {"x": ...,
-    "y": ...}`` sharded along the ``data`` axis; params stay replicated."""
+    "y": ...}`` sharded along the ``data`` axis; params stay replicated.
+
+    With ``weighted=True``, ``loss_fn`` must return *per-sample* losses
+    (shape ``(batch,)``) and ``batch`` must carry a ``"w"`` weight vector;
+    the step optimizes the exact global weighted mean — zero-weight rows
+    (ragged-final-batch padding) contribute nothing to loss or gradient.
+    (They still pass through the forward, so BN moving stats see them; that
+    bias is one padded batch per epoch and vanishes in the average.)
+    """
     n_shards = int(mesh.shape[data_axis])
 
     def step(state: KerasTrainState, batch):
@@ -62,15 +71,30 @@ def make_keras_train_step(
                 outputs, new_nt = model.stateless_call(
                     tr, non_trainable, local_batch["x"], training=True
                 )
+                if weighted:
+                    w = local_batch["w"]
+                    w_total = jax.lax.psum(w.sum(), axis_name=data_axis)
+                    per = loss_fn(local_batch["y"], outputs)
+                    return (per * w).sum() / w_total, new_nt
                 return loss_fn(local_batch["y"], outputs), new_nt
 
             (loss, new_nt), grads = jax.value_and_grad(
                 local_loss, has_aux=True
             )(trainable)
-            # replicated-param transpose already psum'd the grads over the
-            # data axis (see trainer.make_train_step); normalize to the mean
-            grads = jax.tree_util.tree_map(lambda g: g / n_shards, grads)
-            loss = jax.lax.pmean(loss, axis_name=data_axis)
+            if weighted:
+                # each shard's loss is its share of the global weighted mean;
+                # the replicated-param transpose psums grads over the data
+                # axis, which together with the global w_total normalization
+                # is already the exact weighted-mean gradient
+                loss = jax.lax.psum(loss, axis_name=data_axis)
+            else:
+                # replicated-param transpose already psum'd the grads over
+                # the data axis (see trainer.make_train_step); normalize to
+                # the mean
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / n_shards, grads
+                )
+                loss = jax.lax.pmean(loss, axis_name=data_axis)
             # float stats (BN moving averages) averaged across shards;
             # integer state (RNG counters) is shard-invariant already
             new_nt = jax.tree_util.tree_map(

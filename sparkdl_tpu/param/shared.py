@@ -115,6 +115,10 @@ class CanLoadImage(Params):
 
 
 class HasKerasModel(Params):
+    # persistence: modelFile names a model artifact — save() copies the file
+    # into the save directory instead of recording a dangling path
+    _file_params = ("modelFile",)
+
     modelFile = Param(
         "undefined",
         "modelFile",

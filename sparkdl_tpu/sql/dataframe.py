@@ -31,6 +31,18 @@ def _partition_nrows(part: Partition) -> int:
     return len(next(iter(part.values())))
 
 
+def _infer_column_type(parts: List[Partition], name: str, fallback):
+    """Type of the first non-None value anywhere in the column — a probe of
+    just the first partition's first row degrades to untyped whenever that
+    row is empty or None.  ``fallback()`` supplies the prior schema's type
+    when the whole column is empty/None."""
+    for part in parts:
+        for v in part.get(name, ()):
+            if v is not None:
+                return infer_type(v)
+    return fallback()
+
+
 class DataFrame:
     def __init__(
         self,
@@ -147,10 +159,13 @@ class DataFrame:
             n = _partition_nrows(part)
             out_parts.append({e._name: e._eval(part, n) for e in exprs})
         new_schema = StructType()
-        probe = next((p for p in out_parts if _partition_nrows(p)), None)
         for e in exprs:
-            dt = infer_type(probe[e._name][0]) if probe else self._field_type(e._name)
-            new_schema.add(e._name, dt)
+            new_schema.add(
+                e._name,
+                _infer_column_type(
+                    out_parts, e._name, lambda: self._field_type(e._name)
+                ),
+            )
         return self._with_partitions(out_parts, new_schema)
 
     def _field_type(self, name: str) -> DataType:
@@ -181,12 +196,14 @@ class DataFrame:
             new_part[name] = expr._eval(part, n)
             out_parts.append(new_part)
         new_schema = StructType()
-        probe = next((p for p in out_parts if _partition_nrows(p)), None)
         for f in self._schema:
             if f.name != name:
                 new_schema.add(f.name, f.dataType)
         new_schema.add(
-            name, infer_type(probe[name][0]) if probe else self._field_type(name)
+            name,
+            _infer_column_type(
+                out_parts, name, lambda: self._field_type(name)
+            ),
         )
         return self._with_partitions(out_parts, new_schema)
 

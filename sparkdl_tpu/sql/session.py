@@ -299,5 +299,8 @@ class TPUSession:
     def binaryFiles(self, path: str, minPartitions: int = DEFAULT_PARTITIONS):
         from sparkdl_tpu.image.imageIO import _list_files
 
-        files = _list_files(path)
-        return [(f, open(f, "rb").read()) for f in files]
+        out = []
+        for f in _list_files(path):
+            with open(f, "rb") as fh:
+                out.append((f, fh.read()))
+        return out

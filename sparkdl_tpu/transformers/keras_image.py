@@ -92,6 +92,13 @@ class KerasImageFileTransformer(
                 out[output_col] = []
                 return out
             arrays = [np.asarray(loader(u), dtype=np.float32) for u in uris]
+            shapes = {a.shape for a in arrays}
+            if len(shapes) > 1:
+                raise ValueError(
+                    "imageLoader must produce one fixed array shape per "
+                    f"image; this partition mixes {sorted(shapes)} — resize "
+                    "inside the loader"
+                )
             batch = np.stack(arrays)
             result = run_batched(jitted, batch, batch_size)
             if mode == "vector":

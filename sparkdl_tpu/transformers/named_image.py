@@ -7,10 +7,11 @@ preprocessing, CNN forward — is one jitted XLA program on bf16-capable
 hardware, instead of stitched GraphDefs run per block by executors.
 
 Weights: the reference always pulled ``imagenet`` weights over the network.
-Here ``modelWeights`` may be ``"imagenet"`` (tried via Keras' cache; falls
-back to deterministic random initialization with a warning when offline), a
-built Keras model, or a Flax variables pytree — the latter two also give
-tests their oracle injection point.
+Here ``modelWeights`` may be ``"imagenet"`` (via Keras' local cache; raises
+when unavailable — silent random "imagenet" features would be garbage), a
+built Keras model, a Flax variables pytree (the tests' oracle injection
+point), or the explicit opt-in ``"random"`` (deterministic random init for
+testing/benchmarking).
 """
 
 from __future__ import annotations
@@ -41,17 +42,20 @@ from sparkdl_tpu.transformers.utils import (
 
 logger = logging.getLogger(__name__)
 
-# modelName -> variables pytree, shared across transformer instances.
-_VARIABLES_CACHE: Dict[str, Any] = {}
+from sparkdl_tpu.transformers.utils import LRUCache
+
+# (modelName, kind) -> variables pytree, shared across transformer instances.
+# Bounded: each entry is a full CNN's weights (tens-hundreds of MB).
+_VARIABLES_CACHE = LRUCache(4)
 
 # id(keras model) -> (model, ported variables); the strong model ref keeps
-# the id stable.
-_PORTED_CACHE: Dict[int, Tuple[Any, Any]] = {}
+# the id stable (and is dropped on LRU eviction).
+_PORTED_CACHE = LRUCache(4)
 
 # (modelName, dtype, featurize, id(variables)) -> jitted forward.  Keeps the
 # XLA executable alive across _transform calls (fit → score → new stages), so
 # the CNN compiles once per process instead of once per transform.
-_FORWARD_CACHE: Dict[Tuple, Any] = {}
+_FORWARD_CACHE = LRUCache(8)
 
 
 def _imagenet_cache_present(model_name: str) -> bool:
@@ -77,8 +81,9 @@ def _resolve_variables(model_name: str, spec) -> Any:
     """Resolve the ``modelWeights`` param to a Flax variables pytree."""
     entry = get_keras_application_model(model_name)
     if spec is None or spec == "imagenet":
-        if model_name in _VARIABLES_CACHE:
-            return _VARIABLES_CACHE[model_name]
+        key = (model_name, "imagenet")
+        if key in _VARIABLES_CACHE:
+            return _VARIABLES_CACHE[key]
         variables = None
         if _imagenet_cache_present(model_name):
             try:
@@ -90,20 +95,29 @@ def _resolve_variables(model_name: str, spec) -> Any:
                     exc,
                 )
         if variables is None:
-            logger.warning(
-                "No imagenet weights available for %s (offline, no local "
-                "cache); falling back to deterministic random "
-                "initialization. Pass modelWeights= to supply real weights.",
-                model_name,
+            # fail loudly, like the reference: silently random-initialized
+            # "imagenet" features look structurally valid but are garbage
+            raise RuntimeError(
+                f"imagenet weights for {model_name} are unavailable (offline "
+                "and no local Keras cache). Pass modelWeights= a built Keras "
+                "model or a Flax variables pytree, or opt in to "
+                "modelWeights='random' for deterministic random "
+                "initialization (testing/benchmarking only)."
             )
-            module = entry.make_module()
-            h, w = entry.input_size
-            with jax.default_device(jax.local_devices(backend="cpu")[0]):
-                variables = module.init(
-                    jax.random.PRNGKey(0),
-                    jnp.zeros((1, h, w, 3), jnp.float32),
-                )
-        _VARIABLES_CACHE[model_name] = variables
+        _VARIABLES_CACHE[key] = variables
+        return variables
+    if spec == "random":
+        key = (model_name, "random")
+        if key in _VARIABLES_CACHE:
+            return _VARIABLES_CACHE[key]
+        module = entry.make_module()
+        h, w = entry.input_size
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            variables = module.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, h, w, 3), jnp.float32),
+            )
+        _VARIABLES_CACHE[key] = variables
         return variables
     if isinstance(spec, dict):  # Flax variables pytree
         return spec
@@ -128,7 +142,8 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
     modelWeights = Param(
         "undefined",
         "modelWeights",
-        "'imagenet', a built Keras model, or a Flax variables pytree",
+        "'imagenet', a built Keras model, a Flax variables pytree, or "
+        "'random' (explicit opt-in to deterministic random init)",
     )
     batchSize = Param(
         "undefined",

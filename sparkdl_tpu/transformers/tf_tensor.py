@@ -112,12 +112,19 @@ class TFTransformer(Transformer):
                 for col in output_mapping.values():
                     out[col] = []
                 return out
-            columns = [
-                np.stack(
-                    [np.asarray(v, dtype=np.float32) for v in part[c]]
+            def to_batch(values):
+                # floats narrow to f32 (TPU-native); integer columns keep
+                # integral dtype (i32) instead of being silently corrupted
+                # through a float cast (embedding ids, one-hot indices)
+                first = np.asarray(values[0])
+                dtype = (
+                    np.int32
+                    if np.issubdtype(first.dtype, np.integer)
+                    else np.float32
                 )
-                for c in ordered_cols
-            ]
+                return np.stack([np.asarray(v, dtype=dtype) for v in values])
+
+            columns = [to_batch(part[c]) for c in ordered_cols]
             results = run_batched_multi(jitted, columns, batch_size)
             by_name = dict(zip(fn.output_names, results))
             for name, col in output_mapping.items():

@@ -16,7 +16,8 @@ rules applied here:
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -25,20 +26,54 @@ import jax.numpy as jnp
 
 DEFAULT_BATCH_SIZE = 32
 
-_resize_cache: Dict[Tuple, Callable] = {}
+
+class LRUCache:
+    """Tiny bounded mapping: process-lifetime model/program caches hold
+    compiled XLA executables and full variable pytrees (potentially hundreds
+    of MB each), so they must evict rather than grow without bound."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = int(maxsize)
+        self._data: "OrderedDict[Any, Any]" = OrderedDict()
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def __getitem__(self, key):
+        value = self._data[key]
+        self._data.move_to_end(key)
+        return value
+
+    def __setitem__(self, key, value):
+        self._data[key] = value
+        self._data.move_to_end(key)
+        while len(self._data) > self.maxsize:
+            self._data.popitem(last=False)
+
+    def get(self, key, default=None):
+        return self[key] if key in self._data else default
+
+    def __len__(self):
+        return len(self._data)
+
+
+_resize_cache = LRUCache(16)
 
 
 def _host_resize_one(img: np.ndarray, height: int, width: int) -> np.ndarray:
-    """PIL bilinear resize of one HWC float array (no XLA compile)."""
-    from PIL import Image
-
-    channels = []
-    for c in range(img.shape[-1]):
-        f = Image.fromarray(np.ascontiguousarray(img[:, :, c]), mode="F")
-        channels.append(
-            np.asarray(f.resize((width, height), Image.BILINEAR))
+    """``jax.image.resize`` of one HWC float array on the CPU backend — the
+    *same* resampler as the batched device path, so features are invariant to
+    how images were partitioned/shape-grouped (PIL bilinear differs
+    numerically: corner-aligned sampling vs half-pixel centers)."""
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        return np.asarray(
+            jax.image.resize(
+                jnp.asarray(img, jnp.float32),
+                (height, width, img.shape[-1]),
+                method="bilinear",
+            )
         )
-    return np.stack(channels, axis=-1)
 
 
 # A new XLA program per distinct source shape is ~10-40s on cold TPU; beyond
@@ -175,7 +210,7 @@ def place_params(params, device=None):
     return jax.device_put(params, device)
 
 
-_KERAS_FN_CACHE: Dict[Tuple[str, float], Any] = {}
+_KERAS_FN_CACHE = LRUCache(8)
 
 
 def load_keras_function(path: str):

@@ -121,7 +121,10 @@ def test_checkpoint_and_resume(labeled_df, tmp_path):
     est = _make_estimator(path)
     est = est.copy({est.checkpointDir: ckpt})
     est.fit(labeled_df)
-    saved = sorted(os.listdir(ckpt))
+    # checkpoints are namespaced per training configuration
+    namespaces = os.listdir(ckpt)
+    assert len(namespaces) == 1 and namespaces[0].startswith("fit_")
+    saved = sorted(os.listdir(os.path.join(ckpt, namespaces[0])))
     assert "epoch_1" in saved and "epoch_8" in saved
 
     # resume: a fresh estimator with the same dir starts past epoch 8 and
@@ -129,6 +132,90 @@ def test_checkpoint_and_resume(labeled_df, tmp_path):
     est2 = _make_estimator(path).copy({est.checkpointDir: ckpt})
     fitted = est2.fit(labeled_df)
     assert isinstance(fitted, KerasImageFileTransformer)
+
+
+def test_checkpoints_namespaced_by_fit_config(labeled_df, tmp_path):
+    """Different param maps sharing one checkpointDir must not restore each
+    other's state (previously epoch_N keys collided across configs)."""
+    _, path = _tiny_model(tmp_path)
+    ckpt = str(tmp_path / "shared_ckpts")
+    est_a = _make_estimator(path, epochs=2)
+    est_a = est_a.copy({est_a.checkpointDir: ckpt})
+    est_b = _make_estimator(path, epochs=3)
+    est_b = est_b.copy({est_b.checkpointDir: ckpt})
+    est_a.fit(labeled_df)
+    fitted_b = est_b.fit(labeled_df)
+    namespaces = sorted(os.listdir(ckpt))
+    assert len(namespaces) == 2  # one namespace per config
+    # est_b trained its full 3 epochs rather than resuming est_a's epoch_2
+    assert isinstance(fitted_b, KerasImageFileTransformer)
+    ns_b = [
+        ns for ns in namespaces
+        if "epoch_3" in os.listdir(os.path.join(ckpt, ns))
+    ]
+    assert len(ns_b) == 1
+
+
+def test_fit_dataset_smaller_than_batch(labeled_df, tmp_path):
+    """Regression: 3 rows with batch_size 32 on an 8-device mesh previously
+    crashed in shard_batch (wrap-around pad produced a 6-row chunk)."""
+    _, path = _tiny_model(tmp_path)
+    small = labeled_df.limit(3)
+    est = _make_estimator(path, epochs=1, batch_size=32)
+    fitted = est.fit(small)
+    assert isinstance(fitted, KerasImageFileTransformer)
+    assert np.isfinite(fitted._training_loss)
+
+
+def test_padded_rows_do_not_bias_gradient(labeled_df, tmp_path):
+    """The ragged final batch is padded to the full batch size but masked:
+    one epoch over n rows with batch_size > n must produce exactly the
+    single-device full-batch SGD update on those n rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.estimators.losses import sparse_categorical_crossentropy
+
+    model, path = _tiny_model(tmp_path, seed=3)
+    rows = labeled_df.limit(5).collect()
+    x = np.stack([_loader(r.filePath) for r in rows])
+    y = np.asarray([r.label for r in rows], np.int32)
+
+    lr = 0.1
+    est = KerasImageFileEstimator(
+        inputCol="filePath",
+        outputCol="pred",
+        labelCol="label",
+        imageLoader=_loader,
+        modelFile=path,
+        kerasOptimizer="sgd",
+        kerasLoss="sparse_categorical_crossentropy",
+        kerasFitParams={
+            "epochs": 1,
+            "batch_size": 16,
+            "learning_rate": lr,
+            "seed": 0,
+        },
+    )
+    fitted = est.fit(labeled_df.limit(5))
+
+    # single-device oracle: one plain full-batch SGD step on the 5 rows
+    ref = keras.saving.load_model(path, compile=False)
+    trainable = [jnp.asarray(v.value) for v in ref.trainable_variables]
+    non_trainable = [jnp.asarray(v.value) for v in ref.non_trainable_variables]
+
+    def loss_fn(tr):
+        out, _ = ref.stateless_call(tr, non_trainable, jnp.asarray(x),
+                                    training=True)
+        return sparse_categorical_crossentropy(jnp.asarray(y), out)
+
+    grads = jax.grad(loss_fn)(trainable)
+    want = [np.asarray(t - lr * g) for t, g in zip(trainable, grads)]
+
+    tuned = keras.saving.load_model(fitted.getModelFile(), compile=False)
+    got = [np.asarray(v.value) for v in tuned.trainable_variables]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
 
 
 def test_param_grid_builder():

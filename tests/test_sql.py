@@ -119,3 +119,29 @@ def test_to_pandas(df):
     pdf = df.toPandas()
     assert list(pdf.columns) == ["id", "name", "score"]
     assert len(pdf) == 10
+
+
+def test_column_eq_returns_column_not_bool(df):
+    """pyspark parity wart, pinned: Column.__eq__ builds an expression, so
+    Columns are unhashable and `in` checks on Column lists are meaningless —
+    use .alias()/_name comparisons instead."""
+    c = col("id") == 3
+    assert isinstance(c, type(col("id")))
+    with pytest.raises(TypeError):
+        hash(col("id"))
+
+
+def test_schema_inference_skips_leading_nones(tpu_session):
+    """Type inference probes for the first non-None value anywhere in the
+    column (previously: first partition's first row only)."""
+    from sparkdl_tpu.sql.types import infer_type
+
+    df = tpu_session.createDataFrame(
+        [(None,), (None,), (7,)], ["x"], numPartitions=2
+    )
+    out = df.select("x")
+    want = type(infer_type(7))
+    assert isinstance(out.schema["x"].dataType, want)
+
+    out2 = df.withColumn("y", col("x") * 2)
+    assert isinstance(out2.schema["y"].dataType, want)

@@ -346,3 +346,98 @@ def test_flagship_pipeline_featurizer_plus_lr(image_df, mobilenet_oracle):
     assert preds <= {0.0, 1.0}
     acc = MulticlassClassificationEvaluator().evaluate(scored)
     assert 0.0 <= acc <= 1.0
+
+
+def test_featurizer_missing_imagenet_weights_raises(image_df):
+    """Offline with no Keras weight cache: default 'imagenet' weights must
+    fail loudly, not silently random-initialize (random features posing as
+    imagenet features look valid but are garbage)."""
+    from sparkdl_tpu.transformers import named_image
+    from sparkdl_tpu.transformers.named_image import DeepImageFeaturizer
+
+    if named_image._imagenet_cache_present("MobileNetV2"):
+        pytest.skip("local imagenet cache exists; raise path not reachable")
+    featurizer = DeepImageFeaturizer(
+        inputCol="image", outputCol="features", modelName="MobileNetV2"
+    )
+    with pytest.raises(RuntimeError, match="imagenet weights"):
+        featurizer.transform(image_df).collect()
+
+
+def test_featurizer_random_weights_opt_in(image_df):
+    """modelWeights='random' is the explicit, deterministic opt-in."""
+    from sparkdl_tpu.transformers.named_image import DeepImageFeaturizer
+
+    kwargs = dict(
+        inputCol="image",
+        outputCol="features",
+        modelName="MobileNetV2",
+        modelWeights="random",
+        computeDtype="float32",
+        batchSize=4,
+    )
+    a = DeepImageFeaturizer(**kwargs).transform(image_df).collect()
+    b = DeepImageFeaturizer(**kwargs).transform(image_df).collect()
+    va = np.asarray(a[0]["features"])
+    assert np.isfinite(va).all() and va.shape == (1280,)
+    np.testing.assert_array_equal(va, np.asarray(b[0]["features"]))
+
+
+def test_tf_transformer_preserves_integer_columns(tpu_session):
+    """Integer tensor columns must keep integral dtype through the engine
+    (previously cast to float32 silently)."""
+    from sparkdl_tpu.graph.function import XlaFunction
+    from sparkdl_tpu.transformers.tf_tensor import TFTransformer
+
+    fn = XlaFunction.from_callable(
+        lambda x: x * 2, input_names=("ids",), output_names=("doubled",)
+    )
+    df = tpu_session.createDataFrame(
+        [([1, 2, 3],), ([4, 5, 6],)], ["ids"]
+    )
+    t = TFTransformer(
+        tfInputGraph=fn,
+        inputMapping={"ids": "ids"},
+        outputMapping={"doubled": "out"},
+    )
+    rows = t.transform(df).collect()
+    out = np.asarray(rows[0]["out"])
+    assert np.issubdtype(out.dtype, np.integer), out.dtype
+    np.testing.assert_array_equal(out, [2, 4, 6])
+
+
+def test_keras_image_transformer_ragged_loader_raises(
+    tpu_session, image_dir, tmp_path
+):
+    """A loader producing mixed shapes must fail with a named error, not a
+    cryptic np.stack failure."""
+    import keras
+
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.transformers.keras_image import KerasImageFileTransformer
+
+    model = keras.Sequential(
+        [keras.layers.Input((8, 8, 3)), keras.layers.Flatten()]
+    )
+    path = str(tmp_path / "flat.keras")
+    model.save(path)
+
+    sizes = iter([(8, 8), (9, 9), (8, 8), (9, 9), (8, 8), (9, 9), (8, 8)])
+
+    def ragged_loader(uri):
+        from PIL import Image
+
+        return np.asarray(
+            Image.open(uri).convert("RGB").resize(next(sizes)),
+            dtype=np.float32,
+        )
+
+    df = imageIO.filesToDF(tpu_session, image_dir, numPartitions=1)
+    t = KerasImageFileTransformer(
+        inputCol="filePath",
+        outputCol="out",
+        modelFile=path,
+        imageLoader=ragged_loader,
+    )
+    with pytest.raises(ValueError, match="imageLoader"):
+        t.transform(df).collect()
