@@ -51,6 +51,7 @@ from sparkdl_tpu.parallel.keras_train import (
     init_keras_train_state,
     make_keras_train_step,
 )
+from sparkdl_tpu.parallel import runner
 from sparkdl_tpu.parallel.trainer import make_mesh, shard_batch
 from sparkdl_tpu.transformers.keras_image import KerasImageFileTransformer
 
@@ -121,14 +122,34 @@ class KerasImageFileEstimator(
         return True
 
     def _getNumpyFeaturesAndLabels(self, dataset):
-        """Collect (URI, label) rows to the host and load images via the
-        user ``imageLoader`` (reference ``_getNumpyFeaturesAndLabels``†; IO
-        parallelized with a thread pool)."""
+        """Collect (URI, label) rows and load images via the user
+        ``imageLoader`` (reference ``_getNumpyFeaturesAndLabels``†; IO
+        parallelized with a thread pool).
+
+        Unlike the reference — which collected the *entire* dataset to the
+        driver (SURVEY.md §3.2) — under a multi-host run each process loads
+        only its own strided shard of the rows (the per-host data plane;
+        see :mod:`sparkdl_tpu.parallel.runner`).  Returns ``(x, y,
+        n_global)`` where ``x``/``y`` are this host's rows.
+        """
         input_col = self.getInputCol()
         label_col = self.getLabelCol()
         rows = dataset.select(input_col, label_col).collect()
         if not rows:
             raise ValueError("fit() received an empty dataset")
+        n_global = len(rows)
+        if runner.is_distributed():
+            nprocs = jax.process_count()
+            if n_global < nprocs:
+                # raised identically on every process, before any collective
+                # op, so the job fails fast instead of deadlocking peers on
+                # a host whose strided shard would be empty
+                raise ValueError(
+                    f"fit() needs at least one row per host: got {n_global} "
+                    f"rows across {nprocs} processes"
+                )
+            keep = runner.host_shard_indices(n_global)
+            rows = [rows[i] for i in keep]
         loader = self.getImageLoader()
         uris = [r[input_col] for r in rows]
         with ThreadPoolExecutor(max_workers=16) as pool:
@@ -142,14 +163,14 @@ class KerasImageFileEstimator(
             y = np.asarray(labels, dtype=np.int32)
         else:
             y = np.stack([np.asarray(l, dtype=np.float32) for l in labels])
-        return x, y
+        return x, y, n_global
 
     # ------------------------------------------------------------------
     def _fit(self, dataset):
         self._validateParams()
         import keras
 
-        x, y = self._getNumpyFeaturesAndLabels(dataset)
+        x, y, n_global = self._getNumpyFeaturesAndLabels(dataset)
         fit_params = dict(self.getKerasFitParams() or {})
         epochs = int(fit_params.get("epochs", 1))
         batch_size = int(fit_params.get("batch_size", 32))
@@ -163,10 +184,13 @@ class KerasImageFileEstimator(
         loss_fn = per_sample_loss if weighted else get_loss_fn(loss_spec)
         tx = get_optimizer(self.getKerasOptimizer(), learning_rate)
 
-        mesh = make_mesh()
+        distributed = runner.is_distributed()
+        nprocs = jax.process_count()
+        mesh = runner.make_global_mesh() if distributed else make_mesh()
         n_dev = int(mesh.devices.size)
-        # global batch must split evenly across the mesh
+        # global batch must split evenly across the mesh (and hence hosts)
         batch_size = max(batch_size - batch_size % n_dev, n_dev)
+        local_bs = batch_size // nprocs
 
         state = init_keras_train_state(model, tx)
         step_fn = make_keras_train_step(
@@ -175,29 +199,48 @@ class KerasImageFileEstimator(
 
         ckpt_dir = self.getOrDefault(self.checkpointDir)
         start_epoch, state = self._maybe_restore(ckpt_dir, state)
+        if distributed:
+            # params start host-local (loaded from the same model file on
+            # every process) — lift them onto the global mesh, replicated
+            state = runner.replicate(state, mesh)
 
-        n = x.shape[0]
-        rng = np.random.RandomState(seed)
+        n = x.shape[0]  # this host's rows (== n_global when single-host)
+        # identical step count on every host, derived from the global row
+        # count: the largest host shard, padded up to whole local batches
+        max_local_rows = -(-n_global // nprocs)
+        steps_per_epoch = max(1, -(-max_local_rows // local_bs))
+        if distributed and not weighted and n_global % nprocs:
+            logger.warning(
+                "custom loss without a per-sample form: uneven host shards "
+                "(%d rows / %d hosts) train on duplicate-padded rows at "
+                "full weight, slightly over-weighting the smaller hosts' "
+                "rows; use a named loss for exact zero-weight padding",
+                n_global,
+                nprocs,
+            )
+        rng = np.random.RandomState((seed * 7919 + jax.process_index()) % 2**32)
         last_loss = None
         for epoch in range(start_epoch, epochs):
             order = rng.permutation(n)
-            for lo in range(0, n, batch_size):
-                idx = order[lo : lo + batch_size]
+            for step_i in range(steps_per_epoch):
+                idx = order[step_i * local_bs : (step_i + 1) * local_bs]
                 k = len(idx)
-                if k < batch_size:
-                    # pad cyclically to the full batch so the chunk always
-                    # splits evenly across the mesh (even when n < batch);
+                if k < local_bs:
+                    # pad cyclically to the full local batch so every host
+                    # contributes the same shape (even when n < local_bs);
                     # with a known loss the pad rows carry zero weight, so
-                    # the update is the exact mean over the k real rows
-                    idx = np.concatenate(
-                        [idx, np.resize(order, batch_size - k)]
-                    )
-                batch = {"x": jnp.asarray(x[idx]), "y": jnp.asarray(y[idx])}
+                    # the update is the exact mean over the real rows
+                    idx = np.concatenate([idx, np.resize(order, local_bs - k)])
+                batch = {"x": x[idx], "y": y[idx]}
                 if weighted:
-                    w = np.zeros(batch_size, np.float32)
+                    w = np.zeros(local_bs, np.float32)
                     w[:k] = 1.0
-                    batch["w"] = jnp.asarray(w)
-                batch = shard_batch(batch, mesh)
+                    batch["w"] = w
+                if distributed:
+                    batch = runner.global_batch(batch, mesh)
+                else:
+                    batch = jax.tree_util.tree_map(jnp.asarray, batch)
+                    batch = shard_batch(batch, mesh)
                 state, loss = step_fn(state, batch)
             last_loss = float(loss)
             logger.info("epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss)

@@ -8,6 +8,7 @@ gradient allreduce over ICI — the NCCL-allreduce analog — and the control
 plane via ``jax.distributed`` for multi-host.
 """
 
+from sparkdl_tpu.parallel import runner  # noqa: F401
 from sparkdl_tpu.parallel.trainer import (  # noqa: F401
     TrainState,
     init_train_state,

@@ -1,0 +1,154 @@
+"""Multi-host runner — the ``jax.distributed`` control plane + data plane.
+
+Reference analog: in ``spark-deep-learning`` the control plane between the
+driver and executors is Spark RPC + py4j, and the data plane is TensorFrames
+feeding TF sessions inside executor JVMs (SURVEY.md §5.8).  There is no
+NCCL/MPI anywhere in the reference; scale-out is Spark's job.  The TPU-native
+replacement is:
+
+- **control plane**: ``jax.distributed.initialize`` — one process per host,
+  a coordinator at process 0 (the "driver"), workers register and exchange
+  device topology (its role ≈ Spark driver↔executor RPC);
+- **collectives**: XLA collectives over ICI within a slice / DCN across
+  slices, emitted by the compiler from sharding annotations — the
+  NCCL-allreduce analog;
+- **data plane**: each host loads only its own shard of the dataset
+  (the analog of Spark partitions living on their executors) and assembles
+  global ``jax.Array``s with :func:`jax.make_array_from_process_local_data`.
+
+On CPU test rigs the same code path runs with gloo collectives
+(``jax_cpu_collectives_implementation``), which is how
+``tests/test_multihost.py`` proves the global-mesh step with 2 processes x 4
+virtual devices and no TPU pod.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+    cpu_collectives: str = "gloo",
+) -> None:
+    """Start the distributed control plane (idempotent).
+
+    On real TPU pods all arguments are discovered from the TPU metadata
+    environment and may be omitted.  On CPU rigs pass them explicitly (or
+    via ``SPARKDL_COORDINATOR`` / ``SPARKDL_NUM_PROCS`` / ``SPARKDL_PROC_ID``
+    env vars) and the CPU client is created with gloo TCP collectives so
+    cross-process ``psum``/``all_gather`` work without TPU hardware.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "SPARKDL_COORDINATOR"
+    )
+    if num_processes is None and "SPARKDL_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["SPARKDL_NUM_PROCS"])
+    if process_id is None and "SPARKDL_PROC_ID" in os.environ:
+        process_id = int(os.environ["SPARKDL_PROC_ID"])
+    if (
+        cpu_collectives
+        and jax.config.jax_platforms
+        and "cpu" in str(jax.config.jax_platforms)
+    ):
+        # must be set before the CPU backend is created
+        jax.config.update("jax_cpu_collectives_implementation", cpu_collectives)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _INITIALIZED = True
+    logger.info(
+        "distributed control plane up: process %d/%d",
+        jax.process_index(),
+        jax.process_count(),
+    )
+
+
+def is_distributed() -> bool:
+    """True when more than one host process participates in the mesh."""
+    return jax.process_count() > 1
+
+
+def make_global_mesh(
+    axis_names: Sequence[str] = ("data",),
+    axis_shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Mesh over *all* global devices (every process's chips).
+
+    Contiguous-per-host device order, so a pure-DP ``data`` axis keeps each
+    host's shard of a batch on that host's own chips — host→device transfers
+    never cross DCN.
+    """
+    devices = np.asarray(jax.devices())
+    if axis_shape is None:
+        axis_shape = (devices.size,) + (1,) * (len(axis_names) - 1)
+    return Mesh(devices.reshape(tuple(axis_shape)), axis_names=tuple(axis_names))
+
+
+def host_shard_indices(n_rows: int, process_id: Optional[int] = None) -> np.ndarray:
+    """Row indices owned by this host: the strided shard ``pid::nprocs``
+    (the analog of Spark partitions pinned to their executors)."""
+    pid = jax.process_index() if process_id is None else process_id
+    return np.arange(pid, n_rows, jax.process_count())
+
+
+def global_batch(batch: Any, mesh: Mesh, axis: str = "data") -> Any:
+    """Assemble global arrays from each host's local shard of a batch.
+
+    Every leaf of ``batch`` is this host's rows of the global batch; the
+    result is a pytree of global ``jax.Array``s sharded along ``axis`` whose
+    leading dim is ``local_rows * num_processes``.
+    """
+    nprocs = jax.process_count()
+
+    def build(x):
+        x = np.asarray(x)
+        sharding = NamedSharding(mesh, P(*([axis] + [None] * (x.ndim - 1))))
+        global_shape = (x.shape[0] * nprocs,) + x.shape[1:]
+        return jax.make_array_from_process_local_data(sharding, x, global_shape)
+
+    return jax.tree_util.tree_map(build, batch)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Replicate host-local values onto every device of the global mesh.
+
+    Every process must hold the same values (e.g. params loaded from the
+    same model file) — this is how initial params/opt-state enter the
+    global-mesh training step.
+    """
+    sharding = NamedSharding(mesh, P())
+
+    def build(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(sharding, x, x.shape)
+
+    return jax.tree_util.tree_map(build, tree)
+
+
+def barrier(name: str = "sparkdl_barrier") -> None:
+    """Block until every process reaches this point (Spark stage-boundary
+    analog)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -1,0 +1,85 @@
+"""Subprocess worker for ``tests/test_multihost.py``.
+
+One process of an N-process multi-host training job on the virtual CPU
+platform: 4 local devices per process, gloo TCP collectives between
+processes (the CPU stand-in for ICI/DCN — SURVEY.md §4 "Implication",
+§5.8).  Runs ``KerasImageFileEstimator.fit`` end-to-end: per-host data
+shard loading, global-mesh shard_map step, cross-process gradient psum.
+
+Usage: ``python multihost_worker.py <pid> <nproc> <port> <workdir>``
+"""
+
+import json
+import os
+import sys
+
+
+def load_vector(uri):
+    import numpy as np
+
+    return np.load(uri)
+
+
+def main():
+    pid, nproc, port, workdir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    os.environ["KERAS_BACKEND"] = "jax"
+    import jax
+
+    # the axon sitecustomize may have imported jax already with the TPU
+    # platform pinned — force CPU through the live config (see conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+
+    from sparkdl_tpu.parallel import runner
+
+    runner.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=pid,
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 4 * nproc, jax.device_count()
+    assert runner.is_distributed()
+
+    import numpy as np
+
+    from sparkdl_tpu.estimators import KerasImageFileEstimator
+    from sparkdl_tpu.sql.session import TPUSession
+
+    with open(os.path.join(workdir, "meta.json")) as f:
+        meta = json.load(f)
+    spark = TPUSession.builder.master("local[*]").getOrCreate()
+    df = spark.createDataFrame(
+        [{"uri": u, "label": [float(l)]} for u, l in meta["rows"]]
+    )
+
+    est = KerasImageFileEstimator(
+        inputCol="uri",
+        outputCol="out",
+        labelCol="label",
+        imageLoader=load_vector,
+        modelFile=os.path.join(workdir, "model.keras"),
+        kerasOptimizer="sgd",
+        kerasLoss="mse",
+        kerasFitParams=meta["fit_params"],
+    )
+    fitted = est.fit(df)
+
+    import keras
+
+    m = keras.saving.load_model(fitted.getModelFile(), compile=False)
+    np.savez(
+        os.path.join(workdir, f"weights_proc{pid}.npz"),
+        *[np.asarray(w) for w in m.get_weights()],
+    )
+    runner.barrier("multihost_worker_done")
+    print(f"MULTIHOST_WORKER_OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
