@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Test runner — the reference's `python/run-tests.sh`† analog (SURVEY.md §2
+# "CI" row).  The reference script exported SPARK_HOME and the assembly jar
+# onto the classpath before running nose; here the equivalent environment is
+# the virtual 8-device CPU mesh (conftest.py re-asserts these, so running
+# bare pytest also works — this script is the pinned entry point).
+#
+# Usage:
+#   ./run-tests.sh              # full suite
+#   ./run-tests.sh -m 'not slow'  # skip multi-process tests
+#   ./run-tests.sh tests/test_sql.py  # one file
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export KERAS_BACKEND=jax
+export JAX_PLATFORMS=cpu
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+exec python -m pytest tests/ -q "$@"

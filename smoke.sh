@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Smoke check: the two driver contracts end-to-end.
+#   1. bench.py           — flagship featurizer throughput (one JSON line)
+#   2. dryrun_multichip   — 8-device mesh training step (forced-CPU subprocess)
+# Exits non-zero if either fails.  (CI analog of the reference's Travis
+# smoke stage — SURVEY.md §2 "CI" row.)
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== dryrun_multichip(8) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('MULTICHIP OK')"
+
+echo "== bench =="
+python bench.py
+
+echo "SMOKE OK"

@@ -209,14 +209,14 @@ class KerasImageFileEstimator(
         # count: the largest host shard, padded up to whole local batches
         max_local_rows = -(-n_global // nprocs)
         steps_per_epoch = max(1, -(-max_local_rows // local_bs))
-        if distributed and not weighted and n_global % nprocs:
+        if not weighted and max_local_rows % local_bs:
             logger.warning(
-                "custom loss without a per-sample form: uneven host shards "
-                "(%d rows / %d hosts) train on duplicate-padded rows at "
-                "full weight, slightly over-weighting the smaller hosts' "
-                "rows; use a named loss for exact zero-weight padding",
-                n_global,
-                nprocs,
+                "custom loss without a per-sample form: ragged batches "
+                "(%d rows/host, local batch %d) train duplicate-padded "
+                "rows at full weight, slightly over-weighting them; use a "
+                "named loss for exact zero-weight padding",
+                max_local_rows,
+                local_bs,
             )
         rng = np.random.RandomState((seed * 7919 + jax.process_index()) % 2**32)
         last_loss = None

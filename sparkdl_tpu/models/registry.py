@@ -142,9 +142,11 @@ getKerasApplicationModel = get_keras_application_model
 def decode_predictions(preds, top: int = 5):
     """``imagenet_utils.decode_predictions`` analog.
 
-    Uses Keras's cached class index when available; otherwise falls back to
-    synthetic ``class_<idx>`` labels (this environment has no network).
-    Accepts logits or probabilities, shape (batch, 1000).
+    Label priority: Keras's cached ``imagenet_class_index.json`` (real
+    wnids + names) when present, else the vendored class-name list
+    (:mod:`sparkdl_tpu.models.imagenet_labels` — real names, synthetic
+    wnid placeholders; no network needed).  Accepts logits or
+    probabilities, shape (batch, 1000).
     """
     import numpy as np
 
@@ -163,15 +165,21 @@ def decode_predictions(preds, top: int = 5):
     except Exception:
         class_index = None
 
+    from sparkdl_tpu.models.imagenet_labels import IMAGENET_CLASS_NAMES
+
     results = []
     for row in preds:
         top_idx = row.argsort()[-top:][::-1]
         entries = []
+        is_imagenet_shaped = row.shape[-1] == 1000
         for i in top_idx:
-            if class_index is not None:
-                wnid, label = class_index[str(int(i))]
+            i = int(i)
+            if class_index is not None and is_imagenet_shaped:
+                wnid, label = class_index[str(i)]
+            elif is_imagenet_shaped and i < len(IMAGENET_CLASS_NAMES):
+                wnid, label = f"n{i:08d}", IMAGENET_CLASS_NAMES[i]
             else:
-                wnid, label = f"n{int(i):08d}", f"class_{int(i)}"
+                wnid, label = f"n{i:08d}", f"class_{i}"
             entries.append((wnid, label, float(row[i])))
         results.append(entries)
     return results

@@ -91,7 +91,13 @@ class KerasImageFileTransformer(
             if not uris:
                 out[output_col] = []
                 return out
-            arrays = [np.asarray(loader(u), dtype=np.float32) for u in uris]
+            from sparkdl_tpu.utils.metrics import metrics
+
+            with metrics.timer("sparkdl.load").time():
+                arrays = [
+                    np.asarray(loader(u), dtype=np.float32) for u in uris
+                ]
+            metrics.counter("sparkdl.images_processed").add(len(arrays))
             shapes = {a.shape for a in arrays}
             if len(shapes) > 1:
                 raise ValueError(

@@ -224,12 +224,16 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
             if not rows:
                 out[output_col] = []
                 return out
-            images = [
-                normalize_channels(
-                    imageIO.imageStructToArray(r).astype(np.float32), 3
-                )
-                for r in rows
-            ]
+            from sparkdl_tpu.utils.metrics import metrics
+
+            with metrics.timer("sparkdl.decode").time():
+                images = [
+                    normalize_channels(
+                        imageIO.imageStructToArray(r).astype(np.float32), 3
+                    )
+                    for r in rows
+                ]
+            metrics.counter("sparkdl.images_processed").add(len(images))
             shapes = {img.shape for img in images}
             if len(shapes) > 1:
                 # mixed sizes: normalize per source-shape group first so the

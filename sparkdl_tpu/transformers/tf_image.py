@@ -146,14 +146,18 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol, HasOutputMode):
                 out = dict(part)
                 out[output_col] = []
                 return out
+            from sparkdl_tpu.utils.metrics import metrics
+
             n_channels = 1 if order == "L" else 3
-            images = [
-                normalize_channels(
-                    imageIO.imageStructToArray(r).astype(np.float32),
-                    n_channels,
-                )
-                for r in rows
-            ]
+            with metrics.timer("sparkdl.decode").time():
+                images = [
+                    normalize_channels(
+                        imageIO.imageStructToArray(r).astype(np.float32),
+                        n_channels,
+                    )
+                    for r in rows
+                ]
+            metrics.counter("sparkdl.images_processed").add(len(images))
             if size is not None:
                 batch = device_resize(images, size)
             else:

@@ -16,6 +16,7 @@ rules applied here:
 
 from __future__ import annotations
 
+import logging
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -23,6 +24,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_BATCH_SIZE = 32
 
@@ -93,7 +96,17 @@ def device_resize(
     the host is how a TPU input pipeline stays fed (the reference likewise
     resized per-row on CPU — ``ImageUtils.scala``†).
     """
+    from sparkdl_tpu.utils.metrics import metrics
+
     height, width = int(size[0]), int(size[1])
+    resize_timer = metrics.timer("sparkdl.resize")
+    with resize_timer.time():
+        return _device_resize_timed(images, height, width)
+
+
+def _device_resize_timed(
+    images: Sequence[np.ndarray], height: int, width: int
+) -> np.ndarray:
     out: List[Optional[np.ndarray]] = [None] * len(images)
     groups: Dict[Tuple[int, ...], List[int]] = {}
     for i, img in enumerate(images):
@@ -142,27 +155,37 @@ def run_batched_multi(
 
     Returns one concatenated array per function output.
     """
+    from sparkdl_tpu.utils.metrics import metrics
+    from sparkdl_tpu.utils.profiler import maybe_trace
+
     n = arrays[0].shape[0]
     if n == 0:
         raise ValueError("run_batched requires non-empty inputs")
     collected: Optional[List[List[np.ndarray]]] = None
-    for lo in range(0, n, batch_size):
-        chunks = [a[lo : lo + batch_size] for a in arrays]
-        k = chunks[0].shape[0]
-        if k < batch_size:
-            chunks = [
-                np.concatenate(
-                    [c, np.repeat(c[-1:], batch_size - k, axis=0)], axis=0
-                )
-                for c in chunks
-            ]
-        results = fn(*[jnp.asarray(c) for c in chunks])
-        if not isinstance(results, (tuple, list)):
-            results = (results,)
-        if collected is None:
-            collected = [[] for _ in results]
-        for acc, r in zip(collected, results):
-            acc.append(np.asarray(jax.device_get(r))[:k])
+    forward_timer = metrics.timer("sparkdl.forward")
+    with maybe_trace(), forward_timer.time():
+        for lo in range(0, n, batch_size):
+            chunks = [a[lo : lo + batch_size] for a in arrays]
+            k = chunks[0].shape[0]
+            if k < batch_size:
+                chunks = [
+                    np.concatenate(
+                        [c, np.repeat(c[-1:], batch_size - k, axis=0)], axis=0
+                    )
+                    for c in chunks
+                ]
+            results = fn(*[jnp.asarray(c) for c in chunks])
+            if not isinstance(results, (tuple, list)):
+                results = (results,)
+            if collected is None:
+                collected = [[] for _ in results]
+            for acc, r in zip(collected, results):
+                acc.append(np.asarray(jax.device_get(r))[:k])
+    metrics.counter("sparkdl.rows_processed").add(n)
+    metrics.counter("sparkdl.batches_run").add(-(-n // batch_size))
+    rate = metrics.images_per_sec()
+    if rate:
+        logger.debug("run_batched: %d rows, %.1f rows/sec sustained", n, rate)
     assert collected is not None
     return tuple(np.concatenate(acc, axis=0) for acc in collected)
 

@@ -1,0 +1,9 @@
+"""Cross-cutting utilities: metrics registry + profiler hooks.
+
+The reference's ``python/sparkdl/utils/``† held the py4j JVM bridge
+(``jvmapi.py``†) — obviated here by the single-language control plane
+(SURVEY.md §2 native table).  What lives here instead is what the reference
+*lacked* and SURVEY.md §5.1/§5.5 ask for: first-class observability.
+"""
+
+from sparkdl_tpu.utils.metrics import metrics  # noqa: F401

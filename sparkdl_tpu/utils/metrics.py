@@ -1,0 +1,129 @@
+"""First-class metrics: counters + stage timers for the transform hot loop.
+
+The reference had no metrics subsystem at all — observability was the Spark
+UI plus stdlib logging (SURVEY.md §5.1, §5.5).  The north-star metric
+(images/sec/chip) lived nowhere in code.  Here it is a first-class counter:
+every batched transform advances ``sparkdl.images_processed`` and the
+per-stage timers (``load`` / ``resize`` / ``forward``), so
+``metrics.images_per_sec()`` reports the sustained rate of the current
+process without touching ``bench.py``.
+
+Thread-safe (transforms may run from CrossValidator worker threads).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Counter:
+    """Monotonic accumulator (count + optional value sum)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._updates = 0
+
+    def add(self, value: float = 1.0) -> None:
+        with self._lock:
+            self._value += value
+            self._updates += 1
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    @property
+    def updates(self) -> int:
+        with self._lock:
+            return self._updates
+
+
+class Timer:
+    """Accumulates wall-time over ``with timer.time():`` sections."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._seconds = 0.0
+        self._entries = 0
+
+    @contextmanager
+    def time(self):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            with self._lock:
+                self._seconds += elapsed
+                self._entries += 1
+
+    @property
+    def seconds(self) -> float:
+        with self._lock:
+            return self._seconds
+
+    @property
+    def entries(self) -> int:
+        with self._lock:
+            return self._entries
+
+
+class MetricsRegistry:
+    """Process-wide named counters/timers (Spark-accumulator analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._timers: Dict[str, Timer] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = Counter(name)
+            return self._counters[name]
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            if name not in self._timers:
+                self._timers[name] = Timer(name)
+            return self._timers[name]
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat dict of every counter value and timer total."""
+        with self._lock:
+            counters = dict(self._counters)
+            timers = dict(self._timers)
+        out: Dict[str, float] = {}
+        for name, c in counters.items():
+            out[name] = c.value
+        for name, t in timers.items():
+            out[name + ".seconds"] = t.seconds
+        return out
+
+    def images_per_sec(self) -> Optional[float]:
+        """Sustained rows/sec through the batched forward — the north-star
+        images/sec metric when the pipeline is an image transformer (tensor
+        transformers count their rows here too; the counter is honest about
+        that, hence its name)."""
+        n = self.counter("sparkdl.rows_processed").value
+        s = self.timer("sparkdl.forward").seconds
+        return (n / s) if (n and s) else None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+
+#: the process-wide registry
+metrics = MetricsRegistry()

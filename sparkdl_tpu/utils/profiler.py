@@ -1,0 +1,67 @@
+"""Opt-in ``jax.profiler`` trace capture around transforms.
+
+The reference shipped no profiling hooks (SURVEY.md §5.1 — observability
+was the Spark UI).  Here any transform can be wrapped in an XLA-level trace
+(viewable in TensorBoard / Perfetto):
+
+- programmatic: ``with profiler.trace("/tmp/trace"): transformer.transform(df)``
+- zero-code: set ``SPARKDL_PROFILE_DIR=/tmp/trace`` and every batched
+  transform captures into it (``maybe_trace`` is called inside the engine's
+  hot loop wrapper).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager, nullcontext
+
+# jax.profiler.trace is process-global and refuses to start twice, so the
+# first entrant wins and concurrent/nested sections run untraced (their
+# device work still lands in the active capture).
+_trace_lock = threading.Lock()
+_trace_active = False
+
+
+@contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace of the enclosed block into ``log_dir``.
+
+    Re-entrant/concurrent use degrades to a no-op instead of raising: only
+    one jax profiler capture can exist per process.
+    """
+    import jax
+
+    global _trace_active
+    with _trace_lock:
+        if _trace_active:
+            acquired = False
+        else:
+            _trace_active = True
+            acquired = True
+    if not acquired:
+        yield
+        return
+    try:
+        with jax.profiler.trace(str(log_dir)):
+            yield
+    finally:
+        with _trace_lock:
+            _trace_active = False
+
+
+def maybe_trace(log_dir=None):
+    """``trace(dir)`` if profiling is requested, else a no-op context.
+
+    ``log_dir`` defaults to the ``SPARKDL_PROFILE_DIR`` env var; profiling
+    is off when neither is set (the common case — zero overhead).
+    """
+    log_dir = log_dir or os.environ.get("SPARKDL_PROFILE_DIR")
+    return trace(log_dir) if log_dir else nullcontext()
+
+
+def annotate(name: str):
+    """Named sub-span inside an active trace (``TraceAnnotation`` analog)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

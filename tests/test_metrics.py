@@ -1,0 +1,114 @@
+"""Metrics/profiling subsystem tests (SURVEY.md §5.1/§5.5 — the
+observability the reference lacked)."""
+
+import glob
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.transformers.utils import device_resize, run_batched
+from sparkdl_tpu.utils import profiler
+from sparkdl_tpu.utils.metrics import MetricsRegistry, metrics
+
+
+def test_counter_and_timer_accumulate():
+    reg = MetricsRegistry()
+    reg.counter("c").add(3)
+    reg.counter("c").add(2)
+    assert reg.counter("c").value == 5
+    assert reg.counter("c").updates == 2
+    with reg.timer("t").time():
+        pass
+    snap = reg.snapshot()
+    assert snap["c"] == 5
+    assert snap["t.seconds"] >= 0
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+def test_counters_thread_safe():
+    reg = MetricsRegistry()
+
+    def bump():
+        for _ in range(1000):
+            reg.counter("x").add(1)
+
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("x").value == 8000
+
+
+def test_run_batched_advances_row_counter():
+    before = metrics.counter("sparkdl.rows_processed").value
+    before_s = metrics.timer("sparkdl.forward").seconds
+    x = np.random.RandomState(0).randn(10, 4).astype(np.float32)
+    run_batched(lambda a: a * 2.0, x, batch_size=4)
+    assert metrics.counter("sparkdl.rows_processed").value == before + 10
+    assert metrics.timer("sparkdl.forward").seconds > before_s
+    assert metrics.images_per_sec() is not None
+
+
+def test_device_resize_advances_stage_metrics():
+    before = metrics.timer("sparkdl.resize").entries
+    imgs = [np.zeros((6, 7, 3), np.float32), np.zeros((5, 4, 3), np.float32)]
+    out = device_resize(imgs, (8, 8))
+    assert out.shape == (2, 8, 8, 3)
+    assert metrics.timer("sparkdl.resize").entries == before + 1
+
+
+def test_image_transformer_advances_image_counter(tpu_session, image_dir):
+    """The flagship image path advances the first-class image counter and
+    the decode-stage timer (SURVEY.md §5.5 — images/sec as a real metric)."""
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.transformers.named_image import DeepImagePredictor
+
+    before = metrics.counter("sparkdl.images_processed").value
+    before_decode = metrics.timer("sparkdl.decode").entries
+    df = imageIO.readImages(image_dir, tpu_session, numPartitions=2)
+    n = df.count()
+    predictor = DeepImagePredictor(
+        inputCol="image",
+        outputCol="preds",
+        modelName="MobileNetV2",
+        modelWeights="random",
+    )
+    predictor.transform(df).collect()
+    assert metrics.counter("sparkdl.images_processed").value == before + n
+    assert metrics.timer("sparkdl.decode").entries > before_decode
+
+
+def test_trace_is_reentrant_safe(tmp_path):
+    with profiler.trace(str(tmp_path / "outer")):
+        # nested trace degrades to a no-op instead of raising
+        with profiler.trace(str(tmp_path / "inner")):
+            jnp.ones((4,)).sum().block_until_ready()
+
+
+def test_profiler_trace_writes_capture(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    with profiler.trace(log_dir):
+        with profiler.annotate("tiny_op"):
+            jnp.ones((8, 8)).sum().block_until_ready()
+    written = glob.glob(os.path.join(log_dir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in written), written
+
+
+def test_maybe_trace_env_gate(tmp_path, monkeypatch):
+    # off by default: no-op context
+    monkeypatch.delenv("SPARKDL_PROFILE_DIR", raising=False)
+    with profiler.maybe_trace():
+        pass
+    # on when env var set
+    log_dir = str(tmp_path / "envtrace")
+    monkeypatch.setenv("SPARKDL_PROFILE_DIR", log_dir)
+    with profiler.maybe_trace():
+        jnp.zeros((4,)).sum().block_until_ready()
+    written = glob.glob(os.path.join(log_dir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in written), written

@@ -119,3 +119,25 @@ def test_decode_predictions_fallback():
     assert len(out) == 1 and len(out[0]) == 2
     wnid, label, score = out[0][0]
     assert score == 5.0 and (label == "class_7" or wnid.startswith("n"))
+
+
+def test_decode_predictions_real_labels_offline():
+    """The vendored class-name list gives real ImageNet labels with no
+    network and no Keras cache (VERDICT round-1 item 9)."""
+    from sparkdl_tpu.models.imagenet_labels import IMAGENET_CLASS_NAMES
+
+    assert len(IMAGENET_CLASS_NAMES) == 1000
+    assert len(set(IMAGENET_CLASS_NAMES)) >= 998  # "crane"/"maillot" repeat
+
+    preds = np.zeros((2, 1000), dtype=np.float32)
+    preds[0, 281] = 9.0  # tabby
+    preds[0, 285] = 5.0  # Egyptian_cat
+    preds[1, 207] = 7.0  # golden_retriever
+    out = decode_predictions(preds, top=2)
+    labels = [[e[1] for e in row] for row in out]
+    assert labels[0] == ["tabby", "Egyptian_cat"]
+    assert labels[1][0] == "golden_retriever"
+    # non-1000-way outputs still fall back to synthetic names
+    small = np.zeros((1, 10), dtype=np.float32)
+    small[0, 4] = 1.0
+    assert decode_predictions(small, top=1)[0][0][1] == "class_4"
