@@ -15,3 +15,4 @@ from sparkdl_tpu.models.registry import (  # noqa: F401
     get_keras_application_model,
 )
 from sparkdl_tpu.models.keras_port import port_keras_weights  # noqa: F401
+from sparkdl_tpu.models.vit import VIT_VARIANTS, ViT  # noqa: F401

@@ -1,0 +1,127 @@
+"""Vision Transformer (ViT-B/16 family) in Flax — the stretch model family.
+
+The reference zoo is CNN-only (``keras_applications.py``† has no ViT); this
+model exists for the pod-scale fine-tune stretch config (SURVEY.md §7 step
+8, BASELINE.json config #5) and as the vehicle for tensor/sequence
+parallelism: unlike the CNNs, a ViT has a token axis, so its attention can
+run sequence-sharded (:mod:`sparkdl_tpu.parallel.context`) and its MLP/QKV
+projections tensor-sharded (:mod:`sparkdl_tpu.parallel.tp`).
+
+Architecture follows the original ViT (Dosovitskiy et al., ICLR 2021;
+public reference implementation google-research/vision_transformer):
+patchify conv, prepended CLS token, learned position embeddings,
+pre-LayerNorm encoder blocks, final LayerNorm; ``features_only`` returns
+the CLS embedding (the transfer-learning cut point, like the CNNs'
+``avg_pool``).
+
+``attn_impl`` switches the attention schedule without touching params:
+``"full"`` (dense, single device) or a callable ``(q, k, v) -> out`` — e.g.
+ring attention bound to a mesh axis — so the same checkpoint runs dense on
+one chip and sequence-parallel on a pod.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from sparkdl_tpu.parallel.context import full_attention
+
+# name -> (patch, dim, depth, heads, mlp_dim)
+VIT_VARIANTS = {
+    "ViT-Ti/16": (16, 192, 12, 3, 768),
+    "ViT-S/16": (16, 384, 12, 6, 1536),
+    "ViT-B/16": (16, 768, 12, 12, 3072),
+    "ViT-B/32": (32, 768, 12, 12, 3072),
+    "ViT-L/16": (16, 1024, 24, 16, 4096),
+}
+
+
+class ViTEncoderBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_dim: int
+    dtype: Optional[Any] = None
+    attn_impl: Union[str, Callable] = "full"
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, _ = x.shape
+        head_dim = self.dim // self.heads
+
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_1")(x)
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.heads, head_dim)
+        k = k.reshape(b, s, self.heads, head_dim)
+        v = v.reshape(b, s, self.heads, head_dim)
+        if callable(self.attn_impl):
+            attn = self.attn_impl(q, k, v)
+        else:
+            attn = full_attention(q, k, v)
+        attn = attn.reshape(b, s, self.dim)
+        x = x + nn.Dense(self.dim, dtype=self.dtype, name="proj")(attn)
+
+        y = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_up")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """``variant`` picks geometry; all params are explicit for tests."""
+
+    variant: str = "ViT-B/16"
+    num_classes: int = 1000
+    include_top: bool = True
+    dtype: Optional[Any] = None
+    attn_impl: Union[str, Callable] = "full"
+    image_size: int = 224
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, features_only: bool = False):
+        patch, dim, depth, heads, mlp_dim = VIT_VARIANTS[self.variant]
+        b = x.shape[0]
+
+        x = nn.Conv(
+            dim,
+            (patch, patch),
+            strides=(patch, patch),
+            padding="VALID",
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(b, -1, dim)  # (b, tokens, dim)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, dim), jnp.float32
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(x.dtype), (b, 1, dim)), x], axis=1
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, x.shape[1], dim),
+            jnp.float32,
+        )
+        x = x + pos.astype(x.dtype)
+
+        for i in range(depth):
+            x = ViTEncoderBlock(
+                dim=dim,
+                heads=heads,
+                mlp_dim=mlp_dim,
+                dtype=self.dtype,
+                attn_impl=self.attn_impl,
+                name=f"block_{i}",
+            )(x)
+
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        feats = x[:, 0]  # CLS token — the transfer-learning cut point
+        if features_only or not self.include_top:
+            return feats
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="head")(feats)

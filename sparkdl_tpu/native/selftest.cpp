@@ -1,0 +1,95 @@
+// ASAN/UBSAN self-test for the native bridge (run via `make asan`).
+// Exercises decode/normalize/flip/resize over heterogeneous rows, including
+// the boundary windows of the resize weights, under the sanitizers.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+int64_t sdl_pack_resize_batch(const uint8_t** datas, const int32_t* heights,
+                              const int32_t* widths, const int32_t* channels,
+                              const int32_t* modes, int64_t n, int32_t out_h,
+                              int32_t out_w, int32_t out_c,
+                              int32_t bgr_to_rgb, float* out,
+                              int32_t n_threads);
+int64_t sdl_resize_batch_f32(const float* src, int64_t n, int32_t h,
+                             int32_t w, int32_t c, int32_t out_h,
+                             int32_t out_w, float* out, int32_t n_threads);
+int32_t sdl_abi_version();
+}
+
+int main() {
+  if (sdl_abi_version() != 1) return 1;
+
+  // heterogeneous rows: uint8 gray, uint8 BGR, float BGRA, up/downscales
+  struct RowSpec {
+    int32_t h, w, c, mode;
+    bool f32;
+  };
+  const RowSpec specs[] = {
+      {17, 23, 1, 0, false},   // CV_8UC1
+      {64, 48, 3, 16, false},  // CV_8UC3
+      {9, 301, 4, 29, true},   // CV_32FC4
+      {224, 224, 3, 21, true}, // CV_32FC3 (no-resize path)
+  };
+  const int64_t n = 4;
+  const int32_t OH = 224, OW = 224, OC = 3;
+
+  std::vector<std::vector<uint8_t>> storage;
+  std::vector<const uint8_t*> datas;
+  std::vector<int32_t> hs, ws, cs, ms;
+  unsigned seed = 7;
+  for (const auto& s : specs) {
+    const int64_t elems = static_cast<int64_t>(s.h) * s.w * s.c;
+    std::vector<uint8_t> buf(elems * (s.f32 ? 4 : 1));
+    if (s.f32) {
+      float* f = reinterpret_cast<float*>(buf.data());
+      for (int64_t i = 0; i < elems; ++i) {
+        seed = seed * 1664525u + 1013904223u;
+        f[i] = static_cast<float>(seed % 255);
+      }
+    } else {
+      for (auto& b : buf) {
+        seed = seed * 1664525u + 1013904223u;
+        b = static_cast<uint8_t>(seed % 255);
+      }
+    }
+    storage.push_back(std::move(buf));
+    datas.push_back(storage.back().data());
+    hs.push_back(s.h);
+    ws.push_back(s.w);
+    cs.push_back(s.c);
+    ms.push_back(s.mode);
+  }
+
+  std::vector<float> out(n * OH * OW * OC, -1.0f);
+  int64_t rc = sdl_pack_resize_batch(datas.data(), hs.data(), ws.data(),
+                                     cs.data(), ms.data(), n, OH, OW, OC,
+                                     /*bgr_to_rgb=*/1, out.data(),
+                                     /*n_threads=*/3);
+  if (rc != 0) {
+    std::fprintf(stderr, "pack failed at row %lld\n",
+                 static_cast<long long>(rc));
+    return 2;
+  }
+  for (float v : out) {
+    if (!(v >= 0.0f && v <= 255.0f)) {
+      std::fprintf(stderr, "out of range value %f\n", v);
+      return 3;
+    }
+  }
+
+  // f32 batch resize, extreme aspect change
+  std::vector<float> src(2 * 7 * 150 * 3);
+  for (size_t i = 0; i < src.size(); ++i) src[i] = float(i % 100);
+  std::vector<float> rout(2 * 128 * 16 * 3, -1.0f);
+  rc = sdl_resize_batch_f32(src.data(), 2, 7, 150, 3, 128, 16, rout.data(),
+                            2);
+  if (rc != 0) return 4;
+
+  std::puts("native selftest OK");
+  return 0;
+}

@@ -1,0 +1,113 @@
+"""Tensor parallelism: rule-based param sharding + GSPMD train step.
+
+The reference has no TP at all (SURVEY.md §2 parallelism table) — this is
+the TPU-native capability the stretch ViT config needs.  Design is the
+idiomatic XLA one (scaling-book recipe): pick a mesh, annotate param
+shardings with ``NamedSharding`` rules, jit — the compiler inserts the
+all-gathers/reduce-scatters over ICI.  No hand-written collectives.
+
+Megatron-style block sharding for a transformer:
+
+- ``qkv`` / ``mlp_up`` kernels: split the *output* feature dim over
+  ``model`` (column parallel) — activations stay sharded per head/neuron;
+- ``proj`` / ``mlp_down`` kernels: split the *input* feature dim
+  (row parallel) — XLA emits one psum per block to restore the residual;
+- everything else (LN scales, embeddings, biases of row-parallel layers):
+  replicated.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import optax
+
+from sparkdl_tpu.parallel.trainer import TrainState, init_train_state
+
+#: (path regex, PartitionSpec builder) rules for a ViT encoder, Megatron
+#: column/row-parallel layout over the ``model`` axis.
+VIT_TP_RULES: List[Tuple[str, Callable[[str], P]]] = [
+    (r".*/(qkv|mlp_up)/kernel$", lambda axis: P(None, axis)),
+    (r".*/(qkv|mlp_up)/bias$", lambda axis: P(axis)),
+    (r".*/(proj|mlp_down)/kernel$", lambda axis: P(axis, None)),
+]
+
+
+def param_path_specs(
+    params: Any,
+    rules: Sequence[Tuple[str, Callable[[str], P]]],
+    model_axis: str = "model",
+) -> Any:
+    """Map every param leaf to a PartitionSpec via the first matching
+    ``/``-joined-path rule (unmatched leaves replicate)."""
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def spec_for(path) -> P:
+        name = "/".join(
+            getattr(k, "key", getattr(k, "idx", str(k))).__str__()
+            for k in path
+        )
+        for pattern, build in rules:
+            if re.match(pattern, name):
+                return build(model_axis)
+        return P()
+
+    specs = {jax.tree_util.keystr(p): spec_for(p) for p, _ in flat}
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: specs[jax.tree_util.keystr(p)], params
+    )
+
+
+def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:
+    """Place params onto the mesh per their specs (GSPMD annotations)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def make_tp_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    param_specs: Any,
+    data_axis: str = "data",
+    donate: bool = True,
+):
+    """DP x TP training step via GSPMD: batch sharded on ``data_axis``,
+    params per ``param_specs``; XLA inserts every collective (grad psum over
+    data, activation gathers/reduce-scatters over model).
+
+    ``loss_fn(params, batch) -> scalar`` written as if single-device —
+    that is the point of the GSPMD design.  Input shardings (from
+    :func:`init_tp_train_state`'s placed arrays) seed the propagation;
+    ``param_specs``/``mesh``/``data_axis`` are part of the signature for
+    callers that pre-place batches explicitly.
+    """
+    del mesh, param_specs, data_axis  # shardings ride on the input arrays
+
+    def step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1, state.batch_stats), loss
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def init_tp_train_state(
+    params: Any,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    param_specs: Any,
+) -> TrainState:
+    """Shard params per specs, then init the optimizer *on the sharded
+    params* so moment buffers inherit the same layout (no replicated Adam
+    moments for sharded weights)."""
+    sharded = shard_params(params, mesh, param_specs)
+    return init_train_state(sharded, tx)

@@ -34,8 +34,8 @@ from sparkdl_tpu.param.shared import HasInputCol, HasOutputCol
 from sparkdl_tpu.sql.types import Row
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
-    device_resize,
-    normalize_channels,
+    cast_and_resize_on_device,
+    decode_image_batch,
     place_params,
     run_batched,
 )
@@ -193,14 +193,12 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
         preprocess = entry.preprocess
 
         def forward(x):
-            # x: float32 NHWC, stored (Spark) BGR order, source size — the
-            # whole pipeline below fuses into one XLA program.
+            # x: uint8 or float32 NHWC, stored (Spark) BGR order, source
+            # size — cast, flip, resize, preprocess and CNN all fuse into
+            # one XLA program (uint8 ingest quarters host->device bytes).
+            x = cast_and_resize_on_device(x, (height, width))
             if x.shape[-1] == 3:
                 x = x[..., ::-1]  # BGR -> RGB
-            if x.shape[1] != height or x.shape[2] != width:
-                x = jax.image.resize(
-                    x, (x.shape[0], height, width, x.shape[3]), "bilinear"
-                )
             x = preprocess(x)
             out = module.apply(
                 variables, x.astype(dtype), features_only=featurize
@@ -224,25 +222,13 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
             if not rows:
                 out[output_col] = []
                 return out
-            from sparkdl_tpu.utils.metrics import metrics
-
-            with metrics.timer("sparkdl.decode").time():
-                images = [
-                    normalize_channels(
-                        imageIO.imageStructToArray(r).astype(np.float32), 3
-                    )
-                    for r in rows
-                ]
-            metrics.counter("sparkdl.images_processed").add(len(images))
-            shapes = {img.shape for img in images}
-            if len(shapes) > 1:
-                # mixed sizes: normalize per source-shape group first so the
-                # model batch has one static shape
-                batch = device_resize(images, (height, width))
-            else:
-                # uniform size: feed at source size — resize, preprocess and
-                # CNN fuse into the one jitted forward program
-                batch = np.stack(images)
+            # uniform-size partitions pack at source size — as uint8 when
+            # the rows allow (cast, resize, preprocess and CNN fuse into
+            # the one jitted forward program); mixed-size partitions
+            # resize-while-packing (native bridge when available)
+            batch = decode_image_batch(
+                rows, 3, (height, width), prefer_uint8=True
+            )
             result = run_batched(forward, batch, batch_size)
             out[output_col] = self._postprocess(result)
             return out

@@ -32,8 +32,8 @@ from sparkdl_tpu.param.shared import (
 )
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
-    device_resize,
-    normalize_channels,
+    cast_and_resize_on_device,
+    decode_image_batch,
     place_params,
     run_batched,
 )
@@ -133,6 +133,10 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol, HasOutputMode):
         want_bgr = order == "BGR"
 
         def model_fn(x):
+            # cast + resize + flip fuse with the fn into one program (so
+            # uint8 source-size batches work — link bytes are the serving
+            # bottleneck)
+            x = cast_and_resize_on_device(x, size)
             # stored order is BGR; flip on device if the fn wants RGB
             if not want_bgr and x.shape[-1] == 3:
                 x = x[..., ::-1]
@@ -146,22 +150,10 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol, HasOutputMode):
                 out = dict(part)
                 out[output_col] = []
                 return out
-            from sparkdl_tpu.utils.metrics import metrics
-
             n_channels = 1 if order == "L" else 3
-            with metrics.timer("sparkdl.decode").time():
-                images = [
-                    normalize_channels(
-                        imageIO.imageStructToArray(r).astype(np.float32),
-                        n_channels,
-                    )
-                    for r in rows
-                ]
-            metrics.counter("sparkdl.images_processed").add(len(images))
-            if size is not None:
-                batch = device_resize(images, size)
-            else:
-                batch = np.stack(images)
+            batch = decode_image_batch(
+                rows, n_channels, size, prefer_uint8=True
+            )
             result = run_batched(jitted, batch, batch_size)
             out = dict(part)
             if mode == "vector":

@@ -28,15 +28,18 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
 from sparkdl_tpu.graph.function import XlaFunction
 from sparkdl_tpu.image import imageIO
 from sparkdl_tpu.ml.linalg import DenseVector
 from sparkdl_tpu.sql.functions import UserDefinedFunction
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
-    device_resize,
+    cast_and_resize_on_device,
+    decode_image_batch,
     load_keras_function,
-    normalize_channels,
     place_params,
     run_batched,
 )
@@ -67,6 +70,10 @@ def registerKerasImageUDF(
     inner = fn._jitted()
 
     def forward(x):
+        # cast + resize fuse with the model into one device program, so
+        # batches arrive at source size (uint8 when possible — the
+        # host->device link is the serving path's bottleneck)
+        x = cast_and_resize_on_device(x, size)
         return inner(params, x)[0]
 
     def evaluate(values):
@@ -86,24 +93,19 @@ def registerKerasImageUDF(
                 )
             batch = np.stack(arrays)
         else:
-            arrays = [
-                normalize_channels(
-                    imageIO.imageStructToArray(v).astype(np.float32), 3
-                )[..., ::-1]  # stored BGR -> model RGB
-                for v in values
-            ]
-            if size is not None:
-                batch = device_resize(arrays, size)
-            else:
-                shapes = {a.shape for a in arrays}
-                if len(shapes) > 1:
-                    raise ValueError(
-                        f"UDF {udfName!r}: model input size is dynamic and "
-                        f"the column holds mixed shapes {sorted(shapes)}; "
-                        "resize in a preprocessor or use a fixed-input-size "
-                        "model"
-                    )
-                batch = np.stack(arrays)
+            try:
+                # stored BGR -> model RGB while packing; uniform partitions
+                # pack at source size (uint8 when possible — the forward
+                # resizes on device); mixed shapes resize-while-packing
+                batch = decode_image_batch(
+                    values, 3, size, to_rgb=True, prefer_uint8=True
+                )
+            except ValueError as e:
+                raise ValueError(
+                    f"UDF {udfName!r}: model input size is dynamic and "
+                    "the column holds mixed shapes; resize in a "
+                    "preprocessor or use a fixed-input-size model"
+                ) from e
         result = run_batched(forward, batch, batchSize)
         flat = result.reshape(result.shape[0], -1).astype(np.float64)
         return [DenseVector(v) for v in flat]

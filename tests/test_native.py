@@ -1,0 +1,167 @@
+"""Native columnar-bridge tests: C++ path ≡ Python path (oracle pattern,
+SURVEY.md §4) plus the jax.image.resize numerical-parity contract that keeps
+host-packed batches interchangeable with device-resized ones."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu import native
+from sparkdl_tpu.image import imageIO
+from sparkdl_tpu.transformers.utils import (
+    decode_image_batch,
+    normalize_channels,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.is_available(), reason="native bridge unavailable (no g++?)"
+)
+
+
+def _python_pack(rows, n_channels, out_hw, to_rgb):
+    imgs = [
+        normalize_channels(
+            imageIO.imageStructToArray(r).astype(np.float32), n_channels
+        )
+        for r in rows
+    ]
+    if to_rgb and n_channels >= 3:
+        imgs = [i[..., ::-1] for i in imgs]
+    resized = [
+        np.asarray(
+            jax.image.resize(
+                jnp.asarray(i),
+                (out_hw[0], out_hw[1], i.shape[-1]),
+                method="bilinear",
+            )
+        )
+        if i.shape[:2] != tuple(out_hw)
+        else i
+        for i in imgs
+    ]
+    return np.stack(resized)
+
+
+def _rows(rng):
+    """Heterogeneous structs: uint8 gray/BGR/BGRA + float32 BGR, mixed sizes."""
+    rows = []
+    rows.append(
+        imageIO.imageArrayToStruct(
+            rng.randint(0, 255, (40, 50), dtype=np.uint8).astype(np.uint8)
+        )
+    )
+    rows.append(
+        imageIO.imageArrayToStruct(
+            rng.randint(0, 255, (64, 48, 3), dtype=np.uint8).astype(np.uint8)
+        )
+    )
+    rows.append(
+        imageIO.imageArrayToStruct(
+            rng.randint(0, 255, (30, 31, 4), dtype=np.uint8).astype(np.uint8)
+        )
+    )
+    rows.append(
+        imageIO.imageArrayToStruct(
+            (rng.rand(100, 80, 3) * 255).astype(np.float32)
+        )
+    )
+    return rows
+
+
+def test_pack_matches_python_path_rgb3():
+    rng = np.random.RandomState(0)
+    rows = _rows(rng)
+    got = native.pack_image_rows(rows, (56, 72), 3, bgr_to_rgb=True)
+    want = _python_pack(rows, 3, (56, 72), to_rgb=True)
+    np.testing.assert_allclose(got, want, atol=2e-2)
+
+
+def test_pack_matches_python_path_gray():
+    rng = np.random.RandomState(1)
+    rows = _rows(rng)
+    got = native.pack_image_rows(rows, (33, 44), 1, bgr_to_rgb=False)
+    want = _python_pack(rows, 1, (33, 44), to_rgb=False)
+    np.testing.assert_allclose(got, want, atol=2e-2)
+
+
+def test_pack_no_resize_is_exact():
+    rng = np.random.RandomState(2)
+    arr = rng.randint(0, 255, (25, 35, 3), dtype=np.uint8)
+    rows = [imageIO.imageArrayToStruct(arr.astype(np.uint8))] * 3
+    got = native.pack_image_rows(rows, (25, 35), 3, bgr_to_rgb=False)
+    want = np.stack([arr.astype(np.float32)] * 3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_resize_batch_matches_jax_bilinear():
+    rng = np.random.RandomState(3)
+    for (h, w), (oh, ow) in [((60, 80), (299, 299)), ((400, 300), (128, 96))]:
+        x = (rng.rand(2, h, w, 3) * 255).astype(np.float32)
+        got = native.resize_batch(x, (oh, ow))
+        want = np.asarray(
+            jax.image.resize(jnp.asarray(x), (2, oh, ow, 3), method="bilinear")
+        )
+        np.testing.assert_allclose(got, want, atol=1e-2)
+
+
+def test_decode_image_batch_uses_native_and_matches(monkeypatch):
+    """decode_image_batch gives identical results with the bridge on and off
+    (partition-invariance contract of the hot path)."""
+    rng = np.random.RandomState(4)
+    rows = _rows(rng)
+    with_native = decode_image_batch(rows, 3, (48, 48), to_rgb=True)
+    monkeypatch.setenv("SPARKDL_NO_NATIVE", "1")
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", False)
+    without = decode_image_batch(rows, 3, (48, 48), to_rgb=True)
+    monkeypatch.setattr(native, "_tried", False)
+    np.testing.assert_allclose(with_native, without, atol=2e-2)
+
+
+def test_unknown_mode_falls_back_to_python_error():
+    bad = dict(
+        origin="", height=4, width=4, nChannels=3, mode=99,
+        data=bytes(4 * 4 * 3),
+    )
+    from sparkdl_tpu.sql.types import Row
+
+    with pytest.raises(KeyError):
+        decode_image_batch([Row(**bad)], 3, (8, 8))
+
+
+def test_uint8_pack_native_and_python():
+    """uint8 fast path: source-size uint8 rows pack to a uint8 batch with
+    identical bytes from the native and Python paths (link-byte saver)."""
+    rng = np.random.RandomState(5)
+    arrs = [rng.randint(0, 255, (20, 24, 3), dtype=np.uint8) for _ in range(4)]
+    rows = [imageIO.imageArrayToStruct(a) for a in arrs]
+
+    got = native.pack_image_rows_u8(rows, (20, 24), 3, bgr_to_rgb=True)
+    assert got is not None and got.dtype == np.uint8
+    want = np.stack([a[..., ::-1] for a in arrs])
+    np.testing.assert_array_equal(got, want)
+
+    # decode_image_batch returns the uint8 batch when the caller opts in
+    batch = decode_image_batch(rows, 3, (64, 64), to_rgb=True, prefer_uint8=True)
+    assert batch.dtype == np.uint8
+    np.testing.assert_array_equal(batch, want)
+    # and float when a resize is required
+    mixed = rows + [
+        imageIO.imageArrayToStruct(
+            rng.randint(0, 255, (10, 12, 3), dtype=np.uint8)
+        )
+    ]
+    fbatch = decode_image_batch(mixed, 3, (16, 16), to_rgb=True, prefer_uint8=True)
+    assert fbatch.dtype == np.float32 and fbatch.shape == (5, 16, 16, 3)
+
+
+def test_uint8_pack_rejects_float_rows():
+    rng = np.random.RandomState(6)
+    rows = [
+        imageIO.imageArrayToStruct((rng.rand(8, 8, 3) * 255).astype(np.float32))
+    ]
+    assert native.pack_image_rows_u8(rows, (8, 8), 3) is None
+    batch = decode_image_batch(rows, 3, None, prefer_uint8=True)
+    assert batch.dtype == np.float32

@@ -1,0 +1,162 @@
+"""ViT model + tensor-parallel training tests (8-device CPU mesh).
+
+Oracles per SURVEY.md §4: the sharded/SP variants must reproduce the plain
+single-device forward and training step on the same arrays.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import optax
+
+from sparkdl_tpu.models.vit import VIT_VARIANTS, ViT
+from sparkdl_tpu.parallel.context import ring_attention
+from sparkdl_tpu.parallel.tp import (
+    VIT_TP_RULES,
+    init_tp_train_state,
+    make_tp_train_step,
+    param_path_specs,
+)
+from sparkdl_tpu.parallel.trainer import init_train_state
+
+# tiny geometry so CPU tests stay fast; same code path as ViT-B/16
+TINY = "ViT-Ti/16"
+IMG = 32
+
+
+def _tiny_vit(**kw):
+    return ViT(variant=TINY, num_classes=4, image_size=IMG, **kw)
+
+
+def _variables(module, seed=0):
+    x = jnp.zeros((1, IMG, IMG, 3), jnp.float32)
+    return module.init(jax.random.PRNGKey(seed), x)
+
+
+def test_vit_shapes_and_features():
+    m = _tiny_vit()
+    v = _variables(m)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, IMG, IMG, 3), jnp.float32)
+    logits = m.apply(v, x)
+    feats = m.apply(v, x, features_only=True)
+    dim = VIT_VARIANTS[TINY][1]
+    assert logits.shape == (2, 4)
+    assert feats.shape == (2, dim)
+
+
+def test_vit_b16_geometry():
+    """The flagship stretch variant builds with the published geometry."""
+    patch, dim, depth, heads, mlp = VIT_VARIANTS["ViT-B/16"]
+    assert (patch, dim, depth, heads, mlp) == (16, 768, 12, 12, 3072)
+    m = ViT(variant="ViT-B/16", image_size=224)
+    shapes = jax.eval_shape(
+        m.init, jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3), jnp.float32)
+    )
+    n_params = sum(
+        np.prod(l.shape) for l in jax.tree_util.tree_leaves(shapes)
+    )
+    assert 85e6 < n_params < 90e6  # ViT-B/16 is ~86M params
+
+
+def test_vit_sp_attention_matches_full():
+    """Same params, attention swapped to sequence-parallel ring over an
+    8-way seq axis: forward must match the dense forward (the checkpoint
+    is schedule-independent).  A ViT's CLS token breaks seq divisibility by
+    design, so the SP schedule is pad_tokens_for_sp (pad + mask + slice)."""
+    from sparkdl_tpu.parallel.context import pad_tokens_for_sp
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("seq",))
+
+    dense = _tiny_vit()
+    v = _variables(dense)
+    x = jnp.asarray(np.random.RandomState(1).rand(2, IMG, IMG, 3), jnp.float32)
+    want = dense.apply(v, x, features_only=True)
+
+    sp = _tiny_vit(attn_impl=pad_tokens_for_sp(mesh, "seq", "ring"))
+    got = sp.apply(v, x, features_only=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=5e-4, rtol=1e-4
+    )
+
+
+def test_pad_tokens_for_sp_masks_pad_keys():
+    """Zero-padded K rows would otherwise grab exp(0) softmax mass — the
+    padded schedule must mask them (kv_len), reproducing dense attention
+    on a 10-token sequence over an 8-way ring exactly."""
+    from sparkdl_tpu.parallel.context import full_attention, pad_tokens_for_sp
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("seq",))
+    rng = np.random.RandomState(2)
+    q, k, v = (
+        jnp.asarray(rng.randn(1, 10, 8, 8).astype(np.float32))
+        for _ in range(3)
+    )
+    want = full_attention(q, k, v)
+    for impl in ("ring", "ulysses"):
+        got = pad_tokens_for_sp(mesh, "seq", impl)(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+
+
+def test_tp_train_step_matches_single_device():
+    """DP x TP GSPMD step == unsharded step: same loss trajectory."""
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ("data", "model"))
+    module = _tiny_vit()
+    variables = _variables(module)
+    tx = optax.sgd(0.05)
+
+    rng = np.random.RandomState(3)
+    x = rng.rand(8, IMG, IMG, 3).astype(np.float32)
+    y = rng.randint(0, 4, 8)
+
+    def loss_fn(params, batch):
+        logits = module.apply(params, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]
+        ).mean()
+
+    # oracle: plain single-device training loop
+    state = init_train_state(variables, tx)
+
+    from sparkdl_tpu.parallel.trainer import TrainState
+
+    @jax.jit
+    def plain_step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params, opt_state, state.step + 1, state.batch_stats),
+            loss,
+        )
+
+    batch = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+    losses_plain = []
+    for _ in range(3):
+        state, loss = plain_step(state, batch)
+        losses_plain.append(float(loss))
+
+    # TP: shard params by Megatron rules, batch by data axis
+    specs = param_path_specs(variables, VIT_TP_RULES, model_axis="model")
+    tp_state = init_tp_train_state(variables, tx, mesh, specs)
+    step_fn = make_tp_train_step(loss_fn, tx, mesh, specs)
+    data_sharding = NamedSharding(mesh, P("data"))
+    tp_batch = {
+        "x": jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data", None, None, None))),
+        "y": jax.device_put(jnp.asarray(y), data_sharding),
+    }
+    losses_tp = []
+    for _ in range(3):
+        tp_state, loss = step_fn(tp_state, tp_batch)
+        losses_tp.append(float(loss))
+
+    np.testing.assert_allclose(losses_tp, losses_plain, rtol=2e-4, atol=2e-5)
+
+    # and the sharded params really are sharded over the model axis
+    qkv_kernel = tp_state.params["params"]["block_0"]["qkv"]["kernel"]
+    assert qkv_kernel.sharding.spec == P(None, "model")
