@@ -7,6 +7,10 @@ collected numpy), this package trains data-parallel over a TPU mesh via
 ``sparkdl_tpu.parallel``.
 """
 
+from sparkdl_tpu.estimators.flax_image_file_estimator import (  # noqa: F401
+    FlaxImageFileEstimator,
+    FlaxImageFileTransformer,
+)
 from sparkdl_tpu.estimators.keras_image_file_estimator import (  # noqa: F401
     KerasImageFileEstimator,
 )

@@ -1,0 +1,59 @@
+"""Shared estimator data plane: collect rows, shard per host, load images.
+
+One implementation of the collect → per-host strided shard → threaded
+``imageLoader`` flow (reference ``_getNumpyFeaturesAndLabels``†, SURVEY.md
+§3.2) for every estimator, so shard/loader behavior cannot drift between
+them.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Tuple
+
+import numpy as np
+
+import jax
+
+from sparkdl_tpu.parallel import runner
+
+
+def load_host_shard(
+    dataset,
+    input_col: str,
+    label_col: str,
+    loader: Callable[[str], Any],
+    max_workers: int = 16,
+) -> Tuple[np.ndarray, List[Any], int]:
+    """Collect (URI, label) rows, keep this host's strided shard, load
+    images via ``loader`` in a thread pool.
+
+    Returns ``(x, labels, n_global)`` — ``x`` stacked float32, ``labels``
+    the raw label values (caller owns dtype policy), ``n_global`` the
+    pre-shard row count.  Fails fast (identically on every process) when a
+    multi-host run has fewer rows than hosts, so no peer deadlocks inside a
+    collective waiting for a crashed host.
+    """
+    rows = dataset.select(input_col, label_col).collect()
+    if not rows:
+        raise ValueError("fit() received an empty dataset")
+    n_global = len(rows)
+    if runner.is_distributed():
+        nprocs = jax.process_count()
+        if n_global < nprocs:
+            raise ValueError(
+                f"fit() needs at least one row per host: got {n_global} "
+                f"rows across {nprocs} processes"
+            )
+        keep = runner.host_shard_indices(n_global)
+        rows = [rows[i] for i in keep]
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        images = list(
+            pool.map(
+                lambda r: np.asarray(loader(r[input_col]), dtype=np.float32),
+                rows,
+            )
+        )
+    x = np.stack(images)
+    labels = [r[label_col] for r in rows]
+    return x, labels, n_global

@@ -1,0 +1,346 @@
+"""FlaxImageFileEstimator — fine-tune a Flax module over image files.
+
+The ViT stretch config's estimator (SURVEY.md §7 step 8): same param
+surface and outer flow as :class:`KerasImageFileEstimator` (imageLoader /
+optimizer / loss / fitParams; collect URIs, load via the user's loader,
+train, return a fitted transformer — no mid-training checkpointing yet),
+but the model is a ``flax.linen.Module`` — e.g.
+``sparkdl_tpu.models.ViT(variant="ViT-B/16")``
+— so the training step can also run tensor-parallel: pass
+``shardingRules`` (e.g. ``sparkdl_tpu.parallel.tp.VIT_TP_RULES``) and the
+step becomes the GSPMD DP x TP program over a ``("data", "model")`` mesh
+instead of pure shard_map DP.
+
+The fitted model is a :class:`FlaxImageFileTransformer` running the tuned
+params through one jitted program (same hot loop as every other
+transformer).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.estimators.data import load_host_shard
+from sparkdl_tpu.estimators.losses import (
+    get_optimizer,
+    get_per_sample_loss_fn,
+)
+from sparkdl_tpu.ml.base import Estimator, Transformer
+from sparkdl_tpu.ml.linalg import DenseVector
+from sparkdl_tpu.param.base import Param, keyword_only
+from sparkdl_tpu.param.shared import (
+    CanLoadImage,
+    HasInputCol,
+    HasLabelCol,
+    HasOutputCol,
+)
+from sparkdl_tpu.parallel.trainer import (
+    init_train_state,
+    make_mesh,
+    make_train_step,
+    shard_batch,
+)
+from sparkdl_tpu.transformers.utils import (
+    DEFAULT_BATCH_SIZE,
+    place_params,
+    run_batched,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class FlaxImageFileTransformer(
+    Transformer, HasInputCol, HasOutputCol, CanLoadImage
+):
+    """Fitted model: user loader -> one jitted ``module.apply`` program."""
+
+    def __init__(
+        self,
+        inputCol: str,
+        outputCol: str,
+        imageLoader,
+        module,
+        variables,
+        batchSize: int = DEFAULT_BATCH_SIZE,
+        features_only: bool = False,
+    ):
+        super().__init__()
+        self._set(inputCol=inputCol, outputCol=outputCol,
+                  imageLoader=imageLoader)
+        self.module = module
+        self.variables = variables
+        self.batchSize = int(batchSize)
+        self.features_only = bool(features_only)
+        self._jitted = None
+
+    def _forward(self):
+        if self._jitted is None:
+            module = self.module
+            feats = self.features_only
+            variables = place_params(self.variables)
+
+            def forward(x):
+                return module.apply(variables, x, features_only=feats)
+
+            self._jitted = jax.jit(forward)
+        return self._jitted
+
+    def _transform(self, dataset):
+        input_col = self.getInputCol()
+        output_col = self.getOutputCol()
+        loader = self.getImageLoader()
+        fn = self._forward()
+
+        def process_partition(part):
+            uris = part[input_col]
+            out = dict(part)
+            if not uris:
+                out[output_col] = []
+                return out
+            batch = np.stack(
+                [np.asarray(loader(u), dtype=np.float32) for u in uris]
+            )
+            result = run_batched(fn, batch, self.batchSize)
+            flat = result.reshape(result.shape[0], -1).astype(np.float64)
+            out[output_col] = [DenseVector(v) for v in flat]
+            return out
+
+        return dataset.mapPartitions(process_partition)
+
+
+class FlaxImageFileEstimator(
+    Estimator, HasInputCol, HasOutputCol, HasLabelCol, CanLoadImage
+):
+    module = Param("undefined", "module", "flax.linen.Module to fine-tune")
+    optimizer = Param("undefined", "optimizer", "optax optimizer name")
+    loss = Param("undefined", "loss", "loss name (per-example labels)")
+    fitParams = Param(
+        "undefined", "fitParams",
+        "dict: epochs / batch_size / learning_rate / seed",
+    )
+    initialVariables = Param(
+        "undefined", "initialVariables",
+        "optional pretrained variables pytree (None: module.init)",
+    )
+    shardingRules = Param(
+        "undefined", "shardingRules",
+        "optional (regex, spec) tensor-parallel rules "
+        "(parallel.tp.VIT_TP_RULES); None trains pure-DP",
+    )
+    meshShape = Param(
+        "undefined", "meshShape",
+        "optional (dp, tp) device-count split for the DPxTP mesh; None "
+        "picks dp=2 when the device count is even, else dp=1",
+    )
+
+    @keyword_only
+    def __init__(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        labelCol: Optional[str] = None,
+        imageLoader=None,
+        module=None,
+        optimizer: str = "adam",
+        loss: str = "sparse_categorical_crossentropy",
+        fitParams: Optional[Dict[str, Any]] = None,
+        initialVariables=None,
+        shardingRules: Optional[Sequence] = None,
+        meshShape: Optional[Sequence[int]] = None,
+    ):
+        super().__init__()
+        self._setDefault(
+            optimizer="adam",
+            loss="sparse_categorical_crossentropy",
+            fitParams={"epochs": 1, "batch_size": 32},
+            initialVariables=None,
+            shardingRules=None,
+            meshShape=None,
+        )
+        kwargs = self._input_kwargs
+        self.setParams(**kwargs)
+
+    @keyword_only
+    def setParams(
+        self,
+        inputCol: Optional[str] = None,
+        outputCol: Optional[str] = None,
+        labelCol: Optional[str] = None,
+        imageLoader=None,
+        module=None,
+        optimizer: str = "adam",
+        loss: str = "sparse_categorical_crossentropy",
+        fitParams: Optional[Dict[str, Any]] = None,
+        initialVariables=None,
+        shardingRules: Optional[Sequence] = None,
+        meshShape: Optional[Sequence[int]] = None,
+    ):
+        kwargs = self._input_kwargs
+        return self._set(**kwargs)
+
+    # ------------------------------------------------------------------
+    def _load_shard(self, dataset):
+        x, labels, _ = load_host_shard(
+            dataset,
+            self.getInputCol(),
+            self.getLabelCol(),
+            self.getImageLoader(),
+        )
+        raw = np.asarray(labels)
+        if not np.issubdtype(raw.dtype, np.integer):
+            as_int = raw.astype(np.int64)
+            if not np.array_equal(raw, as_int):
+                raise ValueError(
+                    f"labelCol {self.getLabelCol()!r} holds non-integral "
+                    f"values (dtype {raw.dtype}); this estimator trains "
+                    "with integer class labels"
+                )
+        return x, raw.astype(np.int32)
+
+    def _fit(self, dataset):
+        for p in (self.inputCol, self.outputCol, self.labelCol,
+                  self.imageLoader, self.module):
+            if not self.isDefined(p):
+                raise ValueError(f"Required param not set: {p.name}")
+
+        module = self.getOrDefault(self.module)
+        fit_params = dict(self.getOrDefault(self.fitParams) or {})
+        epochs = int(fit_params.get("epochs", 1))
+        batch_size = int(fit_params.get("batch_size", 32))
+        lr = fit_params.get("learning_rate")
+        seed = int(fit_params.get("seed", 0))
+
+        x, y = self._load_shard(dataset)
+        loss_name = self.getOrDefault(self.loss)
+        tx = get_optimizer(self.getOrDefault(self.optimizer), lr)
+
+        variables = self.getOrDefault(self.initialVariables)
+        if variables is None:
+            variables = module.init(
+                jax.random.PRNGKey(seed),
+                jnp.zeros((1,) + x.shape[1:], jnp.float32),
+            )
+
+        def per_sample(params, batch):
+            """Per-sample losses -> exact zero-weight ragged padding."""
+            logits = module.apply(params, batch["x"])
+            if loss_name == "sparse_categorical_crossentropy":
+                # logits-space CE (Flax modules emit logits, unlike the
+                # Keras estimator's softmax outputs)
+                import optax
+
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, batch["y"]
+                )
+            per = get_per_sample_loss_fn(loss_name)
+            if per is None:
+                raise ValueError(
+                    f"loss {loss_name!r} has no per-sample form; use a "
+                    "named loss"
+                )
+            return per(batch["y"], logits)
+
+        rules = self.getOrDefault(self.shardingRules)
+        if rules is not None:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            from sparkdl_tpu.parallel.tp import (
+                init_tp_train_state,
+                make_tp_train_step,
+                param_path_specs,
+            )
+
+            def weighted_loss(params, batch):
+                # global arrays under GSPMD: the weighted mean is exact
+                per = per_sample(params, batch)
+                w = batch["w"]
+                return (per * w).sum() / w.sum()
+
+            devices = np.asarray(jax.devices())
+            shape = self.getOrDefault(self.meshShape)
+            if shape is not None:
+                dp, tp = (int(s) for s in shape)
+                if dp * tp != devices.size:
+                    raise ValueError(
+                        f"meshShape {tuple(shape)} needs {dp * tp} devices, "
+                        f"have {devices.size}"
+                    )
+            else:
+                dp = 2 if devices.size % 2 == 0 and devices.size > 1 else 1
+            mesh = Mesh(
+                devices.reshape(dp, devices.size // dp), ("data", "model")
+            )
+            specs = param_path_specs(variables, rules, model_axis="model")
+            state = init_tp_train_state(variables, tx, mesh, specs)
+            step_fn = make_tp_train_step(weighted_loss, tx, mesh, specs)
+
+            def place_batch(b):
+                return {
+                    "x": jax.device_put(
+                        jnp.asarray(b["x"]),
+                        NamedSharding(mesh, P("data", None, None, None)),
+                    ),
+                    "y": jax.device_put(
+                        jnp.asarray(b["y"]), NamedSharding(mesh, P("data"))
+                    ),
+                    "w": jax.device_put(
+                        jnp.asarray(b["w"]), NamedSharding(mesh, P("data"))
+                    ),
+                }
+        else:
+            mesh = make_mesh()
+            state = init_train_state(variables, tx)
+            step_fn = make_train_step(per_sample, tx, mesh, weighted=True)
+
+            def place_batch(b):
+                return shard_batch(
+                    {
+                        "x": jnp.asarray(b["x"]),
+                        "y": jnp.asarray(b["y"]),
+                        "w": jnp.asarray(b["w"]),
+                    },
+                    mesh,
+                )
+
+        n_dev = int(mesh.devices.size)
+        batch_size = max(batch_size - batch_size % n_dev, n_dev)
+        n = x.shape[0]
+        rng = np.random.RandomState(seed % 2**32)
+        last_loss = None
+        for epoch in range(epochs):
+            order = rng.permutation(n)
+            for lo in range(0, n, batch_size):
+                idx = order[lo : lo + batch_size]
+                k = len(idx)
+                if k < batch_size:
+                    # pad cyclically; pad rows carry zero weight, so the
+                    # update is the exact mean over the k real rows
+                    idx = np.concatenate(
+                        [idx, np.resize(order, batch_size - k)]
+                    )
+                w = np.zeros(batch_size, np.float32)
+                w[:k] = 1.0
+                state, loss = step_fn(
+                    state, place_batch({"x": x[idx], "y": y[idx], "w": w})
+                )
+            last_loss = float(loss)
+            logger.info(
+                "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
+            )
+
+        tuned = jax.tree_util.tree_map(np.asarray, state.params)
+        transformer = FlaxImageFileTransformer(
+            inputCol=self.getInputCol(),
+            outputCol=self.getOutputCol(),
+            imageLoader=self.getImageLoader(),
+            module=module,
+            variables=tuned,
+        )
+        transformer._training_loss = last_loss
+        return transformer

@@ -22,7 +22,6 @@ from __future__ import annotations
 import logging
 import os
 import tempfile
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -30,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sparkdl_tpu.estimators.data import load_host_shard
 from sparkdl_tpu.estimators.losses import (
     get_loss_fn,
     get_optimizer,
@@ -132,32 +132,12 @@ class KerasImageFileEstimator(
         see :mod:`sparkdl_tpu.parallel.runner`).  Returns ``(x, y,
         n_global)`` where ``x``/``y`` are this host's rows.
         """
-        input_col = self.getInputCol()
-        label_col = self.getLabelCol()
-        rows = dataset.select(input_col, label_col).collect()
-        if not rows:
-            raise ValueError("fit() received an empty dataset")
-        n_global = len(rows)
-        if runner.is_distributed():
-            nprocs = jax.process_count()
-            if n_global < nprocs:
-                # raised identically on every process, before any collective
-                # op, so the job fails fast instead of deadlocking peers on
-                # a host whose strided shard would be empty
-                raise ValueError(
-                    f"fit() needs at least one row per host: got {n_global} "
-                    f"rows across {nprocs} processes"
-                )
-            keep = runner.host_shard_indices(n_global)
-            rows = [rows[i] for i in keep]
-        loader = self.getImageLoader()
-        uris = [r[input_col] for r in rows]
-        with ThreadPoolExecutor(max_workers=16) as pool:
-            images = list(pool.map(
-                lambda u: np.asarray(loader(u), dtype=np.float32), uris
-            ))
-        x = np.stack(images)
-        labels = [r[label_col] for r in rows]
+        x, labels, n_global = load_host_shard(
+            dataset,
+            self.getInputCol(),
+            self.getLabelCol(),
+            self.getImageLoader(),
+        )
         first = np.asarray(labels[0])
         if first.ndim == 0:
             y = np.asarray(labels, dtype=np.int32)
@@ -332,6 +312,10 @@ class KerasImageFileEstimator(
                 os.path.join(root, f"epoch_{latest}"),
                 self._ckpt_payload(state),
             )
+        # back to host arrays: orbax restores arrays committed to device 0,
+        # which a step over a multi-device mesh would reject as incompatible
+        # with the sharded batch (caught by tests/test_fault_injection.py)
+        restored = jax.tree_util.tree_map(np.asarray, restored)
         logger.info("resuming from checkpoint epoch %d", latest)
         return latest, KerasTrainState(
             trainable=restored["trainable"],

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from sparkdl_tpu.models.inception_v3 import InceptionV3
@@ -137,6 +138,36 @@ def get_keras_application_model(name: str) -> KerasApplicationModel:
 
 # Reference-spelling alias (sparkdl.transformers.keras_applications†).
 getKerasApplicationModel = get_keras_application_model
+
+
+def fold_bgr_flip_into_stem(variables):
+    """Fold the BGR->RGB input flip into the stem conv's weights.
+
+    The transformers' fused forward flips the stored-BGR batch before the
+    CNN (``x[..., ::-1]``) — a pure-bandwidth op XLA cannot elide.  When
+    the model's preprocessing is channel-symmetric (``"tf"`` mode: the same
+    affine per channel), reversing the *input-channel axis of the first
+    conv kernel* is mathematically identical, and the flip disappears from
+    the program entirely.
+
+    Returns the folded variables, or ``None`` when folding is unsafe (not
+    exactly one 3-input-channel conv kernel — caller keeps the runtime
+    flip).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(variables)
+    hits = [
+        i
+        for i, (path, leaf) in enumerate(flat)
+        if getattr(leaf, "ndim", 0) == 4
+        and leaf.shape[2] == 3
+        and any(getattr(k, "key", None) == "kernel" for k in path)
+    ]
+    if len(hits) != 1:
+        return None
+    leaves = [leaf for _, leaf in flat]
+    i = hits[0]
+    leaves[i] = leaves[i][:, :, ::-1, :]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def decode_predictions(preds, top: int = 5):

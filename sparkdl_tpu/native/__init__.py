@@ -175,8 +175,8 @@ def pack_image_rows(
     keepalive = []
     for i, r in enumerate(rows):
         raw = r["data"]
-        if not isinstance(raw, (bytes, bytearray)):
-            raw = bytes(raw)
+        if not isinstance(raw, bytes):
+            raw = bytes(raw)  # ctypes.c_char_p accepts only bytes
         itemsize = 4 if int(r["mode"]) in _f32_modes else 1
         expected = int(r["height"]) * int(r["width"]) * int(r["nChannels"])
         if len(raw) < expected * itemsize:
@@ -237,8 +237,8 @@ def pack_image_rows_u8(
     keepalive = []
     for i, r in enumerate(rows):
         raw = r["data"]
-        if not isinstance(raw, (bytes, bytearray)):
-            raw = bytes(raw)
+        if not isinstance(raw, bytes):
+            raw = bytes(raw)  # ctypes.c_char_p accepts only bytes
         if len(raw) < out_h * out_w * int(r["nChannels"]):
             return None  # short buffer: Python path raises cleanly
         keepalive.append(raw)

@@ -90,13 +90,19 @@ def make_train_step(
     mesh: Mesh,
     data_axis: str = "data",
     donate: bool = True,
+    weighted: bool = False,
 ):
     """Build the jitted DP training step.
 
-    ``loss_fn(params, batch) -> scalar loss`` computes the *per-shard* loss;
-    the step averages gradients across the ``data`` axis with ``lax.pmean``
-    (the NCCL-allreduce analog, riding ICI) and applies the optax update
-    identically on every device, keeping params replicated.
+    Default: ``loss_fn(params, batch) -> scalar loss`` computes the
+    *per-shard* loss; the step averages gradients across the ``data`` axis
+    with ``lax.pmean`` (the NCCL-allreduce analog, riding ICI) and applies
+    the optax update identically on every device, keeping params replicated.
+
+    With ``weighted=True``, ``loss_fn(params, batch) -> (local_bs,)``
+    per-sample losses and ``batch`` carries a ``"w"`` weight vector; the
+    step optimizes the exact global weighted mean, so zero-weight rows
+    (ragged-batch padding) contribute nothing to loss or gradient.
     """
 
     n_shards = int(mesh.shape[data_axis])
@@ -110,6 +116,20 @@ def make_train_step(
             # shard count turns the summed per-shard mean-loss grads into
             # the global-mean gradient.  (Do NOT add lax.pmean here — that
             # is the pmap-era pattern and double-counts by n_shards.)
+            if weighted:
+
+                def local_weighted(p):
+                    per = loss_fn(p, local_batch)
+                    w = local_batch["w"]
+                    w_total = jax.lax.psum(w.sum(), axis_name=data_axis)
+                    return (per * w).sum() / w_total
+
+                # each shard's loss is its share of the global weighted
+                # mean; the replicated-param transpose psums the grads, so
+                # together with the global w_total this is already exact
+                loss, grads = jax.value_and_grad(local_weighted)(params)
+                loss = jax.lax.psum(loss, axis_name=data_axis)
+                return loss, grads
             loss, grads = jax.value_and_grad(loss_fn)(params, local_batch)
             grads = jax.tree_util.tree_map(lambda g: g / n_shards, grads)
             loss = jax.lax.pmean(loss, axis_name=data_axis)

@@ -187,17 +187,26 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
             # keeps the id() key from being reused by a new object after GC
             return _FORWARD_CACHE[cache_key][0], entry
         module = entry.make_module(dtype=dtype)
-        variables = place_params(resolved)
         height, width = entry.input_size
         featurize = self._featurize  # local: don't pin self in the cache
         preprocess = entry.preprocess
+        # channel-symmetric preprocessing ("tf" mode): fold the BGR->RGB
+        # flip into the stem conv's input channels — the flip op (pure HBM
+        # bandwidth) vanishes from the program
+        folded = None
+        if entry.preprocess_mode == "tf":
+            from sparkdl_tpu.models.registry import fold_bgr_flip_into_stem
+
+            folded = fold_bgr_flip_into_stem(resolved)
+        variables = place_params(folded if folded is not None else resolved)
+        flip_in_program = folded is None
 
         def forward(x):
             # x: uint8 or float32 NHWC, stored (Spark) BGR order, source
             # size — cast, flip, resize, preprocess and CNN all fuse into
             # one XLA program (uint8 ingest quarters host->device bytes).
             x = cast_and_resize_on_device(x, (height, width))
-            if x.shape[-1] == 3:
+            if flip_in_program and x.shape[-1] == 3:
                 x = x[..., ::-1]  # BGR -> RGB
             x = preprocess(x)
             out = module.apply(

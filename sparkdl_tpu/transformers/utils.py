@@ -30,6 +30,13 @@ logger = logging.getLogger(__name__)
 DEFAULT_BATCH_SIZE = 32
 
 
+class MixedImageSizesError(ValueError):
+    """A partition mixes (H, W) shapes and no target size is configured.
+
+    Typed so callers (e.g. the UDF layer) can catch this specific case and
+    reword the guidance, without string-matching the message."""
+
+
 class LRUCache:
     """Tiny bounded mapping: process-lifetime model/program caches hold
     compiled XLA executables and full variable pytrees (potentially hundreds
@@ -178,7 +185,7 @@ def decode_image_batch(
     uniform = len(hws) == 1
     source_hw = next(iter(hws)) if uniform else None
     if not uniform and target_hw is None:
-        raise ValueError(
+        raise MixedImageSizesError(
             f"partition mixes image sizes {sorted(hws)} and no target size "
             "is configured; resize upstream or set an input size"
         )

@@ -37,6 +37,7 @@ from sparkdl_tpu.ml.linalg import DenseVector
 from sparkdl_tpu.sql.functions import UserDefinedFunction
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
+    MixedImageSizesError,
     cast_and_resize_on_device,
     decode_image_batch,
     load_keras_function,
@@ -69,6 +70,7 @@ def registerKerasImageUDF(
     params = place_params(fn.params)
     inner = fn._jitted()
 
+    @jax.jit
     def forward(x):
         # cast + resize fuse with the model into one device program, so
         # batches arrive at source size (uint8 when possible — the
@@ -100,7 +102,7 @@ def registerKerasImageUDF(
                 batch = decode_image_batch(
                     values, 3, size, to_rgb=True, prefer_uint8=True
                 )
-            except ValueError as e:
+            except MixedImageSizesError as e:
                 raise ValueError(
                     f"UDF {udfName!r}: model input size is dynamic and "
                     "the column holds mixed shapes; resize in a "

@@ -121,6 +121,35 @@ def test_decode_predictions_fallback():
     assert score == 5.0 and (label == "class_7" or wnid.startswith("n"))
 
 
+def test_fold_bgr_flip_into_stem_is_exact():
+    """Folded-stem forward on BGR input == plain forward on flipped input
+    (channel-symmetric preprocessing)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.models import get_keras_application_model
+    from sparkdl_tpu.models.registry import fold_bgr_flip_into_stem
+
+    entry = get_keras_application_model("MobileNetV2")  # "tf" mode
+    module = entry.make_module()
+    x_bgr = jnp.asarray(
+        np.random.RandomState(0).rand(2, 224, 224, 3), jnp.float32
+    )
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        variables = module.init(jax.random.PRNGKey(0), x_bgr)
+        folded = fold_bgr_flip_into_stem(variables)
+        assert folded is not None
+        want = module.apply(
+            variables, entry.preprocess(x_bgr[..., ::-1]), features_only=True
+        )
+        got = module.apply(
+            folded, entry.preprocess(x_bgr), features_only=True
+        )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
 def test_decode_predictions_real_labels_offline():
     """The vendored class-name list gives real ImageNet labels with no
     network and no Keras cache (VERDICT round-1 item 9)."""
