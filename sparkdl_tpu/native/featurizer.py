@@ -1,0 +1,141 @@
+"""Export DeepImageFeaturizer programs for the native (C++) stack.
+
+The dual-stack featurizer (reference: Scala ``DeepImageFeaturizer`` ran a
+pre-frozen GraphDef with TensorFrames ``mapRows`` — SURVEY.md §3.5).  Here
+the "frozen graph" is an exported StableHLO program directory and the
+executor is ``pjrt_tool`` (pure C++ over the PJRT C API) or the in-process
+:class:`sparkdl_tpu.native.pjrt.NativeProgram` bridge.
+
+The exported program is the SAME fused forward the Python transformer jits
+(uint8 ingest -> device resize -> BGR handling -> preprocess -> CNN ->
+f32 features), so both stacks produce identical numerics by construction.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.native import pjrt
+
+
+def export_featurizer(
+    model_name: str,
+    batch_size: int,
+    out_dir: str,
+    source_hw: Optional[Tuple[int, int]] = None,
+    model_weights="imagenet",
+    compute_dtype=jnp.bfloat16,
+) -> dict:
+    """Write a native featurizer program directory.
+
+    ``source_hw``: the (H, W) batches arrive at (uint8, stored BGR, NHWC —
+    the Spark image-struct convention); defaults to the model's input size.
+    Returns the program manifest.
+    """
+    from sparkdl_tpu.models import get_keras_application_model
+    from sparkdl_tpu.models.registry import fold_bgr_flip_into_stem
+    from sparkdl_tpu.transformers.named_image import _resolve_variables
+    from sparkdl_tpu.transformers.utils import cast_and_resize_on_device
+
+    entry = get_keras_application_model(model_name)
+    module = entry.make_module(dtype=compute_dtype)
+    variables = _resolve_variables(model_name, model_weights)
+    height, width = entry.input_size
+    if source_hw is None:
+        source_hw = (height, width)
+    preprocess = entry.preprocess
+
+    folded = None
+    if entry.preprocess_mode == "tf":
+        folded = fold_bgr_flip_into_stem(variables)
+    flip_in_program = folded is None
+    if folded is not None:
+        variables = folded
+
+    def forward(v, x):
+        x = cast_and_resize_on_device(x, (height, width))
+        if flip_in_program and x.shape[-1] == 3:
+            x = x[..., ::-1]  # stored BGR -> RGB
+        x = preprocess(x)
+        out = module.apply(
+            v, x.astype(compute_dtype), features_only=True
+        )
+        return out.reshape(out.shape[0], -1).astype(jnp.float32)
+
+    example = np.zeros(
+        (int(batch_size), int(source_hw[0]), int(source_hw[1]), 3), np.uint8
+    )
+    return pjrt.export_program(
+        forward, variables, [example], out_dir, input_names=["image"]
+    )
+
+
+def run_featurizer_cli(
+    program_dir: str,
+    batches: np.ndarray,
+    plugin_path: str = pjrt.DEFAULT_PLUGIN,
+) -> np.ndarray:
+    """Convenience wrapper: run the standalone ``pjrt_tool`` binary over
+    uint8 image batches shaped (n_batches, B, H, W, 3) and return the
+    stacked f32 features.  Builds the tool on demand."""
+    import json
+    import subprocess
+    import tempfile
+
+    tool = build_tool()
+    with open(os.path.join(program_dir, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    (out_spec,) = manifest["outputs"]
+    with tempfile.TemporaryDirectory() as tmp:
+        in_path = os.path.join(tmp, "in.bin")
+        out_path = os.path.join(tmp, "out.bin")
+        np.ascontiguousarray(batches, np.uint8).tofile(in_path)
+        subprocess.run(
+            [tool, plugin_path, program_dir, in_path, out_path],
+            check=True,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        feats = np.fromfile(out_path, np.float32)
+    return feats.reshape((batches.shape[0],) + tuple(out_spec["shape"]))
+
+
+def build_tool() -> str:
+    """Compile ``pjrt_tool`` next to its source (one-time); returns path."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    tool = os.path.join(here, "pjrt_tool")
+    sources = [
+        os.path.join(here, "pjrt_tool.cpp"),
+        os.path.join(here, "pjrt_runner.cpp"),
+    ]
+    if os.path.exists(tool) and os.path.getmtime(tool) >= max(
+        os.path.getmtime(s) for s in sources
+    ):
+        return tool
+    include = pjrt._xla_include_dir()
+    if include is None:
+        raise RuntimeError("pjrt_c_api.h unavailable; cannot build pjrt_tool")
+    tmp = f"{tool}.{os.getpid()}.tmp"
+    subprocess.run(
+        [
+            os.environ.get("CXX", "g++"),
+            "-O2", "-std=c++17", f"-I{include}", "-o", tmp,
+            os.path.join(here, "pjrt_tool.cpp"),
+            os.path.join(here, "pjrt_runner.cpp"),
+            "-ldl",
+        ],
+        check=True,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    os.replace(tmp, tool)
+    return tool

@@ -1,0 +1,267 @@
+// pjrt_tool — the standalone native featurizer (no Python in the loop).
+//
+// The dual-stack analog of the reference's Scala `DeepImageFeaturizer`
+// (`src/main/scala/com/databricks/sparkdl/DeepImageFeaturizer.scala`†,
+// SURVEY.md §3.5): where that stack ran a pre-frozen GraphDef through
+// TensorFrames/JNI on JVM executors, this binary loads an exported
+// StableHLO program directory (see `sparkdl_tpu.native.pjrt.export_program`),
+// compiles it once on a PJRT plugin, uploads params once, then streams raw
+// batches from a file through the device and appends features to the
+// output file.
+//
+//   pjrt_tool <plugin.so> <program_dir> <input.bin> <output.bin>
+//
+// input.bin: concatenated batches; each batch is the program's data inputs
+// back to back, dense row-major, exactly the dtypes/shapes in
+// manifest.txt.  output.bin: the outputs of every batch, in order.
+//
+// Build: g++ -O2 -std=c++17 -I<tf-include> -o pjrt_tool pjrt_tool.cpp
+//        _pjrt_runner.so -ldl   (or compile pjrt_runner.cpp in directly)
+
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+// C ABI from pjrt_runner.cpp
+extern "C" {
+struct PjrtRunner;
+PjrtRunner* pjrt_runner_create(const char*, char*, int);
+PjrtRunner* pjrt_runner_create_opts(const char*, const char**, const char**,
+                                    const int64_t*, const int32_t*, int32_t,
+                                    char*, int);
+const char* pjrt_runner_last_error(PjrtRunner*);
+int pjrt_runner_platform(PjrtRunner*, char*, int);
+int64_t pjrt_runner_compile(PjrtRunner*, const char*, int64_t, const char*,
+                            int64_t);
+int64_t pjrt_runner_num_outputs(PjrtRunner*, int64_t);
+int64_t pjrt_runner_put(PjrtRunner*, const void*, const char*,
+                        const int64_t*, int32_t);
+int pjrt_runner_free_buffer(PjrtRunner*, int64_t);
+int64_t pjrt_runner_execute(PjrtRunner*, int64_t, const int64_t*, int32_t,
+                            int64_t*);
+int64_t pjrt_runner_buffer_size(PjrtRunner*, int64_t);
+int pjrt_runner_get(PjrtRunner*, int64_t, void*, int64_t);
+void pjrt_runner_destroy(PjrtRunner*);
+}
+
+namespace {
+
+struct Spec {
+  std::string kind;   // "param" | "input" | "output"
+  std::string dtype;  // short name ("f32", "u8", ...)
+  std::vector<int64_t> dims;
+  size_t bytes = 0;
+};
+
+size_t dtype_size(const std::string& d) {
+  if (d == "f64" || d == "s64" || d == "u64") return 8;
+  if (d == "f32" || d == "s32" || d == "u32") return 4;
+  if (d == "f16" || d == "bf16" || d == "s16" || d == "u16") return 2;
+  return 1;  // u8/s8/pred
+}
+
+bool read_file(const std::string& path, std::string* out) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) return false;
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  return true;
+}
+
+int die(PjrtRunner* r, const char* what) {
+  std::fprintf(stderr, "pjrt_tool: %s: %s\n", what,
+               r ? pjrt_runner_last_error(r) : "(no runner)");
+  if (r) pjrt_runner_destroy(r);
+  return 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    std::fprintf(
+        stderr,
+        "usage: %s <plugin.so> <program_dir> <input.bin> <output.bin>\n",
+        argv[0]);
+    return 2;
+  }
+  const std::string plugin = argv[1], dir = argv[2], in_path = argv[3],
+                    out_path = argv[4];
+
+  // --- manifest ---
+  std::ifstream mf(dir + "/manifest.txt");
+  if (!mf) {
+    std::fprintf(stderr, "pjrt_tool: cannot open %s/manifest.txt\n",
+                 dir.c_str());
+    return 1;
+  }
+  std::vector<Spec> params, inputs, outputs;
+  std::string line;
+  while (std::getline(mf, line)) {
+    std::istringstream ls(line);
+    Spec s;
+    std::string dims;
+    if (!(ls >> s.kind >> s.dtype >> dims)) continue;
+    if (dims != "scalar") {
+      std::istringstream ds(dims);
+      std::string tok;
+      while (std::getline(ds, tok, ',')) s.dims.push_back(std::stoll(tok));
+    }
+    s.bytes = dtype_size(s.dtype);
+    for (int64_t d : s.dims) s.bytes *= static_cast<size_t>(d);
+    (s.kind == "param" ? params : s.kind == "input" ? inputs : outputs)
+        .push_back(s);
+  }
+
+  std::string program, copts, params_bin;
+  if (!read_file(dir + "/program.mlir", &program) ||
+      !read_file(dir + "/compile_options.pb", &copts) ||
+      !read_file(dir + "/params.bin", &params_bin)) {
+    std::fprintf(stderr, "pjrt_tool: missing program artifacts in %s\n",
+                 dir.c_str());
+    return 1;
+  }
+
+  // --- client-create options (plugin_options.txt; written at export) ---
+  // Lines: `env KEY VALUE` (setenv'd, e.g. AXON_COMPAT_VERSION),
+  // `int KEY N`, `str KEY VALUE`; the literal value `@mint` becomes a
+  // fresh per-run session id (the terminal's session lock is keyed on it).
+  std::vector<std::string> opt_keys, opt_svals;
+  std::vector<int64_t> opt_ivals;
+  std::vector<int32_t> opt_is_int;
+  std::ifstream pf(dir + "/plugin_options.txt");
+  bool opts_apply = true;
+  while (pf && std::getline(pf, line)) {
+    std::istringstream ls(line);
+    std::string kind, key, value;
+    if (!(ls >> kind >> key)) continue;
+    if (kind == "for-plugin") {
+      // Options are scoped to plugins whose basename contains the token;
+      // a mismatched plugin gets a bare create (axon NamedValues would
+      // be rejected by e.g. a CPU plugin).
+      opts_apply = plugin.find(key) != std::string::npos;
+      continue;
+    }
+    if (!opts_apply) continue;
+    if (!(ls >> value)) continue;
+    if (value == "@mint") {
+      value = "pjrt-tool-" + std::to_string(getpid()) + "-" +
+              std::to_string(
+                  std::chrono::steady_clock::now().time_since_epoch().count());
+    }
+    if (kind == "env") {
+      setenv(key.c_str(), value.c_str(), /*overwrite=*/0);
+    } else {
+      opt_keys.push_back(key);
+      opt_svals.push_back(kind == "int" ? "" : value);
+      opt_ivals.push_back(kind == "int" ? std::stoll(value) : 0);
+      opt_is_int.push_back(kind == "int" ? 1 : 0);
+    }
+  }
+  std::vector<const char*> key_ptrs, sval_ptrs;
+  for (const auto& s : opt_keys) key_ptrs.push_back(s.c_str());
+  for (const auto& s : opt_svals) sval_ptrs.push_back(s.c_str());
+
+  // --- plugin + compile + resident params ---
+  char err[4096];
+  PjrtRunner* r = pjrt_runner_create_opts(
+      plugin.c_str(), key_ptrs.data(), sval_ptrs.data(), opt_ivals.data(),
+      opt_is_int.data(), static_cast<int32_t>(opt_keys.size()), err,
+      sizeof(err));
+  if (!r) {
+    std::fprintf(stderr, "pjrt_tool: create failed: %s\n", err);
+    return 1;
+  }
+  char platform[64];
+  pjrt_runner_platform(r, platform, sizeof(platform));
+  int64_t exec_id = pjrt_runner_compile(
+      r, program.data(), static_cast<int64_t>(program.size()), copts.data(),
+      static_cast<int64_t>(copts.size()));
+  if (exec_id < 0) return die(r, "compile");
+
+  std::vector<int64_t> arg_ids;
+  size_t off = 0;
+  for (const Spec& s : params) {
+    if (off + s.bytes > params_bin.size()) {
+      std::fprintf(stderr, "pjrt_tool: params.bin shorter than manifest\n");
+      pjrt_runner_destroy(r);
+      return 1;
+    }
+    int64_t id = pjrt_runner_put(r, params_bin.data() + off, s.dtype.c_str(),
+                                 s.dims.data(),
+                                 static_cast<int32_t>(s.dims.size()));
+    if (id < 0) return die(r, "param upload");
+    arg_ids.push_back(id);
+    off += s.bytes;
+  }
+
+  // --- stream batches ---
+  size_t batch_bytes = 0;
+  for (const Spec& s : inputs) batch_bytes += s.bytes;
+  std::ifstream in(in_path, std::ios::binary);
+  std::ofstream out(out_path, std::ios::binary | std::ios::trunc);
+  if (!in || !out) {
+    std::fprintf(stderr, "pjrt_tool: cannot open input/output file\n");
+    pjrt_runner_destroy(r);
+    return 1;
+  }
+  std::vector<char> batch(batch_bytes);
+  std::vector<int64_t> out_ids(outputs.size() ? outputs.size() : 1);
+  size_t n_batches = 0;
+  const size_t n_params = arg_ids.size();
+  while (true) {
+    if (batch_bytes == 0) {
+      if (n_batches) break;  // params-only program: run exactly once
+    } else if (!in.read(batch.data(),
+                        static_cast<std::streamsize>(batch_bytes))) {
+      if (in.gcount() != 0) {
+        std::fprintf(stderr,
+                     "pjrt_tool: input.bin has a trailing partial batch "
+                     "(%lld of %zu bytes) — batch shape mismatch?\n",
+                     static_cast<long long>(in.gcount()), batch_bytes);
+        pjrt_runner_destroy(r);
+        return 1;
+      }
+      break;
+    }
+    size_t boff = 0;
+    for (const Spec& s : inputs) {
+      int64_t id = pjrt_runner_put(r, batch.data() + boff, s.dtype.c_str(),
+                                   s.dims.data(),
+                                   static_cast<int32_t>(s.dims.size()));
+      if (id < 0) return die(r, "batch upload");
+      arg_ids.push_back(id);
+      boff += s.bytes;
+    }
+    int64_t n_out = pjrt_runner_execute(
+        r, exec_id, arg_ids.data(), static_cast<int32_t>(arg_ids.size()),
+        out_ids.data());
+    if (n_out < 0) return die(r, "execute");
+    for (int64_t i = 0; i < n_out; ++i) {
+      int64_t sz = pjrt_runner_buffer_size(r, out_ids[i]);
+      if (sz < 0) return die(r, "output size");
+      std::vector<char> host(static_cast<size_t>(sz));
+      if (pjrt_runner_get(r, out_ids[i], host.data(), sz) != 0)
+        return die(r, "fetch");
+      out.write(host.data(), sz);
+      pjrt_runner_free_buffer(r, out_ids[i]);
+    }
+    for (size_t i = n_params; i < arg_ids.size(); ++i)
+      pjrt_runner_free_buffer(r, arg_ids[i]);
+    arg_ids.resize(n_params);
+    ++n_batches;
+  }
+  std::fprintf(stderr, "pjrt_tool: platform=%s batches=%zu -> %s\n",
+               platform, n_batches, out_path.c_str());
+  pjrt_runner_destroy(r);
+  return 0;
+}

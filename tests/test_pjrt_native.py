@@ -1,0 +1,146 @@
+"""Native PJRT runner tests — the second (non-Python) execution stack.
+
+The dual-stack contract (SURVEY.md §2 "Scala DeepImageFeaturizer", §3.5):
+a C++ executor drives a PJRT plugin directly — compile exported StableHLO,
+resident params, stream batches — and must agree with the Python stack's
+numerics (oracle pattern, SURVEY.md §4).
+
+These tests need a live PJRT plugin with a device behind it (the axon TPU
+plugin in this environment); they skip cleanly when it is absent.  They
+run the runner's client in-process while jax stays on the CPU platform
+(conftest forces JAX_PLATFORMS=cpu), so the two stacks never contend for
+the TPU session.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sparkdl_tpu.native import pjrt
+
+
+def _plugin_usable() -> bool:
+    if not os.path.exists(pjrt.DEFAULT_PLUGIN):
+        return False
+    return pjrt.is_available()
+
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not _plugin_usable(),
+        reason="no PJRT plugin / native runner unavailable",
+    ),
+]
+
+
+@pytest.fixture(scope="module")
+def tiny_program(tmp_path_factory):
+    """Exported two-output program with resident params."""
+    w = np.arange(12, dtype=np.float32).reshape(3, 4) / 10.0
+    b = np.ones((4,), np.float32)
+
+    def fn(p, x):
+        return jnp.dot(x, p["w"]) + p["b"], jnp.sum(x, axis=1)
+
+    x = np.random.RandomState(0).rand(5, 3).astype(np.float32)
+    d = str(tmp_path_factory.mktemp("prog"))
+    manifest = pjrt.export_program(
+        fn, {"w": w, "b": b}, [x], d, input_names=["x"]
+    )
+    return d, manifest, w, b
+
+
+def test_export_manifest(tiny_program):
+    d, manifest, w, b = tiny_program
+    assert [p["shape"] for p in manifest["params"]] == [[4], [3, 4]]
+    assert manifest["inputs"][0]["dtype"] == "f32"
+    assert [o["shape"] for o in manifest["outputs"]] == [[5, 4], [5]]
+    for f in ("program.mlir", "params.bin", "compile_options.pb",
+              "manifest.txt", "plugin_options.txt"):
+        assert os.path.exists(os.path.join(d, f)), f
+
+
+def test_native_program_matches_numpy(tiny_program):
+    """In-process bridge: compile + resident params + two batches."""
+    d, manifest, w, b = tiny_program
+    rng = np.random.RandomState(1)
+    with pjrt.NativeProgram(d) as prog:
+        assert prog.runner.platform in ("tpu", "cpu", "axon")
+        for _ in range(2):  # second batch reuses resident params
+            x = rng.rand(5, 3).astype(np.float32)
+            y, s = prog(x)
+            np.testing.assert_allclose(y, x @ w + b, rtol=2e-2, atol=1e-2)
+            np.testing.assert_allclose(s, x.sum(1), rtol=2e-2, atol=1e-2)
+
+
+def test_cli_tool_streams_batches(tiny_program, tmp_path):
+    """The standalone C++ featurizer binary: no Python in the loop."""
+    from sparkdl_tpu.native.featurizer import build_tool
+
+    d, manifest, w, b = tiny_program
+    tool = build_tool()
+    rng = np.random.RandomState(2)
+    batches = rng.rand(3, 5, 3).astype(np.float32)
+    in_path = tmp_path / "in.bin"
+    out_path = tmp_path / "out.bin"
+    batches.tofile(in_path)
+    proc = subprocess.run(
+        [tool, pjrt.DEFAULT_PLUGIN, d, str(in_path), str(out_path)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    raw = np.fromfile(out_path, np.float32)
+    per_batch = 5 * 4 + 5  # out1 (5,4) + out2 (5,)
+    assert raw.size == 3 * per_batch
+    for i in range(3):
+        rec = raw[i * per_batch:(i + 1) * per_batch]
+        y = rec[:20].reshape(5, 4)
+        s = rec[20:]
+        np.testing.assert_allclose(
+            y, batches[i] @ w + b, rtol=2e-2, atol=1e-2
+        )
+        np.testing.assert_allclose(
+            s, batches[i].sum(1), rtol=2e-2, atol=1e-2
+        )
+
+
+def test_native_featurizer_oracle(tmp_path):
+    """Dual-stack DeepImageFeaturizer: the exported MobileNetV2 program on
+    the native stack ≡ the same fused forward in plain jax (CPU f32/bf16
+    vs TPU bf16 — tolerance covers the backend matmul precision gap)."""
+    import jax
+
+    from sparkdl_tpu.models import get_keras_application_model
+    from sparkdl_tpu.native.featurizer import export_featurizer
+    from sparkdl_tpu.transformers.named_image import _resolve_variables
+    from sparkdl_tpu.transformers.utils import cast_and_resize_on_device
+
+    d = str(tmp_path / "feat")
+    export_featurizer(
+        "MobileNetV2", batch_size=2, out_dir=d, source_hw=(64, 64),
+        model_weights="random",
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 255, (2, 64, 64, 3), np.uint8)
+    with pjrt.NativeProgram(d) as prog:
+        got, = prog(x)
+
+    entry = get_keras_application_model("MobileNetV2")
+    module = entry.make_module(dtype=jnp.bfloat16)
+    variables = _resolve_variables("MobileNetV2", "random")
+    h, w = entry.input_size
+
+    def forward(v, xx):
+        xx = cast_and_resize_on_device(xx, (h, w))
+        xx = entry.preprocess(xx[..., ::-1])
+        out = module.apply(v, xx.astype(jnp.bfloat16), features_only=True)
+        return out.reshape(out.shape[0], -1).astype(jnp.float32)
+
+    want = np.asarray(jax.jit(forward)(variables, x))
+    err = np.abs(got - want) / (np.abs(want) + 1e-3)
+    assert err.max() < 0.15, f"max rel err {err.max()}"
