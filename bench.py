@@ -31,7 +31,9 @@ import jax.numpy as jnp
 
 V100_IMAGES_PER_SEC = 1000.0
 BATCH = 512
-SCAN_LEN = 8  # deeper scan -> the ~40ms host-fetch round trip amortizes
+SCAN_LEN = 12  # deeper scan -> the ~40ms host-fetch round trip amortizes
+# (12 measured best on the relay: 16 pushes the 2.2GB stack staging past
+# the driver's patience; 8 leaves ~4% fetch overhead on the table)
 REPEATS = 3
 
 

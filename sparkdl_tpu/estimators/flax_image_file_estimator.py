@@ -79,6 +79,44 @@ class FlaxImageFileTransformer(
         self.features_only = bool(features_only)
         self._jitted = None
 
+    # -- persistence (module pickle + variables pytree pickle) ---------
+    # The module is a flax dataclass (picklable as long as custom
+    # ``attn_impl`` callables are module-level); variables pickle as
+    # numpy pytrees.  Matches the DefaultParamsWritable analog the other
+    # stages use (tests/test_persistence.py).
+    def _save_artifacts(self, path: str):
+        import os
+        import pickle
+
+        host_vars = jax.tree_util.tree_map(np.asarray, self.variables)
+        with open(os.path.join(path, "flax_model.pkl"), "wb") as fh:
+            pickle.dump({"module": self.module, "variables": host_vars}, fh)
+        return {
+            "batchSize": self.batchSize,
+            "features_only": self.features_only,
+        }
+
+    @classmethod
+    def _load_instance(cls, metadata, path: str):
+        import os
+        import pickle
+
+        extra = metadata["extra"]
+        with open(os.path.join(path, "flax_model.pkl"), "rb") as fh:
+            payload = pickle.load(fh)
+        params = metadata["params"]
+        from sparkdl_tpu.ml.util import _decode_param
+
+        return cls(
+            inputCol=_decode_param(params["inputCol"], path),
+            outputCol=_decode_param(params["outputCol"], path),
+            imageLoader=_decode_param(params["imageLoader"], path),
+            module=payload["module"],
+            variables=payload["variables"],
+            batchSize=extra["batchSize"],
+            features_only=extra["features_only"],
+        )
+
     def _forward(self):
         if self._jitted is None:
             module = self.module

@@ -437,3 +437,45 @@ def test_metadata_shape(tmp_path):
     assert md["class"].endswith("LogisticRegression")
     assert md["uid"] == lr.uid
     assert md["params"]["maxIter"] == {"t": "json", "v": 5}
+
+
+# ---------------------------------------------------------------------------
+# Flax stages
+# ---------------------------------------------------------------------------
+
+
+def test_flax_image_file_transformer_roundtrip(
+    tpu_session, image_dir, tmp_path
+):
+    """Fitted-Flax-model persistence: module + variables survive the trip
+    and the reloaded transformer produces identical features."""
+    import jax
+
+    from sparkdl_tpu.estimators import FlaxImageFileTransformer
+    from sparkdl_tpu.image.imageIO import filesToDF
+    from sparkdl_tpu.models.vit import ViT
+
+    module = ViT(variant="ViT-Ti/16", num_classes=3, image_size=8)
+    variables = module.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3), jnp.float32)
+    )
+    t = FlaxImageFileTransformer(
+        inputCol="filePath",
+        outputCol="out",
+        imageLoader=loader_8x8,
+        module=module,
+        variables=variables,
+        batchSize=4,
+    )
+    df = filesToDF(tpu_session, image_dir, numPartitions=2)
+    want = [r["out"].toArray() for r in t.transform(df).collect()]
+
+    path = str(tmp_path / "flax_t")
+    t.save(path)
+    loaded = load_stage(path)
+    assert isinstance(loaded, FlaxImageFileTransformer)
+    assert loaded.batchSize == 4 and loaded.features_only is False
+    got = [r["out"].toArray() for r in loaded.transform(df).collect()]
+    np.testing.assert_allclose(
+        np.stack(got), np.stack(want), rtol=1e-6, atol=1e-6
+    )
