@@ -33,6 +33,7 @@ _EXPORTS = {
     "TFImageTransformer": "sparkdl_tpu.transformers.tf_image",
     "DeepImagePredictor": "sparkdl_tpu.transformers.named_image",
     "DeepImageFeaturizer": "sparkdl_tpu.transformers.named_image",
+    "NativeDeepImageFeaturizer": "sparkdl_tpu.transformers.native_image",
     "KerasImageFileTransformer": "sparkdl_tpu.transformers.keras_image",
     "TPUTransformer": "sparkdl_tpu.transformers.tf_tensor",
     "TFTransformer": "sparkdl_tpu.transformers.tf_tensor",

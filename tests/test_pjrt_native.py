@@ -144,3 +144,44 @@ def test_native_featurizer_oracle(tmp_path):
     want = np.asarray(jax.jit(forward)(variables, x))
     err = np.abs(got - want) / (np.abs(want) + 1e-3)
     assert err.max() < 0.15, f"max rel err {err.max()}"
+
+
+def test_native_featurizer_stage_matches_python_stack(tmp_path, monkeypatch):
+    """NativeDeepImageFeaturizer (C++ decode+pack -> C++ PJRT execute) ≡
+    DeepImageFeaturizer (Python stack) on the same deterministic-random
+    weights — the dual-stack agreement the reference had between its
+    Scala and Python featurizers."""
+    from PIL import Image
+
+    from sparkdl_tpu import DeepImageFeaturizer, NativeDeepImageFeaturizer
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.sql.session import TPUSession
+
+    img_dir = tmp_path / "imgs"
+    img_dir.mkdir()
+    rng = np.random.RandomState(0)
+    for i in range(5):  # 5 rows, batch 4 -> exercises the ragged tail
+        Image.fromarray(
+            rng.randint(0, 255, (224, 224, 3), np.uint8)
+        ).save(img_dir / f"im{i}.png")
+
+    spark = TPUSession.builder.getOrCreate()
+    df = imageIO.readImages(str(img_dir), spark, numPartitions=2)
+
+    monkeypatch.setenv(
+        "SPARKDL_NATIVE_PROGRAM_CACHE", str(tmp_path / "progcache")
+    )
+    native = NativeDeepImageFeaturizer(
+        inputCol="image", outputCol="f", modelName="MobileNetV2",
+        modelWeights="random", batchSize=4,
+    ).transform(df).collect()
+    python = DeepImageFeaturizer(
+        inputCol="image", outputCol="f", modelName="MobileNetV2",
+        modelWeights="random", batchSize=4,
+    ).transform(df).collect()
+
+    got = np.stack([r["f"].toArray() for r in native])
+    want = np.stack([r["f"].toArray() for r in python])
+    assert got.shape == want.shape == (5, 1280)
+    err = np.abs(got - want) / (np.abs(want) + 1e-3)
+    assert err.max() < 0.15, f"max rel err {err.max()}"
