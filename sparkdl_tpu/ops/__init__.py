@@ -1,0 +1,9 @@
+"""Custom TPU kernels (Pallas).
+
+The reference delegated all device kernels to the TF C++ runtime; here the
+XLA compiler plays that role and :mod:`pallas` covers the ops XLA's fusion
+doesn't schedule optimally (SURVEY.md §2 "Native components": custom
+kernels → Pallas).
+"""
+
+from sparkdl_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -155,6 +155,7 @@ def ulysses_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     kv_len: Optional[int] = None,
+    local_attn=None,
 ):
     """Ulysses-style sequence parallelism: all-to-all seq->head resharding.
 
@@ -162,6 +163,11 @@ def ulysses_attention(
     ``heads % axis_size == 0``.  Each device ends up with the *full*
     sequence for ``heads/n`` heads, runs dense attention, and the result is
     resharded back to the sequence axis.
+
+    ``local_attn`` swaps the per-device dense step — e.g.
+    :func:`sparkdl_tpu.ops.flash_attention` to keep the local (s, s)
+    score matrix out of HBM on long sequences (``impl="ulysses-flash"``
+    in :func:`make_sp_attention`).
     """
     n = lax.axis_size(axis_name)
     b, s_blk, h, d = q.shape
@@ -185,7 +191,8 @@ def ulysses_attention(
         return x.reshape(b, s_blk, h, d)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    out = full_attention(qh, kh, vh, causal=causal, scale=scale, kv_len=kv_len)
+    attn = local_attn if local_attn is not None else full_attention
+    out = attn(qh, kh, vh, causal=causal, scale=scale, kv_len=kv_len)
     return to_seq(out)
 
 
@@ -196,7 +203,24 @@ def make_sp_attention(mesh, axis_name: str = "seq", impl: str = "ring",
     sequence dim (dim 1 of ``(batch, seq, heads, head_dim)``)."""
     from jax.sharding import PartitionSpec as P
 
-    inner = ring_attention if impl == "ring" else ulysses_attention
+    check_vma = True
+    if impl == "ring":
+        inner = ring_attention
+    elif impl == "ulysses-flash":
+        from sparkdl_tpu.ops import flash_attention
+
+        inner = partial(ulysses_attention, local_attn=flash_attention)
+        # pallas INTERPRET mode mixes varying/plain values inside the
+        # kernel, which the vma checker rejects; on real TPU the kernel
+        # mirrors vma in its out_shape, so keep the checker there
+        check_vma = jax.default_backend() == "tpu"
+    elif impl == "ulysses":
+        inner = ulysses_attention
+    else:
+        raise ValueError(
+            f"unknown SP attention impl {impl!r}; expected 'ring', "
+            "'ulysses', or 'ulysses-flash'"
+        )
     spec = P(None, axis_name, None, None)
 
     @jax.jit
@@ -206,6 +230,7 @@ def make_sp_attention(mesh, axis_name: str = "seq", impl: str = "ring",
             mesh=mesh,
             in_specs=(spec, spec, spec),
             out_specs=spec,
+            check_vma=check_vma,
         )(q, k, v)
 
     return fn

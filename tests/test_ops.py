@@ -1,0 +1,83 @@
+"""Pallas kernel tests (oracle pattern, SURVEY.md §4): flash attention ≡
+full attention.  Runs in Pallas interpret mode on the CPU test mesh — the
+same kernel code that compiles via Mosaic on TPU."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.ops import flash_attention
+from sparkdl_tpu.parallel.context import full_attention
+
+
+def _qkv(b, s, h, d, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [(2, 197, 3, 64),   # ViT-Ti: CLS-token seq, sub-tile head_dim
+     (1, 128, 2, 32),   # exact block multiple
+     (2, 300, 4, 128)], # pad-to-block seq, full-lane head_dim
+)
+def test_flash_matches_full(shape):
+    q, k, v = _qkv(*shape)
+    got = np.asarray(flash_attention(q, k, v))
+    want = np.asarray(full_attention(q, k, v))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_flash_causal():
+    q, k, v = _qkv(1, 197, 2, 64)
+    got = np.asarray(flash_attention(q, k, v, causal=True))
+    want = np.asarray(full_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_flash_kv_len_mask():
+    """kv_len masks trailing keys exactly like the dense oracle."""
+    q, k, v = _qkv(1, 256, 2, 64)
+    got = np.asarray(flash_attention(q, k, v, kv_len=200))
+    want = np.asarray(full_attention(q, k, v, kv_len=200))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_vit_with_flash_attention():
+    """The kernel drops into ViT's attn_impl slot: same params, same
+    logits as the dense schedule."""
+    from sparkdl_tpu.models.vit import ViT
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(2, 32, 32, 3), jnp.float32)
+    dense = ViT(variant="ViT-Ti/16", num_classes=4, image_size=32)
+    variables = dense.init(jax.random.PRNGKey(0), x)
+    flash = ViT(
+        variant="ViT-Ti/16", num_classes=4, image_size=32,
+        attn_impl=flash_attention,
+    )
+    np.testing.assert_allclose(
+        np.asarray(flash.apply(variables, x)),
+        np.asarray(dense.apply(variables, x)),
+        atol=5e-4, rtol=5e-3,
+    )
+
+
+def test_ulysses_flash_local_attention():
+    """Ulysses SP with the Pallas kernel as its local dense step ≡ full
+    attention over the global sequence (8-device CPU mesh)."""
+    from jax.sharding import Mesh
+
+    from sparkdl_tpu.parallel.context import make_sp_attention
+
+    devices = np.asarray(jax.devices()[:4])
+    mesh = Mesh(devices, ("seq",))
+    b, s, h, d = 1, 256, 4, 64
+    q, k, v = _qkv(b, s, h, d, seed=3)
+    fn = make_sp_attention(mesh, "seq", impl="ulysses-flash")
+    got = np.asarray(fn(q, k, v))
+    want = np.asarray(full_attention(q, k, v))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
