@@ -175,7 +175,12 @@ class XlaFunction:
         )
 
     @classmethod
-    def from_keras(cls, model_or_path, name: Optional[str] = None) -> "XlaFunction":
+    def from_keras(
+        cls,
+        model_or_path,
+        name: Optional[str] = None,
+        compute_dtype: Optional[str] = None,
+    ) -> "XlaFunction":
         """From a Keras model or saved .h5/.keras file.
 
         Keras runs on its JAX backend here (enforced in ``sparkdl_tpu``'s
@@ -183,6 +188,12 @@ class XlaFunction:
         whole model jits straight onto TPU — the analog of the reference's
         "load .h5 → freeze to GraphDef" path (``keras_utils.KSessionWrap``†,
         SURVEY.md §3.1) with no graph surgery.
+
+        ``compute_dtype="bfloat16"`` loads a saved file under Keras'
+        ``mixed_bfloat16`` policy (f32 variables, bf16 compute) — saved
+        models default to f32 compute, which halves MXU throughput on
+        TPU.  Only applies to paths: an in-memory model's layers already
+        carry their dtype policy.
         """
         import keras
 
@@ -191,9 +202,31 @@ class XlaFunction:
                 "Keras must use the JAX backend (set KERAS_BACKEND=jax before "
                 "importing keras; importing sparkdl_tpu first does this)."
             )
+        if compute_dtype == "float32":
+            compute_dtype = None  # the saved-model default; a no-op
+        if compute_dtype not in (None, "bfloat16", "float16"):
+            raise ValueError(
+                f"unsupported compute_dtype {compute_dtype!r}; expected "
+                "'float32', 'bfloat16', or 'float16'"
+            )
         if isinstance(model_or_path, (str, os.PathLike)):
             model = keras.saving.load_model(model_or_path, compile=False)
+            if compute_dtype is not None:
+                # saved models serialize per-layer dtype policies, so the
+                # ambient policy at load time is ignored — override each
+                # layer explicitly (variables stay f32; compute narrows)
+                policy = keras.dtype_policies.DTypePolicy(
+                    f"mixed_{compute_dtype}"
+                )
+                for layer in model._flatten_layers():
+                    layer.dtype_policy = policy
         else:
+            if compute_dtype is not None:
+                raise ValueError(
+                    "compute_dtype applies when loading from a saved file; "
+                    "set a keras dtype policy before building in-memory "
+                    "models instead"
+                )
             model = model_or_path
         if not model.built:
             raise ValueError("Keras model must be built (call it once or load from file)")

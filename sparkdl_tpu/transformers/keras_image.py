@@ -42,6 +42,12 @@ class KerasImageFileTransformer(
     batchSize = Param(
         "undefined", "batchSize", "rows per device batch", TypeConverters.toInt
     )
+    computeDtype = Param(
+        "undefined", "computeDtype",
+        "'float32' (saved-model default) or 'bfloat16' (mixed policy: f32 "
+        "variables, bf16 compute - ~2x MXU throughput on TPU)",
+        TypeConverters.toString,
+    )
 
     @keyword_only
     def __init__(
@@ -52,9 +58,11 @@ class KerasImageFileTransformer(
         imageLoader=None,
         outputMode: str = "vector",
         batchSize: int = DEFAULT_BATCH_SIZE,
+        computeDtype: str = "float32",
     ):
         super().__init__()
-        self._setDefault(outputMode="vector", batchSize=DEFAULT_BATCH_SIZE)
+        self._setDefault(outputMode="vector", batchSize=DEFAULT_BATCH_SIZE,
+                         computeDtype="float32")
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
 
@@ -67,6 +75,7 @@ class KerasImageFileTransformer(
         imageLoader=None,
         outputMode: str = "vector",
         batchSize: int = DEFAULT_BATCH_SIZE,
+        computeDtype: str = "float32",
     ):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
@@ -78,7 +87,10 @@ class KerasImageFileTransformer(
         mode = self.getOutputMode()
         batch_size = self.getOrDefault(self.batchSize)
 
-        fn = load_keras_function(self.getModelFile())
+        fn = load_keras_function(
+            self.getModelFile(),
+            compute_dtype=self.getOrDefault(self.computeDtype),
+        )
         params = place_params(fn.params)
         inner = fn._jitted()  # per-instance jit cache -> compile once
 

@@ -366,15 +366,19 @@ def place_params(params, device=None):
 _KERAS_FN_CACHE = LRUCache(8)
 
 
-def load_keras_function(path: str):
-    """``XlaFunction.from_keras`` cached per (path, mtime): repeated
+def load_keras_function(path: str, compute_dtype: Optional[str] = None):
+    """``XlaFunction.from_keras`` cached per (path, mtime, dtype): repeated
     transforms of the same saved model reuse one XlaFunction instance — and
     therefore its per-instance jit cache / compiled XLA program."""
     import os
 
     from sparkdl_tpu.graph.function import XlaFunction
 
-    key = (os.path.abspath(path), os.path.getmtime(path))
+    if compute_dtype == "float32":
+        compute_dtype = None  # same artifact as the default: share the entry
+    key = (os.path.abspath(path), os.path.getmtime(path), compute_dtype)
     if key not in _KERAS_FN_CACHE:
-        _KERAS_FN_CACHE[key] = XlaFunction.from_keras(path)
+        _KERAS_FN_CACHE[key] = XlaFunction.from_keras(
+            path, compute_dtype=compute_dtype
+        )
     return _KERAS_FN_CACHE[key]

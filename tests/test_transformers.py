@@ -292,6 +292,44 @@ def test_keras_image_file_transformer(tpu_session, image_dir, tmp_path):
         )
 
 
+def test_keras_image_file_transformer_bf16(tpu_session, image_dir, tmp_path):
+    """computeDtype='bfloat16' loads the saved model under the
+    mixed_bfloat16 policy; outputs match f32 within bf16 tolerance."""
+    from PIL import Image
+
+    from sparkdl_tpu.transformers.keras_image import KerasImageFileTransformer
+
+    model = keras.Sequential(
+        [
+            keras.layers.Input(shape=(16, 16, 3)),
+            keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+        ]
+    )
+    path = str(tmp_path / "bf16_model.keras")
+    model.save(path)
+
+    def loader(uri):
+        img = Image.open(uri).convert("RGB").resize((16, 16))
+        return np.asarray(img, dtype=np.float32) / 255.0
+
+    df = imageIO.filesToDF(tpu_session, image_dir, numPartitions=2)
+
+    def run(dtype):
+        t = KerasImageFileTransformer(
+            inputCol="filePath", outputCol="out", modelFile=path,
+            imageLoader=loader, batchSize=4, computeDtype=dtype,
+        )
+        rows = t.transform(df).select("filePath", "out").collect()
+        return {r["filePath"]: np.asarray(r["out"]) for r in rows}
+
+    f32 = run("float32")
+    bf16 = run("bfloat16")
+    assert f32.keys() == bf16.keys()
+    for k in f32:
+        np.testing.assert_allclose(bf16[k], f32[k], rtol=2e-2, atol=2e-2)
+
+
 # ---------------------------------------------------------------------------
 # LogisticRegression head + flagship pipeline slice
 # ---------------------------------------------------------------------------
