@@ -72,3 +72,23 @@ def test_vit_finetune_tp_matches_dp_loss(vector_dataset):
     np.testing.assert_allclose(
         tp._training_loss, dp._training_loss, rtol=5e-3, atol=5e-4
     )
+
+
+def test_flax_estimator_with_flash_attention(vector_dataset):
+    """FlaxImageFileEstimator fine-tunes a ViT whose attention runs
+    through the Pallas flash kernel — the DP training step differentiates
+    the custom VJP end-to-end."""
+    from sparkdl_tpu.ops import flash_attention
+
+    est = FlaxImageFileEstimator(
+        inputCol="uri",
+        outputCol="out",
+        labelCol="label",
+        imageLoader=_loader,
+        module=ViT(variant="ViT-Ti/16", num_classes=2, image_size=IMG,
+                   attn_impl=flash_attention),
+        fitParams={"epochs": 1, "batch_size": 16},
+    )
+    model = est.fit(vector_dataset)
+    assert isinstance(model, FlaxImageFileTransformer)
+    assert np.isfinite(model._training_loss)
