@@ -225,6 +225,10 @@ class KerasImageFileEstimator(
             last_loss = float(loss)
             logger.info("epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss)
             if ckpt_dir:
+                # every process calls save: under jax.distributed orbax
+                # saves are collective (primary writes, peers barrier) —
+                # gating on process 0 would wedge the job in orbax's
+                # internal sync
                 self._save_checkpoint(ckpt_dir, epoch + 1, state)
 
         # write tuned weights back into the Keras model and persist it
@@ -297,15 +301,43 @@ class KerasImageFileEstimator(
         root = os.path.join(os.path.abspath(ckpt_dir), self._ckpt_namespace())
         if not os.path.isdir(root):
             return 0, state
+        import orbax.checkpoint as ocp
+
+        def committed(epoch: int) -> bool:
+            # a SIGKILL mid-save leaves an uncommitted directory; orbax
+            # marks finalized checkpoints — never resume from a partial one
+            path = os.path.join(root, f"epoch_{epoch}")
+            try:
+                return ocp.utils.is_checkpoint_finalized(path)
+            except (AttributeError, ValueError):
+                return os.path.isdir(path)
+
         epochs = sorted(
             int(d.split("_")[1])
             for d in os.listdir(root)
             if d.startswith("epoch_") and d.split("_")[1].isdigit()
         )
+        epochs = [e for e in epochs if committed(e)]
+        latest = epochs[-1] if epochs else 0
+        if runner.is_distributed():
+            # every process must resume from the same epoch or the hosts
+            # run different numbers of collective steps and the job
+            # wedges; a host-local (non-shared) checkpointDir is the way
+            # this happens, so fail fast with the real cause
+            from jax.experimental import multihost_utils
+
+            all_latest = np.asarray(
+                multihost_utils.process_allgather(np.int32(latest))
+            ).reshape(-1)
+            if len(set(int(x) for x in all_latest)) != 1:
+                raise RuntimeError(
+                    "hosts disagree on the latest checkpoint epoch "
+                    f"({sorted(set(int(x) for x in all_latest))}); "
+                    "checkpointDir must be shared storage visible to "
+                    "every process"
+                )
         if not epochs:
             return 0, state
-        latest = epochs[-1]
-        import orbax.checkpoint as ocp
 
         with ocp.StandardCheckpointer() as ckptr:
             restored = ckptr.restore(

@@ -58,6 +58,9 @@ def main():
         [{"uri": u, "label": [float(l)]} for u, l in meta["rows"]]
     )
 
+    import logging
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stdout)
     est = KerasImageFileEstimator(
         inputCol="uri",
         outputCol="out",
@@ -67,6 +70,7 @@ def main():
         kerasOptimizer="sgd",
         kerasLoss="mse",
         kerasFitParams=meta["fit_params"],
+        checkpointDir=meta.get("checkpoint_dir"),
     )
     fitted = est.fit(df)
 
