@@ -18,6 +18,150 @@ import jax
 from sparkdl_tpu.parallel import runner
 
 
+def collect_host_shard_rows(
+    dataset,
+    input_col: str,
+    label_col: str,
+) -> Tuple[List[str], List[Any], int]:
+    """Collect (URI, label) rows and keep this host's strided shard —
+    without loading any images.  Returns ``(uris, labels, n_global)``."""
+    rows = dataset.select(input_col, label_col).collect()
+    if not rows:
+        raise ValueError("fit() received an empty dataset")
+    n_global = len(rows)
+    if runner.is_distributed():
+        nprocs = jax.process_count()
+        if n_global < nprocs:
+            raise ValueError(
+                f"fit() needs at least one row per host: got {n_global} "
+                f"rows across {nprocs} processes"
+            )
+        keep = runner.host_shard_indices(n_global)
+        rows = [rows[i] for i in keep]
+    uris = [r[input_col] for r in rows]
+    labels = [r[label_col] for r in rows]
+    return uris, labels, n_global
+
+
+class StreamingShardLoader:
+    """Batch stream over a host shard that holds only URIs in memory.
+
+    The in-memory path loads the whole shard up front (reference
+    ``_getNumpyFeaturesAndLabels``† behavior); for datasets that don't
+    fit in host RAM this loader materializes one batch at a time, with a
+    background thread prefetching the next batches while the device
+    steps.
+
+    Determinism contract: given the same (seed, epoch) it reproduces the
+    exact batch composition of the in-memory path — same permutation
+    stream, same cyclic padding — so streaming vs in-memory fits are
+    bit-comparable (pinned by ``tests/test_estimators.py``).
+    """
+
+    def __init__(
+        self,
+        uris: List[str],
+        y: np.ndarray,
+        loader: Callable[[str], Any],
+        local_bs: int,
+        weighted: bool,
+        max_workers: int = 16,
+        prefetch: int = 2,
+    ):
+        self.uris = uris
+        self.y = y
+        self.loader = loader
+        self.local_bs = int(local_bs)
+        self.weighted = bool(weighted)
+        self.max_workers = max_workers
+        self.prefetch = max(1, int(prefetch))
+
+    def _load_batch(self, pool, idx, k):
+        xs = list(pool.map(
+            lambda i: np.asarray(self.loader(self.uris[i]), np.float32), idx
+        ))
+        batch = {"x": np.stack(xs), "y": self.y[idx]}
+        if self.weighted:
+            w = np.zeros(self.local_bs, np.float32)
+            w[:k] = 1.0
+            batch["w"] = w
+        return batch
+
+    def epoch(self, order: np.ndarray, steps: int):
+        """Yield ``steps`` batches following ``order`` (the epoch
+        permutation), cyclically padded exactly like the in-memory path."""
+        import queue
+        import threading
+
+        plan = []
+        for step_i in range(steps):
+            idx = order[step_i * self.local_bs:(step_i + 1) * self.local_bs]
+            k = len(idx)
+            if k < self.local_bs:
+                idx = np.concatenate(
+                    [idx, np.resize(order, self.local_bs - k)]
+                )
+            plan.append((idx, k))
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        err: List[BaseException] = []
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer is gone, so an
+            # abandoned epoch (step error / generator close) can't leave
+            # the producer blocked forever holding its pool and batches
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                with ThreadPoolExecutor(
+                    max_workers=self.max_workers
+                ) as pool:
+                    for idx, k in plan:
+                        if not put(self._load_batch(pool, idx, k)):
+                            return
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                put(None)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        produced = 0
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                produced += 1
+                yield item
+        finally:
+            stop.set()
+            t.join()
+        if err:
+            raise err[0]
+        if produced != steps:
+            raise RuntimeError(
+                f"streaming loader produced {produced}/{steps} batches"
+            )
+
+
+def labels_to_array(labels: List[Any]) -> np.ndarray:
+    """Scalar labels -> int32 class ids; vector labels -> float32 rows
+    (one dtype policy for both estimator data paths)."""
+    first = np.asarray(labels[0])
+    if first.ndim == 0:
+        return np.asarray(labels, dtype=np.int32)
+    return np.stack([np.asarray(l, dtype=np.float32) for l in labels])
+
+
 def load_host_shard(
     dataset,
     input_col: str,
@@ -34,26 +178,13 @@ def load_host_shard(
     multi-host run has fewer rows than hosts, so no peer deadlocks inside a
     collective waiting for a crashed host.
     """
-    rows = dataset.select(input_col, label_col).collect()
-    if not rows:
-        raise ValueError("fit() received an empty dataset")
-    n_global = len(rows)
-    if runner.is_distributed():
-        nprocs = jax.process_count()
-        if n_global < nprocs:
-            raise ValueError(
-                f"fit() needs at least one row per host: got {n_global} "
-                f"rows across {nprocs} processes"
-            )
-        keep = runner.host_shard_indices(n_global)
-        rows = [rows[i] for i in keep]
+    uris, labels, n_global = collect_host_shard_rows(
+        dataset, input_col, label_col
+    )
     with ThreadPoolExecutor(max_workers=max_workers) as pool:
         images = list(
             pool.map(
-                lambda r: np.asarray(loader(r[input_col]), dtype=np.float32),
-                rows,
+                lambda u: np.asarray(loader(u), dtype=np.float32), uris
             )
         )
-    x = np.stack(images)
-    labels = [r[label_col] for r in rows]
-    return x, labels, n_global
+    return np.stack(images), labels, n_global

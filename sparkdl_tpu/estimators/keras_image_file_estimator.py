@@ -29,7 +29,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sparkdl_tpu.estimators.data import load_host_shard
+from sparkdl_tpu.estimators.data import (
+    StreamingShardLoader,
+    collect_host_shard_rows,
+    labels_to_array,
+    load_host_shard,
+)
 from sparkdl_tpu.estimators.losses import (
     get_loss_fn,
     get_optimizer,
@@ -138,20 +143,26 @@ class KerasImageFileEstimator(
             self.getLabelCol(),
             self.getImageLoader(),
         )
-        first = np.asarray(labels[0])
-        if first.ndim == 0:
-            y = np.asarray(labels, dtype=np.int32)
-        else:
-            y = np.stack([np.asarray(l, dtype=np.float32) for l in labels])
-        return x, y, n_global
+        return x, labels_to_array(labels), n_global
 
     # ------------------------------------------------------------------
     def _fit(self, dataset):
         self._validateParams()
         import keras
 
-        x, y, n_global = self._getNumpyFeaturesAndLabels(dataset)
         fit_params = dict(self.getKerasFitParams() or {})
+        # streaming=True: keep only URIs host-side and load image batches
+        # on demand with a prefetch thread (datasets beyond host RAM);
+        # composition is batch-identical to the in-memory path
+        streaming = bool(fit_params.get("streaming", False))
+        if streaming:
+            uris, labels, n_global = collect_host_shard_rows(
+                dataset, self.getInputCol(), self.getLabelCol()
+            )
+            y = labels_to_array(labels)
+            x = None
+        else:
+            x, y, n_global = self._getNumpyFeaturesAndLabels(dataset)
         epochs = int(fit_params.get("epochs", 1))
         batch_size = int(fit_params.get("batch_size", 32))
         learning_rate = fit_params.get("learning_rate")
@@ -184,7 +195,14 @@ class KerasImageFileEstimator(
             # every process) — lift them onto the global mesh, replicated
             state = runner.replicate(state, mesh)
 
-        n = x.shape[0]  # this host's rows (== n_global when single-host)
+        n = len(uris) if streaming else x.shape[0]  # this host's rows
+        stream = (
+            StreamingShardLoader(
+                uris, y, self.getImageLoader(), local_bs, weighted
+            )
+            if streaming
+            else None
+        )
         # identical step count on every host, derived from the global row
         # count: the largest host shard, padded up to whole local batches
         max_local_rows = -(-n_global // nprocs)
@@ -200,28 +218,36 @@ class KerasImageFileEstimator(
             )
         rng = np.random.RandomState((seed * 7919 + jax.process_index()) % 2**32)
         last_loss = None
+        def place(batch):
+            if distributed:
+                return runner.global_batch(batch, mesh)
+            batch = jax.tree_util.tree_map(jnp.asarray, batch)
+            return shard_batch(batch, mesh)
+
         for epoch in range(start_epoch, epochs):
             order = rng.permutation(n)
-            for step_i in range(steps_per_epoch):
-                idx = order[step_i * local_bs : (step_i + 1) * local_bs]
-                k = len(idx)
-                if k < local_bs:
-                    # pad cyclically to the full local batch so every host
-                    # contributes the same shape (even when n < local_bs);
-                    # with a known loss the pad rows carry zero weight, so
-                    # the update is the exact mean over the real rows
-                    idx = np.concatenate([idx, np.resize(order, local_bs - k)])
-                batch = {"x": x[idx], "y": y[idx]}
-                if weighted:
-                    w = np.zeros(local_bs, np.float32)
-                    w[:k] = 1.0
-                    batch["w"] = w
-                if distributed:
-                    batch = runner.global_batch(batch, mesh)
-                else:
-                    batch = jax.tree_util.tree_map(jnp.asarray, batch)
-                    batch = shard_batch(batch, mesh)
-                state, loss = step_fn(state, batch)
+            if streaming:
+                for batch in stream.epoch(order, steps_per_epoch):
+                    state, loss = step_fn(state, place(batch))
+            else:
+                for step_i in range(steps_per_epoch):
+                    idx = order[step_i * local_bs : (step_i + 1) * local_bs]
+                    k = len(idx)
+                    if k < local_bs:
+                        # pad cyclically to the full local batch so every
+                        # host contributes the same shape (even when n <
+                        # local_bs); with a known loss the pad rows carry
+                        # zero weight, so the update is the exact mean
+                        # over the real rows
+                        idx = np.concatenate(
+                            [idx, np.resize(order, local_bs - k)]
+                        )
+                    batch = {"x": x[idx], "y": y[idx]}
+                    if weighted:
+                        w = np.zeros(local_bs, np.float32)
+                        w[:k] = 1.0
+                        batch["w"] = w
+                    state, loss = step_fn(state, place(batch))
             last_loss = float(loss)
             logger.info("epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss)
             if ckpt_dir:
@@ -270,7 +296,14 @@ class KerasImageFileEstimator(
         import hashlib
         import json
 
-        fit_params = self.getKerasFitParams() or {}
+        fit_params = {
+            k: v
+            for k, v in (self.getKerasFitParams() or {}).items()
+            # data-plane knobs with no effect on the training trajectory
+            # (streaming is batch-identical by contract) must not change
+            # the namespace, or toggling them orphans the checkpoints
+            if k != "streaming"
+        }
         payload = json.dumps(
             {
                 "modelFile": os.path.abspath(str(self.getModelFile())),

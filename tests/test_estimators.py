@@ -260,3 +260,22 @@ def test_cross_validator_picks_best(tpu_session):
         cv_model.transform(df)
     )
     assert acc == 1.0
+
+
+def test_streaming_fit_identical_to_in_memory(labeled_df, tmp_path):
+    """kerasFitParams streaming=True (URIs only in memory, prefetch-thread
+    batch loading) produces bit-identical weights to the in-memory path:
+    same permutation stream, same cyclic padding."""
+    _, model_path = _tiny_model(tmp_path)
+
+    def fit(streaming):
+        est = _make_estimator(
+            model_path, epochs=3, batch_size=8, learning_rate=0.05, seed=3,
+            streaming=streaming,
+        )
+        fitted = est.fit(labeled_df)
+        m = keras.saving.load_model(fitted.getModelFile(), compile=False)
+        return [np.asarray(w) for w in m.get_weights()]
+
+    for got, want in zip(fit(True), fit(False)):
+        np.testing.assert_array_equal(got, want)
