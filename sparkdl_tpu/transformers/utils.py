@@ -11,12 +11,18 @@ rules applied here:
   transform, never re-shipped per batch (a 1000x difference through the
   PJRT tunnel — see .claude/skills/verify/SKILL.md);
 - **device-side resize**: images are grouped by source shape and resized in
-  batched jitted calls (the reference resized per-row inside its TF graph).
+  batched jitted calls (the reference resized per-row inside its TF graph);
+- **data-parallel inference**: with more than one local chip, params are
+  replicated over a 1-D ``data`` mesh and every batch's leading dim is
+  sharded across it, so the one jitted program runs SPMD over ICI — the
+  analog of the reference fanning inference out across Spark executors
+  (SURVEY.md §2 "Data-parallel inference").
 """
 
 from __future__ import annotations
 
 import logging
+import os
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -24,6 +30,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +75,55 @@ class LRUCache:
 
 
 _resize_cache = LRUCache(16)
+
+# Resolved once per process (a 1-tuple holding the Mesh or None): callers
+# place params at build/registration time but batches are placed per call,
+# so the decision must not drift between the two (e.g. a UDF registered,
+# then the env var changed, then a query run would mix placements and jit
+# would reject the incompatible devices).
+_dp_mesh_choice: Optional[Tuple[Optional[Mesh]]] = None
+
+
+def data_parallel_mesh() -> Optional[Mesh]:
+    """The inference mesh: a 1-D ``data`` axis over the local devices of the
+    default backend, or ``None`` when inference should stay single-device.
+
+    The reference scaled inference by giving every Spark executor its own TF
+    session over a partition of the DataFrame (SURVEY.md §2).  The TPU-native
+    analog is one SPMD program per batch shape whose leading dim is sharded
+    across all local chips; XLA lays the collective-free per-row compute out
+    over ICI with zero cross-chip traffic.
+
+    ``SPARKDL_INFERENCE_DEVICES`` controls it: unset/empty/``all`` uses every
+    local device, ``1``/``off``/``none`` forces single-device, an integer
+    ``N`` uses the first N.  Read once per process — params placed at stage
+    build / UDF registration time and batches placed per call must agree.
+    """
+    global _dp_mesh_choice
+    if _dp_mesh_choice is not None:
+        return _dp_mesh_choice[0]
+    spec = os.environ.get("SPARKDL_INFERENCE_DEVICES", "all").strip().lower()
+    if spec in ("0", "1", "none", "off"):
+        _dp_mesh_choice = (None,)
+        return None
+    if spec in ("", "all"):
+        devices = jax.local_devices()
+    elif spec.isdigit():
+        devices = jax.local_devices()[: int(spec)]
+    else:
+        raise ValueError(
+            "SPARKDL_INFERENCE_DEVICES must be 'all', 'off', or a device "
+            f"count; got {spec!r}"
+        )
+    mesh = Mesh(np.asarray(devices), ("data",)) if len(devices) > 1 else None
+    _dp_mesh_choice = (mesh,)
+    return mesh
+
+
+def _reset_data_parallel_mesh_for_testing() -> None:
+    """Drop the process-cached mesh decision (tests flip the env var)."""
+    global _dp_mesh_choice
+    _dp_mesh_choice = None
 
 
 def _host_resize_one(img: np.ndarray, height: int, width: int) -> np.ndarray:
@@ -283,6 +339,11 @@ def run_batched_multi(
     ``batch_size`` (and sliced back) so only one batch shape is ever compiled
     — small partitions also pad up rather than compiling their own shape.
 
+    With a multi-device :func:`data_parallel_mesh`, every (padded, fixed
+    shape) chunk is placed with its leading dim sharded across the mesh, so
+    ``fn`` — whose params were replicated by :func:`place_params` — compiles
+    to one SPMD program spanning all local chips.
+
     Returns one concatenated array per function output.
     """
     from sparkdl_tpu.utils.metrics import metrics
@@ -291,6 +352,21 @@ def run_batched_multi(
     n = arrays[0].shape[0]
     if n == 0:
         raise ValueError("run_batched requires non-empty inputs")
+    mesh = data_parallel_mesh()
+    if mesh is not None:
+        # padded chunks are always exactly batch_size rows; round the batch
+        # up to a mesh multiple so the shards are equal-sized
+        n_dev = int(mesh.devices.size)
+        batch_size = -(-batch_size // n_dev) * n_dev
+        # P("data") shards the leading dim; unmentioned trailing dims are
+        # replicated, so one sharding serves every input rank
+        sharding = NamedSharding(mesh, PartitionSpec("data"))
+
+        def _place(c):
+            return jax.device_put(c, sharding)
+
+    else:
+        _place = jnp.asarray
     collected: Optional[List[List[np.ndarray]]] = None
     forward_timer = metrics.timer("sparkdl.forward")
     with maybe_trace(), forward_timer.time():
@@ -304,7 +380,7 @@ def run_batched_multi(
                     )
                     for c in chunks
                 ]
-            results = fn(*[jnp.asarray(c) for c in chunks])
+            results = fn(*[_place(c) for c in chunks])
             if not isinstance(results, (tuple, list)):
                 results = (results,)
             if collected is None:
@@ -358,8 +434,15 @@ def normalize_channels(img: np.ndarray, n_channels: int) -> np.ndarray:
 
 
 def place_params(params, device=None):
-    """Pin a params pytree to the accelerator once per transform."""
-    device = device or jax.devices()[0]
+    """Pin a params pytree to the accelerator(s) once per transform: with
+    more than one local device (and no explicit ``device``) the pytree is
+    replicated over the :func:`data_parallel_mesh` so batches sharded on the
+    ``data`` axis run SPMD; otherwise it lands on the one default device."""
+    if device is None:
+        mesh = data_parallel_mesh()
+        if mesh is not None:
+            return jax.device_put(params, NamedSharding(mesh, PartitionSpec()))
+        device = jax.devices()[0]
     return jax.device_put(params, device)
 
 

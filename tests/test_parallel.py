@@ -100,6 +100,51 @@ def test_dp_grads_match_single_device(mesh):
                                    atol=1e-6)
 
 
+def test_dp_inference_shards_batches_across_mesh(monkeypatch):
+    """The transformer runtime's data-parallel inference: params replicated
+    over the local-device mesh, batch leading dim sharded — output must be
+    invariant to whether the mesh is used (the inference analog of the DP
+    gradient invariant above)."""
+    from sparkdl_tpu.transformers import utils as tu
+
+    rng = np.random.RandomState(2)
+    w = rng.randn(8, 5).astype(np.float32)
+    # 37 rows: exercises the padded ragged final chunk under sharding
+    data = rng.randn(37, 8).astype(np.float32)
+
+    def run():
+        params = tu.place_params({"w": jnp.asarray(w)})
+        fn = jax.jit(lambda x: jnp.tanh(x @ params["w"]))
+        return tu.run_batched(fn, data, batch_size=10), params
+
+    # the mesh decision is process-cached (placement at stage-build time and
+    # batch placement at call time must agree), so reset around env flips
+    monkeypatch.delenv("SPARKDL_INFERENCE_DEVICES", raising=False)
+    tu._reset_data_parallel_mesh_for_testing()
+    try:
+        mesh = tu.data_parallel_mesh()
+        assert mesh is not None and int(mesh.devices.size) == 8
+
+        out_dp, params_dp = run()
+        # params actually replicated across all 8 devices
+        assert len(params_dp["w"].sharding.device_set) == 8
+
+        monkeypatch.setenv("SPARKDL_INFERENCE_DEVICES", "1")
+        # without a reset the cached decision stays: registration-time and
+        # call-time placements keep agreeing even if the env var drifts
+        assert tu.data_parallel_mesh() is mesh
+        tu._reset_data_parallel_mesh_for_testing()
+        out_single, params_single = run()
+        assert len(params_single["w"].sharding.device_set) == 1
+    finally:
+        tu._reset_data_parallel_mesh_for_testing()
+
+    assert out_dp.shape == (37, 5)
+    np.testing.assert_allclose(out_dp, out_single, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(out_dp, np.tanh(data @ w), rtol=1e-5,
+                               atol=1e-6)
+
+
 def test_graft_dryrun_multichip():
     # conftest already provides the 8-device CPU platform in-process; the
     # subprocess isolation itself is covered by tests/test_graft_contract.py.
