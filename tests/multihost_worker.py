@@ -1,10 +1,16 @@
 """Subprocess worker for ``tests/test_multihost.py``.
 
-One process of an N-process multi-host training job on the virtual CPU
-platform: 4 local devices per process, gloo TCP collectives between
-processes (the CPU stand-in for ICI/DCN — SURVEY.md §4 "Implication",
-§5.8).  Runs ``KerasImageFileEstimator.fit`` end-to-end: per-host data
-shard loading, global-mesh shard_map step, cross-process gradient psum.
+One process of an N-process multi-host job on the virtual CPU platform:
+4 local devices per process, gloo TCP collectives between processes (the
+CPU stand-in for ICI/DCN — SURVEY.md §4 "Implication", §5.8).
+
+Phases (``meta.json`` ``"phase"``):
+- ``"fit"`` (default): ``KerasImageFileEstimator.fit`` end-to-end — per-host
+  data shard loading, global-mesh shard_map step, cross-process gradient psum.
+- ``"transform"``: multi-host *inference*, the Spark-executor analog — each
+  host transforms only its own row shard (``runner.host_shard_indices``),
+  embarrassingly parallel, no collectives in the hot path; the test
+  reassembles the shards and compares to a single-process transform.
 
 Usage: ``python multihost_worker.py <pid> <nproc> <port> <workdir>``
 """
@@ -54,6 +60,11 @@ def main():
     with open(os.path.join(workdir, "meta.json")) as f:
         meta = json.load(f)
     spark = TPUSession.builder.master("local[*]").getOrCreate()
+
+    if meta.get("phase") == "transform":
+        _transform_phase(pid, workdir, meta, spark, runner)
+        return
+
     df = spark.createDataFrame(
         [{"uri": u, "label": [float(l)]} for u, l in meta["rows"]]
     )
@@ -82,6 +93,32 @@ def main():
         *[np.asarray(w) for w in m.get_weights()],
     )
     runner.barrier("multihost_worker_done")
+    print(f"MULTIHOST_WORKER_OK {pid}", flush=True)
+
+
+def _transform_phase(pid, workdir, meta, spark, runner):
+    """Per-host-shard batch inference: the reference's executors-each-run-
+    their-partitions flow (SURVEY.md §3.1), one host per shard."""
+    import numpy as np
+
+    from sparkdl_tpu.transformers.keras_image import KerasImageFileTransformer
+
+    rows = meta["rows"]
+    shard = runner.host_shard_indices(len(rows))
+    df = spark.createDataFrame([{"uri": rows[i][0]} for i in shard])
+    t = KerasImageFileTransformer(
+        inputCol="uri",
+        outputCol="out",
+        modelFile=os.path.join(workdir, "model.keras"),
+        imageLoader=load_vector,
+    )
+    got = t.transform(df).collect()
+    np.savez(
+        os.path.join(workdir, f"transform_proc{pid}.npz"),
+        indices=np.asarray(shard),
+        outputs=np.stack([np.asarray(r.out.toArray()) for r in got]),
+    )
+    runner.barrier("multihost_transform_done")
     print(f"MULTIHOST_WORKER_OK {pid}", flush=True)
 
 

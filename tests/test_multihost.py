@@ -113,15 +113,9 @@ def _launch_workers(tmp_path, port, phase, env):
     return procs, logs
 
 
-@pytest.mark.slow
-def test_two_process_fit_matches_single_process(tmp_path, tpu_session):
-    rows, model_path = _make_workdir(tmp_path)
-    oracle = _single_process_fit(tpu_session, rows, model_path)
-
-    port = _free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
-    procs, logs = _launch_workers(tmp_path, port, "fit", env)
+def _wait_workers(procs, logs, what="worker"):
+    """Wait for every worker, collect its file-backed log, kill stragglers,
+    and assert clean exits; returns the log texts."""
     outs = []
     try:
         for p in procs:
@@ -137,8 +131,21 @@ def test_two_process_fit_matches_single_process(tmp_path, tpu_session):
         for lg in logs:
             lg.close()
     for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert p.returncode == 0, f"{what} {pid} failed:\n{out[-4000:]}"
         assert f"MULTIHOST_WORKER_OK {pid}" in out
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_fit_matches_single_process(tmp_path, tpu_session):
+    rows, model_path = _make_workdir(tmp_path)
+    oracle = _single_process_fit(tpu_session, rows, model_path)
+
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs, logs = _launch_workers(tmp_path, port, "fit", env)
+    _wait_workers(procs, logs)
 
     w0 = np.load(tmp_path / "weights_proc0.npz")
     w1 = np.load(tmp_path / "weights_proc1.npz")
@@ -149,6 +156,45 @@ def test_two_process_fit_matches_single_process(tmp_path, tpu_session):
     # step; tolerance covers collective reduction-order float drift)
     for got, want in zip([w0[k] for k in w0.files], oracle):
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_transform_shards_match_single_process(
+    tmp_path, tpu_session
+):
+    """Multi-host inference: each host transforms only its own row shard
+    (the Spark-executor analog — embarrassingly parallel, no collectives);
+    the reassembled shards must equal one single-process transform."""
+    rows, model_path = _make_workdir(tmp_path)
+    with open(tmp_path / "meta.json", "w") as f:
+        json.dump({"rows": rows, "phase": "transform"}, f)
+
+    # single-process oracle over the full row set
+    from sparkdl_tpu.transformers.keras_image import KerasImageFileTransformer
+    from tests.multihost_worker import load_vector
+
+    df = tpu_session.createDataFrame([{"uri": u} for u, _ in rows])
+    t = KerasImageFileTransformer(
+        inputCol="uri", outputCol="out", modelFile=model_path,
+        imageLoader=load_vector,
+    )
+    oracle = np.stack(
+        [np.asarray(r.out.toArray()) for r in t.transform(df).collect()]
+    )
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs, logs = _launch_workers(tmp_path, _free_port(), "transform", env)
+    _wait_workers(procs, logs)
+
+    got = np.full_like(oracle, np.nan)
+    covered = np.zeros(len(rows), dtype=bool)
+    for pid in range(2):
+        shard = np.load(tmp_path / f"transform_proc{pid}.npz")
+        got[shard["indices"]] = shard["outputs"]
+        covered[shard["indices"]] = True
+    assert covered.all(), "host shards must cover every row exactly"
+    np.testing.assert_allclose(got, oracle, rtol=1e-5, atol=1e-6)
 
 
 @pytest.mark.slow
@@ -209,23 +255,7 @@ def test_elastic_restart_resumes_multihost_fit(tmp_path):
 
     # re-dispatch: fresh coordinator, fresh processes, same config
     procs, logs = _launch_workers(tmp_path, _free_port(), "phase2", env)
-    outs = []
-    try:
-        for p in procs:
-            p.wait(timeout=600)
-        for lg in logs:
-            lg.seek(0)
-            outs.append(lg.read())
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
-        for lg in logs:
-            lg.close()
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"relaunched worker {pid} failed:\n{out[-4000:]}"
-        assert f"MULTIHOST_WORKER_OK {pid}" in out
+    outs = _wait_workers(procs, logs, what="relaunched worker")
     assert any("resuming from checkpoint" in out for out in outs), (
         "relaunched job did not resume from the surviving checkpoint"
     )
