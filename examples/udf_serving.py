@@ -1,0 +1,79 @@
+"""SQL-UDF model serving, end to end — the reference's L4 flow.
+
+Mirrors the upstream README's ``registerKerasImageUDF`` example
+(``python/sparkdl/udf/keras_image_model.py``†, SURVEY.md §3.3): register a
+Keras model as a named SQL UDF, then score an image view with plain SQL —
+plus the ``makeGraphUDF`` analog for an arbitrary composed ``XlaFunction``.
+Offline-safe: builds a tiny Keras CNN in-process.  Works on the real TPU or
+the virtual CPU mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/udf_serving.py
+"""
+
+import os
+import tempfile
+
+import numpy as np
+from PIL import Image
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+
+def make_images(root: str, n: int = 12, size: int = 32):
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        Image.fromarray(
+            rng.randint(0, 255, (size, size, 3), np.uint8)
+        ).save(os.path.join(root, f"img_{i}.png"))
+
+
+def main():
+    import keras
+
+    from sparkdl_tpu import makeGraphUDF, registerKerasImageUDF
+    from sparkdl_tpu.graph.function import XlaFunction
+    from sparkdl_tpu.image import imageIO
+    from sparkdl_tpu.sql.session import TPUSession
+
+    spark = TPUSession.builder.master("local[*]").getOrCreate()
+    root = tempfile.mkdtemp(prefix="udf_imgs_")
+    make_images(root)
+    df = imageIO.readImages(root, spark, numPartitions=2)
+    df.createOrReplaceTempView("images")
+
+    # a tiny classifier standing in for InceptionV3 (offline; same plumbing)
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential(
+        [
+            keras.layers.Input(shape=(32, 32, 3)),
+            keras.layers.Conv2D(8, 3, activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(4, activation="softmax"),
+        ]
+    )
+
+    registerKerasImageUDF("my_cnn", model, session=spark)
+    scored = spark.sql("SELECT my_cnn(image) AS probs FROM images").collect()
+    print(f"SQL-UDF scored {len(scored)} rows; "
+          f"first probs: {np.round(np.asarray(scored[0].probs.toArray()), 3)}")
+
+    # makeGraphUDF: any XlaFunction over tensor columns (the reference's
+    # TensorFrames makeGraphUDF analog) — here a composed normalize -> mean
+    rng = np.random.RandomState(1)
+    tensors = spark.createDataFrame(
+        [{"x": rng.rand(16).astype(np.float32).tolist()} for _ in range(8)]
+    )
+    tensors.createOrReplaceTempView("tensors")
+    norm = XlaFunction.from_callable(lambda x: x * 2.0 - 1.0, name="normalize")
+    mean = XlaFunction.from_callable(lambda x: x.mean(axis=-1), name="mean")
+    makeGraphUDF(norm.compose(mean), "centered_mean", session=spark)
+    got = spark.sql(
+        "SELECT centered_mean(x) AS m FROM tensors LIMIT 3"
+    ).collect()
+    print("centered means of first rows:",
+          [round(float(r.m), 4) for r in got])
+
+
+if __name__ == "__main__":
+    main()
