@@ -52,7 +52,14 @@ def main():
         runner.initialize()
 
     spark = TPUSession.builder.master("local[*]").getOrCreate()
-    root = tempfile.mkdtemp(prefix="finetune_")
+    # a STABLE working dir (data, base model, checkpoints): the checkpoint
+    # namespace includes the modelFile path, so a per-run tempdir would give
+    # every run a fresh namespace and resume-after-kill could never engage
+    root = os.environ.get(
+        "SPARKDL_DEMO_DIR",
+        os.path.join(tempfile.gettempdir(), "sparkdl_finetune_demo"),
+    )
+    os.makedirs(root, exist_ok=True)
 
     rng = np.random.RandomState(0)
     rows = []
@@ -86,12 +93,9 @@ def main():
         kerasOptimizer="adam",
         kerasLoss="sparse_categorical_crossentropy",
         kerasFitParams={"epochs": 6, "batch_size": 16, "learning_rate": 1e-3},
-        # a STABLE path, so a killed run resumes from its last committed
-        # epoch on relaunch (a per-run tempdir would never resume)
-        checkpointDir=os.environ.get(
-            "SPARKDL_CKPT_DIR",
-            os.path.join(tempfile.gettempdir(), "sparkdl_finetune_ckpt"),
-        ),
+        # under the stable root: a killed run resumes from its last
+        # committed epoch on relaunch
+        checkpointDir=os.path.join(root, "ckpt"),
     )
 
     # hyperparameter search: fitMultiple fans the grid out (the reference's

@@ -1,0 +1,71 @@
+"""The runnable examples must stay runnable — each is a documented user
+flow (README "examples/" pointer), so rot there is a user-facing break.
+
+Each example runs in a fresh subprocess on the virtual CPU mesh (the same
+forced-platform pattern as ``__graft_entry__.dryrun_multichip``) and must
+exit 0 after printing its success line.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+
+def _run_example(name: str, timeout: int = 900, extra_env=None) -> str:
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KERAS_BACKEND"] = "jax"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    # the axon sitecustomize may pin the TPU platform before env vars land,
+    # so force CPU through the live config first (see conftest.py)
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "import runpy; runpy.run_path("
+        f"{os.path.join(_REPO, 'examples', name)!r}, run_name='__main__')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"{name} failed (rc={proc.returncode}):\n{proc.stderr[-4000:]}"
+    )
+    return proc.stdout
+
+
+@pytest.mark.slow
+def test_transfer_learning_example():
+    out = _run_example("transfer_learning.py")
+    assert "transfer-learning accuracy" in out
+    assert "reloaded pipeline reproduces accuracy" in out
+
+
+@pytest.mark.slow
+def test_udf_serving_example():
+    out = _run_example("udf_serving.py")
+    assert "SQL-UDF scored 12 rows" in out
+    assert "centered means of first rows" in out
+
+
+@pytest.mark.slow
+def test_distributed_finetune_example(tmp_path):
+    out = _run_example(
+        "distributed_finetune.py",
+        extra_env={"SPARKDL_DEMO_DIR": str(tmp_path / "demo")},
+    )
+    assert "fitMultiple trained 2 models" in out
+    assert "train accuracy" in out
