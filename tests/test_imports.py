@@ -1,0 +1,48 @@
+"""Every module imports; every advertised export resolves.
+
+Guard for the round-1 failure class: ``sparkdl_tpu/__init__.py`` advertised
+``registerKerasImageUDF`` while the implementing module did not exist, so
+the package façade raised ``ModuleNotFoundError`` on first use.  Lazy (PEP
+562) exports make that mistake silent until touched — so touch everything.
+"""
+
+import importlib
+import pkgutil
+
+import sparkdl_tpu
+
+
+# plain ctypes shared libraries (loaded via CDLL, not importable as
+# CPython extension modules) that pkgutil sees as modules
+_CTYPES_LIBS = {
+    "sparkdl_tpu.native._batchpack",
+    "sparkdl_tpu.native._pjrt_runner",
+}
+
+
+def test_every_module_imports():
+    failures = []
+    for info in pkgutil.walk_packages(
+        sparkdl_tpu.__path__,
+        prefix="sparkdl_tpu.",
+        # a subpackage __init__ that fails to import would otherwise have
+        # its whole subtree silently skipped during the walk's recursion
+        onerror=lambda name: failures.append(f"{name}: walk failed"),
+    ):
+        if info.name in _CTYPES_LIBS:
+            continue
+        try:
+            importlib.import_module(info.name)
+        except Exception as exc:  # noqa: BLE001 - collect all failures
+            failures.append(f"{info.name}: {type(exc).__name__}: {exc}")
+    assert not failures, "unimportable modules:\n" + "\n".join(failures)
+
+
+def test_every_advertised_export_resolves():
+    for name in sparkdl_tpu.__all__:
+        obj = getattr(sparkdl_tpu, name)
+        assert obj is not None, name
+
+
+def test_dir_covers_exports():
+    assert set(sparkdl_tpu.__all__) <= set(dir(sparkdl_tpu))
