@@ -77,6 +77,43 @@ def test_register_keras_image_udf_sql_oracle(
                                    atol=1e-4)
 
 
+def test_register_keras_image_udf_bfloat16_compute(
+    tpu_session, image_df, keras_model, keras_model_file
+):
+    """computeDtype='bfloat16' serves the same predictions within bf16
+    tolerance (variables stay f32; compute narrows — the serving-path
+    analog of the transformer's mixed policy)."""
+    from sparkdl_tpu.udf import registerKerasImageUDF
+
+    registerKerasImageUDF(
+        "small_cnn_bf16", keras_model_file, computeDtype="bfloat16"
+    )
+    image_df.createOrReplaceTempView("images_udf_bf16")
+    out = tpu_session.sql(
+        "SELECT filePath, small_cnn_bf16(image) AS preds FROM images_udf_bf16"
+    ).collect()
+
+    rows = image_df.collect()
+    want = _oracle(keras_model, rows)
+    by_path = {r.filePath: np.asarray(r.preds) for r in out}
+    for row, w in zip(rows, want):
+        np.testing.assert_allclose(
+            by_path[row.filePath], w, rtol=3e-2, atol=3e-2
+        )
+
+
+def test_register_keras_image_udf_bf16_rejects_in_memory_model(
+    tpu_session, keras_model
+):
+    from sparkdl_tpu.udf import registerKerasImageUDF
+
+    with pytest.raises(ValueError, match="computeDtype"):
+        registerKerasImageUDF(
+            "nope", keras_model, computeDtype="bfloat16",
+            session=tpu_session,
+        )
+
+
 def test_register_keras_image_udf_model_object(tpu_session, image_df, keras_model):
     """Registering a built in-memory model (not a file) works identically."""
     from sparkdl_tpu.udf import registerKerasImageUDF
