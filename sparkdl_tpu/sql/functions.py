@@ -105,16 +105,64 @@ class Column:
     def __ge__(self, other):
         return self._binop(other, operator.ge, ">=")
 
+    def _kleene_binop(self, other, table, sym) -> "Column":
+        """SQL three-valued logic combinator (as in Spark/Catalyst):
+        ``table(a, b)`` receives operands normalized to True/False/None
+        (comparisons over numpy scalars yield np.True_/np.False_, for
+        which ``is True`` identity checks would fail)."""
+        other_col = other if isinstance(other, Column) else Column._literal(other)
+
+        def ev(cols, n):
+            return [
+                table(
+                    None if a is None else bool(a),
+                    None if b is None else bool(b),
+                )
+                for a, b in zip(self._eval(cols, n), other_col._eval(cols, n))
+            ]
+
+        return Column(ev, f"({self._name} {sym} {other_col._name})")
+
     def __and__(self, other):
-        return self._binop(other, operator.and_, "&")
+        # FALSE AND NULL = FALSE, TRUE AND NULL = NULL
+        def table(a, b):
+            if a is False or b is False:
+                return False
+            if a is None or b is None:
+                return None
+            return a and b
+
+        return self._kleene_binop(other, table, "&")
 
     def __or__(self, other):
-        return self._binop(other, operator.or_, "|")
+        # TRUE OR NULL = TRUE, FALSE OR NULL = NULL
+        def table(a, b):
+            if a is True or b is True:
+                return True
+            if a is None or b is None:
+                return None
+            return a or b
+
+        return self._kleene_binop(other, table, "|")
 
     def __invert__(self):
         return Column(
             lambda cols, n: [None if v is None else not v for v in self._eval(cols, n)],
             f"(NOT {self._name})",
+        )
+
+    def isin(self, *values):
+        """Membership test (``col.isin(0, 1)`` or ``col.isin([0, 1])``) —
+        the pyspark ``Column.isin`` analog, and what SQL ``IN (...)``
+        lowers to."""
+        if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
+            values = tuple(values[0])
+        vals = set(values)
+        return Column(
+            lambda cols, n: [
+                None if v is None else v in vals for v in self._eval(cols, n)
+            ],
+            "(%s IN (%s))" % (self._name, ", ".join(map(repr, values))),
         )
 
     def isNull(self):

@@ -209,8 +209,9 @@ class TPUSession:
         )
 
     # ------------------------------------------------------------------
-    # Minimal SQL: SELECT <exprs> FROM <view> [WHERE <col> <op> <lit>]
-    # [LIMIT n] — expr := * | ident | fn(ident, ...) [AS alias]
+    # Minimal SQL: SELECT <exprs> FROM <view> [WHERE <pred>] [LIMIT n]
+    #   expr := * | ident | fn(ident, ...) [AS alias]
+    #   pred := comparisons composed with AND / OR / NOT / IN (...) / parens
     # ------------------------------------------------------------------
     _SQL_RE = re.compile(
         r"^\s*SELECT\s+(?P<proj>.+?)\s+FROM\s+(?P<table>\w+)"
@@ -270,23 +271,7 @@ class TPUSession:
 
     @staticmethod
     def _parse_predicate(text: str) -> Column:
-        m = re.match(
-            r"^(?P<col>\w+)\s*(?P<op>=|==|!=|<>|<=|>=|<|>)\s*(?P<lit>.+)$", text
-        )
-        if not m:
-            raise ValueError(f"Unsupported WHERE clause: {text!r}")
-        lit_raw = m.group("lit").strip()
-        if lit_raw.startswith(("'", '"')):
-            value: Any = lit_raw[1:-1]
-        else:
-            value = float(lit_raw) if "." in lit_raw else int(lit_raw)
-        c = col(m.group("col"))
-        op = m.group("op")
-        if op in ("=", "=="):
-            return c == value
-        if op in ("!=", "<>"):
-            return c != value
-        return {"<": c < value, "<=": c <= value, ">": c > value, ">=": c >= value}[op]
+        return _PredicateParser(text).parse()
 
     def stop(self):
         TPUSession._active = None
@@ -304,3 +289,164 @@ class TPUSession:
             with open(f, "rb") as fh:
                 out.append((f, fh.read()))
         return out
+
+
+class _PredicateParser:
+    """Recursive-descent WHERE parser lowering to :class:`Column` combinators.
+
+    Grammar (SQL92 subset; precedence NOT > AND > OR, as in Spark SQL):
+
+        pred   := and_e (OR and_e)*
+        and_e  := not_e (AND not_e)*
+        not_e  := NOT not_e | '(' pred ')' | cmp
+        cmp    := ref ( op literal
+                      | [NOT] IN '(' literal (',' literal)* ')'
+                      | IS [NOT] NULL )
+        ref    := ident ('.' ident)*         -- struct fields: image.height
+        op     := = | == | != | <> | <= | >= | < | >
+
+    Reference analog: the reference delegated WHERE to Spark Catalyst; this
+    covers the predicate shapes its examples/tests exercise (e.g.
+    ``label IN (0,1) AND height > 100``).
+    """
+
+    _TOKEN_RE = re.compile(
+        r"\s*(?:(?P<num>-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)"
+        r"|(?P<str>'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\")"
+        r"|(?P<ident>\w+)"
+        r"|(?P<op><=|>=|==|!=|<>|=|<|>)"
+        r"|(?P<punct>[(),.]))"
+    )
+
+    def __init__(self, text: str):
+        self.text = text
+        self.tokens: List[tuple] = []
+        pos = 0
+        while pos < len(text):
+            m = self._TOKEN_RE.match(text, pos)
+            if not m or m.end() == pos:
+                if text[pos:].strip():
+                    raise ValueError(
+                        f"Unsupported WHERE clause at {text[pos:]!r}"
+                    )
+                break
+            pos = m.end()
+            kind = m.lastgroup
+            self.tokens.append((kind, m.group(kind)))
+        self.i = 0
+
+    # -- token helpers --------------------------------------------------
+    def _peek(self, offset: int = 0):
+        j = self.i + offset
+        return self.tokens[j] if j < len(self.tokens) else (None, None)
+
+    def _next(self):
+        tok = self._peek()
+        self.i += 1
+        return tok
+
+    def _accept_kw(self, word: str) -> bool:
+        kind, val = self._peek()
+        if kind == "ident" and val.upper() == word:
+            self.i += 1
+            return True
+        return False
+
+    def _expect(self, kind: str, value: Optional[str] = None):
+        got_kind, got_val = self._next()
+        if got_kind != kind or (value is not None and got_val != value):
+            raise ValueError(
+                f"Unsupported WHERE clause: {self.text!r} "
+                f"(expected {value or kind}, got {got_val!r})"
+            )
+        return got_val
+
+    # -- grammar --------------------------------------------------------
+    def parse(self) -> Column:
+        out = self._or_expr()
+        if self.i != len(self.tokens):
+            raise ValueError(
+                f"Unsupported WHERE clause: trailing tokens in {self.text!r}"
+            )
+        return out
+
+    def _or_expr(self) -> Column:
+        left = self._and_expr()
+        while self._accept_kw("OR"):
+            left = left | self._and_expr()
+        return left
+
+    def _and_expr(self) -> Column:
+        left = self._not_expr()
+        while self._accept_kw("AND"):
+            left = left & self._not_expr()
+        return left
+
+    def _not_expr(self) -> Column:
+        if self._accept_kw("NOT"):
+            return ~self._not_expr()
+        kind, val = self._peek()
+        if kind == "punct" and val == "(":
+            self.i += 1
+            inner = self._or_expr()
+            self._expect("punct", ")")
+            return inner
+        return self._comparison()
+
+    def _comparison(self) -> Column:
+        kind, name = self._next()
+        if kind != "ident":
+            raise ValueError(
+                f"Unsupported WHERE clause: expected column name, got "
+                f"{name!r} in {self.text!r}"
+            )
+        c = col(name)
+        while self._peek() == ("punct", "."):
+            self.i += 1
+            k, field = self._next()
+            if k != "ident":
+                raise ValueError(
+                    f"Expected field name after '.' in {self.text!r}"
+                )
+            c = c.getField(field)
+        if self._accept_kw("IS"):
+            negate = self._accept_kw("NOT")
+            k, v = self._next()
+            if k != "ident" or v.upper() != "NULL":
+                raise ValueError(f"Expected NULL after IS in {self.text!r}")
+            return c.isNotNull() if negate else c.isNull()
+        negate_in = self._accept_kw("NOT")
+        if self._accept_kw("IN"):
+            self._expect("punct", "(")
+            values = [self._literal()]
+            while self._peek() == ("punct", ","):
+                self.i += 1
+                values.append(self._literal())
+            self._expect("punct", ")")
+            membership = c.isin(*values)
+            return ~membership if negate_in else membership
+        if negate_in:
+            raise ValueError(f"Expected IN after NOT in {self.text!r}")
+        kind, op = self._next()
+        if kind != "op":
+            raise ValueError(
+                f"Unsupported WHERE clause: expected operator after "
+                f"{name!r} in {self.text!r}"
+            )
+        value = self._literal()
+        if op in ("=", "=="):
+            return c == value
+        if op in ("!=", "<>"):
+            return c != value
+        return {"<": c < value, "<=": c <= value, ">": c > value, ">=": c >= value}[op]
+
+    def _literal(self):
+        kind, val = self._next()
+        if kind == "num":
+            return float(val) if ("." in val or "e" in val.lower()) else int(val)
+        if kind == "str":
+            body = val[1:-1]
+            return body.replace("\\" + val[0], val[0]).replace("\\\\", "\\")
+        raise ValueError(
+            f"Unsupported WHERE literal {val!r} in {self.text!r}"
+        )

@@ -145,3 +145,118 @@ def test_schema_inference_skips_leading_nones(tpu_session):
 
     out2 = df.withColumn("y", col("x") * 2)
     assert isinstance(out2.schema["y"].dataType, want)
+
+
+class TestWherePredicates:
+    """Compound WHERE parsing (AND/OR/NOT/IN/parens/IS NULL) — the subset of
+    Catalyst's predicate surface the reference examples exercise."""
+
+    @pytest.fixture()
+    def view(self, tpu_session):
+        data = [
+            (i, f"name_{i}", float(i) * 1.5, i % 3 if i != 4 else None)
+            for i in range(10)
+        ]
+        df = tpu_session.createDataFrame(
+            data, ["id", "name", "score", "label"]
+        )
+        df.createOrReplaceTempView("preds")
+        return tpu_session
+
+    def _ids(self, session, where):
+        out = session.sql(f"SELECT id FROM preds WHERE {where}")
+        return sorted(r.id for r in out.collect())
+
+    def test_and(self, view):
+        assert self._ids(view, "id >= 3 AND id < 6") == [3, 4, 5]
+
+    def test_or(self, view):
+        assert self._ids(view, "id < 2 OR id > 8") == [0, 1, 9]
+
+    def test_precedence_and_binds_tighter(self, view):
+        # a OR b AND c  ==  a OR (b AND c)
+        assert self._ids(view, "id = 9 OR id > 2 AND id < 5") == [3, 4, 9]
+
+    def test_parens_override(self, view):
+        assert self._ids(view, "(id = 9 OR id > 2) AND id < 5") == [3, 4]
+
+    def test_in(self, view):
+        assert self._ids(view, "id IN (1, 3, 5)") == [1, 3, 5]
+
+    def test_in_strings(self, view):
+        assert self._ids(view, "name IN ('name_2', 'name_7')") == [2, 7]
+
+    def test_not_in(self, view):
+        assert self._ids(view, "id NOT IN (0,1,2,3,4,5,6,7)") == [8, 9]
+
+    def test_not(self, view):
+        assert self._ids(view, "NOT id < 8") == [8, 9]
+
+    def test_is_null(self, view):
+        assert self._ids(view, "label IS NULL") == [4]
+        assert self._ids(view, "label IS NOT NULL") == [
+            0, 1, 2, 3, 5, 6, 7, 8, 9
+        ]
+
+    def test_verdict_example_shape(self, view):
+        # the VERDICT r2 #8 acceptance query shape:
+        #   SELECT udf(image) FROM t WHERE label IN (0,1) AND height > 100
+        assert self._ids(view, "label IN (0, 1) AND score > 3") == [
+            3, 6, 7, 9
+        ]
+
+    def test_float_and_negative_literals(self, view):
+        assert self._ids(view, "score >= 10.5") == [7, 8, 9]
+        assert self._ids(view, "id > -1 AND score < 1.0") == [0]
+
+    def test_mixed_case_keywords(self, view):
+        assert self._ids(view, "id in (1, 2) or id = 9") == [1, 2, 9]
+
+    def test_isin_column_api(self, view):
+        df = view.table("preds")
+        out = df.filter(col("id").isin(2, 4, 6)).collect()
+        assert sorted(r.id for r in out) == [2, 4, 6]
+        out2 = df.filter(col("id").isin([7, 8])).collect()
+        assert sorted(r.id for r in out2) == [7, 8]
+
+    def test_unsupported_raises(self, view):
+        with pytest.raises(ValueError):
+            view.sql("SELECT id FROM preds WHERE id ~~ 3")
+        with pytest.raises(ValueError):
+            view.sql("SELECT id FROM preds WHERE id IN ()")
+        with pytest.raises(ValueError):
+            view.sql("SELECT id FROM preds WHERE (id = 1")
+
+    def test_struct_field_reference(self, tpu_session):
+        data = [
+            (i, {"height": 10 * i, "width": 5}) for i in range(6)
+        ]
+        df = tpu_session.createDataFrame(data, ["id", "image"])
+        df.createOrReplaceTempView("structs")
+        out = tpu_session.sql(
+            "SELECT id FROM structs WHERE image.height > 20 AND id IN (3, 4)"
+        )
+        assert sorted(r.id for r in out.collect()) == [3, 4]
+
+    def test_null_three_valued_logic(self, tpu_session):
+        """SQL 3VL (as in Spark/Catalyst): TRUE OR NULL = TRUE keeps the
+        row; FALSE AND NULL = FALSE (not NULL)."""
+        data = [(1, 0), (4, None), (9, 2)]
+        df = tpu_session.createDataFrame(data, ["id", "lbl"])
+        df.createOrReplaceTempView("nulls")
+        out = tpu_session.sql("SELECT id FROM nulls WHERE lbl = 1 OR id = 4")
+        assert sorted(r.id for r in out.collect()) == [4]
+        # NULL AND TRUE = NULL; NOT NULL = NULL -> row 4 dropped (as Spark)
+        out2 = tpu_session.sql(
+            "SELECT id FROM nulls WHERE NOT (lbl = 1 AND id = 4)"
+        )
+        assert sorted(r.id for r in out2.collect()) == [1, 9]
+        # NULL AND FALSE = FALSE; NOT FALSE = TRUE -> row 4 kept
+        out3 = tpu_session.sql(
+            "SELECT id FROM nulls WHERE NOT (lbl = 1 AND id = 5)"
+        )
+        assert sorted(r.id for r in out3.collect()) == [1, 4, 9]
+
+    def test_leading_dot_float_literal(self, view):
+        # regression: `score > .5` parsed before the tokenizer rewrite
+        assert self._ids(view, "score > .5") == list(range(1, 10))
