@@ -189,7 +189,19 @@ class KerasImageFileEstimator(
         )
 
         ckpt_dir = self.getOrDefault(self.checkpointDir)
-        start_epoch, state = self._maybe_restore(ckpt_dir, state)
+        # restore the latest committed epoch <= the requested stopping point:
+        # fit(epochs=2) after a completed fit(epochs=4) returns the exact
+        # 2-epoch weights (epoch_2 is on disk), not the later ones
+        start_epoch, state = self._maybe_restore(
+            ckpt_dir, state, max_epoch=epochs
+        )
+        if start_epoch >= epochs and start_epoch > 0:
+            logger.info(
+                "checkpoint already at epoch %d == requested epochs=%d; "
+                "returning the checkpointed weights without training",
+                start_epoch,
+                epochs,
+            )
         if distributed:
             # params start host-local (loaded from the same model file on
             # every process) — lift them onto the global mesh, replicated
@@ -217,6 +229,11 @@ class KerasImageFileEstimator(
                 local_bs,
             )
         rng = np.random.RandomState((seed * 7919 + jax.process_index()) % 2**32)
+        # replay the restored epochs' draws so epoch e always trains on the
+        # e-th permutation: fit(epochs=2) resumed to epochs=4 is then
+        # step-for-step identical to a single fit(epochs=4)
+        for _ in range(start_epoch):
+            rng.permutation(n)
         last_loss = None
         def place(batch):
             if distributed:
@@ -224,38 +241,50 @@ class KerasImageFileEstimator(
             batch = jax.tree_util.tree_map(jnp.asarray, batch)
             return shard_batch(batch, mesh)
 
-        for epoch in range(start_epoch, epochs):
-            order = rng.permutation(n)
-            if streaming:
-                for batch in stream.epoch(order, steps_per_epoch):
-                    state, loss = step_fn(state, place(batch))
-            else:
-                for step_i in range(steps_per_epoch):
-                    idx = order[step_i * local_bs : (step_i + 1) * local_bs]
-                    k = len(idx)
-                    if k < local_bs:
-                        # pad cyclically to the full local batch so every
-                        # host contributes the same shape (even when n <
-                        # local_bs); with a known loss the pad rows carry
-                        # zero weight, so the update is the exact mean
-                        # over the real rows
-                        idx = np.concatenate(
-                            [idx, np.resize(order, local_bs - k)]
-                        )
-                    batch = {"x": x[idx], "y": y[idx]}
-                    if weighted:
-                        w = np.zeros(local_bs, np.float32)
-                        w[:k] = 1.0
-                        batch["w"] = w
-                    state, loss = step_fn(state, place(batch))
-            last_loss = float(loss)
-            logger.info("epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss)
-            if ckpt_dir:
-                # every process calls save: under jax.distributed orbax
-                # saves are collective (primary writes, peers barrier) —
-                # gating on process 0 would wedge the job in orbax's
-                # internal sync
-                self._save_checkpoint(ckpt_dir, epoch + 1, state)
+        ckptr = self._make_checkpointer() if ckpt_dir else None
+        try:
+            for epoch in range(start_epoch, epochs):
+                order = rng.permutation(n)
+                if streaming:
+                    for batch in stream.epoch(order, steps_per_epoch):
+                        state, loss = step_fn(state, place(batch))
+                else:
+                    for step_i in range(steps_per_epoch):
+                        idx = order[step_i * local_bs : (step_i + 1) * local_bs]
+                        k = len(idx)
+                        if k < local_bs:
+                            # pad cyclically to the full local batch so every
+                            # host contributes the same shape (even when n <
+                            # local_bs); with a known loss the pad rows carry
+                            # zero weight, so the update is the exact mean
+                            # over the real rows
+                            idx = np.concatenate(
+                                [idx, np.resize(order, local_bs - k)]
+                            )
+                        batch = {"x": x[idx], "y": y[idx]}
+                        if weighted:
+                            w = np.zeros(local_bs, np.float32)
+                            w[:k] = 1.0
+                            batch["w"] = w
+                        state, loss = step_fn(state, place(batch))
+                last_loss = float(loss)
+                logger.info(
+                    "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
+                )
+                if ckptr is not None:
+                    # every process calls save: under jax.distributed orbax
+                    # saves are collective (primary writes, peers barrier) —
+                    # gating on process 0 would wedge the job in orbax's
+                    # internal sync.  The save is async (SURVEY.md §5.4):
+                    # arrays are snapshotted to host synchronously, disk
+                    # commit happens behind the next epoch's steps
+                    self._save_checkpoint(ckptr, ckpt_dir, epoch + 1, state)
+        finally:
+            if ckptr is not None:
+                # the final epoch's write must commit before fit returns
+                # (a crash right after fit must find a resumable ckpt)
+                ckptr.wait_until_finished()
+                ckptr.close()
 
         # write tuned weights back into the Keras model and persist it
         for var, val in zip(model.trainable_variables, state.trainable):
@@ -299,10 +328,12 @@ class KerasImageFileEstimator(
         fit_params = {
             k: v
             for k, v in (self.getKerasFitParams() or {}).items()
-            # data-plane knobs with no effect on the training trajectory
-            # (streaming is batch-identical by contract) must not change
-            # the namespace, or toggling them orphans the checkpoints
-            if k != "streaming"
+            # excluded: knobs with no effect on the step-by-step trajectory.
+            # `streaming` is batch-identical by contract; `epochs` is a
+            # stopping point, not a trajectory parameter — keeping it in the
+            # hash would silently restart fit(epochs=4) from scratch after a
+            # fit(epochs=2) instead of resuming two more epochs
+            if k not in ("streaming", "epochs")
         }
         payload = json.dumps(
             {
@@ -319,16 +350,33 @@ class KerasImageFileEstimator(
         )
         return "fit_" + hashlib.sha256(payload.encode()).hexdigest()[:12]
 
-    def _save_checkpoint(self, ckpt_dir: str, epoch: int, state):
+    @staticmethod
+    def _make_checkpointer():
+        """Async orbax checkpointer (SURVEY.md §5.4 "async, multi-host"):
+        ``save`` snapshots device arrays to host memory synchronously —
+        safe against the train loop donating the state buffers on the next
+        step — and commits to disk on a background thread, so save latency
+        hides behind the following epoch instead of blocking the step
+        loop."""
+        import orbax.checkpoint as ocp
+
+        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+
+    def _save_checkpoint(self, ckptr, ckpt_dir: str, epoch: int, state):
         import orbax.checkpoint as ocp
 
         path = os.path.join(
             os.path.abspath(ckpt_dir), self._ckpt_namespace(), f"epoch_{epoch}"
         )
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path, self._ckpt_payload(state), force=True)
+        ckptr.save(
+            path,
+            args=ocp.args.StandardSave(self._ckpt_payload(state)),
+            force=True,
+        )
 
-    def _maybe_restore(self, ckpt_dir: Optional[str], state):
+    def _maybe_restore(
+        self, ckpt_dir: Optional[str], state, max_epoch: Optional[int] = None
+    ):
         if not ckpt_dir:
             return 0, state
         root = os.path.join(os.path.abspath(ckpt_dir), self._ckpt_namespace())
@@ -350,6 +398,10 @@ class KerasImageFileEstimator(
             for d in os.listdir(root)
             if d.startswith("epoch_") and d.split("_")[1].isdigit()
         )
+        if max_epoch is not None:
+            # never resume past the requested stopping point — a shorter
+            # re-fit must reproduce the short run, not return later weights
+            epochs = [e for e in epochs if e <= max_epoch]
         epochs = [e for e in epochs if committed(e)]
         latest = epochs[-1] if epochs else 0
         if runner.is_distributed():
