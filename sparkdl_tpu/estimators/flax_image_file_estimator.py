@@ -264,6 +264,13 @@ class FlaxImageFileEstimator(
                 jax.random.PRNGKey(seed),
                 jnp.zeros((1,) + x.shape[1:], jnp.float32),
             )
+        else:
+            # defensive copy: the train step donates its state buffers, and
+            # donating the CALLER's pretrained pytree would leave them
+            # holding deleted arrays after fit returns
+            variables = jax.tree_util.tree_map(
+                lambda a: jnp.array(a), variables
+            )
 
         def per_sample(params, batch):
             """Per-sample losses -> exact zero-weight ragged padding."""
@@ -300,7 +307,9 @@ class FlaxImageFileEstimator(
                 w = batch["w"]
                 return (per * w).sum() / w.sum()
 
-            devices = np.asarray(jax.devices())
+            from sparkdl_tpu.parallel.trainer import current_device_slice
+
+            devices = np.asarray(current_device_slice() or jax.devices())
             shape = self.getOrDefault(self.meshShape)
             if shape is not None:
                 dp, tp = (int(s) for s in shape)

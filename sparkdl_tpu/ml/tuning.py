@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
+from queue import Queue
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -126,6 +127,15 @@ class CrossValidator(Estimator):
         "undefined", "parallelism", "number of threads for parallel fits",
         TypeConverters.toInt,
     )
+    partitionDevices = Param(
+        "undefined", "partitionDevices",
+        "partition the local devices into `parallelism` disjoint sub-meshes "
+        "and bind one to each trial thread, so concurrent trials train on "
+        "separate chips (the trial-parallel-across-slices strategy) instead "
+        "of contending for one mesh; requires the device count to divide "
+        "evenly",
+        TypeConverters.toBoolean,
+    )
     seed = Param("undefined", "seed", "random seed")
 
     @keyword_only
@@ -136,10 +146,13 @@ class CrossValidator(Estimator):
         evaluator=None,
         numFolds: int = 3,
         parallelism: int = 1,
+        partitionDevices: bool = False,
         seed: Optional[int] = None,
     ):
         super().__init__()
-        self._setDefault(numFolds=3, parallelism=1, seed=None)
+        self._setDefault(
+            numFolds=3, parallelism=1, partitionDevices=False, seed=None
+        )
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
 
@@ -151,6 +164,7 @@ class CrossValidator(Estimator):
         evaluator=None,
         numFolds: int = 3,
         parallelism: int = 1,
+        partitionDevices: bool = False,
         seed: Optional[int] = None,
     ):
         kwargs = self._input_kwargs
@@ -171,7 +185,21 @@ class CrossValidator(Estimator):
         evaluator = self.getEvaluator()
         n_folds = self.getOrDefault(self.numFolds)
         parallelism = max(1, self.getOrDefault(self.parallelism))
+        partition = self.getOrDefault(self.partitionDevices)
         seed = self.getOrDefault(self.seed)
+
+        # trial-parallel across device slices: carve the local devices into
+        # one disjoint sub-mesh per worker thread, so every make_mesh() a
+        # trial issues builds on its own chips (without this, concurrent
+        # trials contend for the full mesh and serialize in practice)
+        sliced = partition and parallelism > 1
+        slice_queue: Optional[Queue] = None
+
+        def _bind_slice():
+            if slice_queue is not None:
+                from sparkdl_tpu.parallel.trainer import bind_device_slice
+
+                bind_device_slice(slice_queue.get_nowait())
 
         folds = dataset.randomSplit([1.0] * n_folds, seed=seed)
         n_params = len(param_maps)
@@ -193,7 +221,17 @@ class CrossValidator(Estimator):
                 with lock:
                     metrics[index] += metric
 
-            with ThreadPoolExecutor(max_workers=parallelism) as pool:
+            if sliced:
+                # fresh queue per fold: each pool creates fresh worker
+                # threads, and every one must bind its own slice
+                from sparkdl_tpu.parallel.trainer import partition_devices
+
+                slice_queue = Queue()
+                for s in partition_devices(parallelism):
+                    slice_queue.put(s)
+            with ThreadPoolExecutor(
+                max_workers=parallelism, initializer=_bind_slice
+            ) as pool:
                 list(pool.map(consume_one, range(n_params)))
 
         metrics /= n_folds

@@ -12,9 +12,11 @@ shard.
 
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
 from dataclasses import dataclass
 from functools import partial
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -25,6 +27,53 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import optax
 
+# Thread-local device restriction: the slice analog for trial-parallel
+# tuning (SURVEY.md §2 "trial-parallel across pod slices").  A tuning
+# driver binds each worker thread to a disjoint subset of the local
+# devices; every make_mesh() an estimator issues on that thread then builds
+# its training mesh from the slice instead of all local devices, so k
+# trials train concurrently without sharing chips.
+_DEVICE_SLICE = threading.local()
+
+
+def current_device_slice() -> Optional[List]:
+    """The devices this thread is restricted to, or None (all local)."""
+    return getattr(_DEVICE_SLICE, "devices", None)
+
+
+@contextmanager
+def device_slice(devices: Sequence):
+    """Restrict ``make_mesh`` on this thread to ``devices`` for the scope."""
+    prev = current_device_slice()
+    _DEVICE_SLICE.devices = list(devices)
+    try:
+        yield
+    finally:
+        _DEVICE_SLICE.devices = prev
+
+
+def bind_device_slice(devices: Optional[Sequence]) -> None:
+    """Non-scoped form of :func:`device_slice` for pool-thread initializers
+    (a ThreadPoolExecutor binds each worker thread once, for its life)."""
+    _DEVICE_SLICE.devices = list(devices) if devices is not None else None
+
+
+def partition_devices(k: int, devices: Optional[Sequence] = None):
+    """Split the local devices into ``k`` disjoint, equal, contiguous
+    slices (contiguity keeps each slice's collectives on neighboring
+    chips).  Raises when the devices don't divide evenly — a ragged split
+    would give trials different DP widths and different batch math."""
+    devices = list(devices if devices is not None else jax.devices())
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    n = len(devices)
+    if n % k:
+        raise ValueError(
+            f"{n} devices do not partition into {k} equal slices"
+        )
+    per = n // k
+    return [devices[i * per : (i + 1) * per] for i in range(k)]
+
 
 def make_mesh(
     n_devices: Optional[int] = None,
@@ -32,9 +81,11 @@ def make_mesh(
     axis_shape: Optional[Sequence[int]] = None,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a device mesh.  Default: all local devices on one ``data`` axis
-    (pure DP).  For DP x TP pass e.g. ``axis_names=("data", "model"),
-    axis_shape=(2, 4)``."""
+    """Build a device mesh.  Default: the thread's :func:`device_slice` when
+    bound, else all local devices, on one ``data`` axis (pure DP).  For
+    DP x TP pass e.g. ``axis_names=("data", "model"), axis_shape=(2, 4)``."""
+    if devices is None:
+        devices = current_device_slice()
     devices = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
         devices = devices[:n_devices]

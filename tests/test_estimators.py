@@ -136,12 +136,15 @@ def test_checkpoint_and_resume(labeled_df, tmp_path):
 
 def test_checkpoints_namespaced_by_fit_config(labeled_df, tmp_path):
     """Different param maps sharing one checkpointDir must not restore each
-    other's state (previously epoch_N keys collided across configs)."""
+    other's state (previously epoch_N keys collided across configs).
+    Trajectory params (batch_size here) namespace; `epochs` — a stopping
+    point, not a trajectory param — deliberately does not (see
+    test_refit_with_more_epochs_resumes)."""
     _, path = _tiny_model(tmp_path)
     ckpt = str(tmp_path / "shared_ckpts")
-    est_a = _make_estimator(path, epochs=2)
+    est_a = _make_estimator(path, epochs=2, batch_size=8)
     est_a = est_a.copy({est_a.checkpointDir: ckpt})
-    est_b = _make_estimator(path, epochs=3)
+    est_b = _make_estimator(path, epochs=3, batch_size=4)
     est_b = est_b.copy({est_b.checkpointDir: ckpt})
     est_a.fit(labeled_df)
     fitted_b = est_b.fit(labeled_df)
@@ -154,6 +157,49 @@ def test_checkpoints_namespaced_by_fit_config(labeled_df, tmp_path):
         if "epoch_3" in os.listdir(os.path.join(ckpt, ns))
     ]
     assert len(ns_b) == 1
+
+
+def test_refit_with_more_epochs_resumes(labeled_df, tmp_path):
+    """fit(epochs=2) then fit(epochs=4) on the same checkpointDir must
+    resume — training exactly two more epochs in the same namespace — and
+    produce weights identical to a single uninterrupted fit(epochs=4)
+    (the rng replays restored epochs, so epoch e always sees the e-th
+    permutation)."""
+    _, path = _tiny_model(tmp_path)
+    ckpt = str(tmp_path / "extend_ckpts")
+
+    est2 = _make_estimator(path, epochs=2, learning_rate=0.05)
+    est2 = est2.copy({est2.checkpointDir: ckpt})
+    est2.fit(labeled_df)
+    (ns,) = os.listdir(ckpt)
+    assert sorted(os.listdir(os.path.join(ckpt, ns))) == [
+        "epoch_1", "epoch_2"
+    ]
+
+    est4 = _make_estimator(path, epochs=4, learning_rate=0.05)
+    est4 = est4.copy({est4.checkpointDir: ckpt})
+    fitted_resumed = est4.fit(labeled_df)
+    # same namespace, extended in place — not a fresh restart
+    (ns_after,) = os.listdir(ckpt)
+    assert ns_after == ns
+    assert sorted(os.listdir(os.path.join(ckpt, ns))) == [
+        "epoch_1", "epoch_2", "epoch_3", "epoch_4"
+    ]
+
+    # oracle: one uninterrupted fit(epochs=4), no checkpointing
+    est_straight = _make_estimator(path, epochs=4, learning_rate=0.05)
+    fitted_straight = est_straight.fit(labeled_df)
+
+    got = keras.saving.load_model(
+        fitted_resumed.getModelFile(), compile=False
+    )
+    want = keras.saving.load_model(
+        fitted_straight.getModelFile(), compile=False
+    )
+    for g, w in zip(got.trainable_variables, want.trainable_variables):
+        np.testing.assert_allclose(
+            np.asarray(g.value), np.asarray(w.value), rtol=1e-6, atol=1e-7
+        )
 
 
 def test_fit_dataset_smaller_than_batch(labeled_df, tmp_path):
@@ -279,3 +325,164 @@ def test_streaming_fit_identical_to_in_memory(labeled_df, tmp_path):
 
     for got, want in zip(fit(True), fit(False)):
         np.testing.assert_array_equal(got, want)
+
+
+def test_refit_with_fewer_epochs_restores_exact_epoch(labeled_df, tmp_path):
+    """fit(epochs=4) then fit(epochs=2) on the same checkpointDir must
+    return the exact epoch-2 weights from disk — never the later epoch-4
+    state (the restore is capped at the requested stopping point)."""
+    _, path = _tiny_model(tmp_path)
+    ckpt = str(tmp_path / "shrink_ckpts")
+
+    est4 = _make_estimator(path, epochs=4, learning_rate=0.05)
+    est4 = est4.copy({est4.checkpointDir: ckpt})
+    fitted4 = est4.fit(labeled_df)
+
+    est2 = _make_estimator(path, epochs=2, learning_rate=0.05)
+    est2 = est2.copy({est2.checkpointDir: ckpt})
+    fitted2 = est2.fit(labeled_df)
+
+    # oracle: an uninterrupted 2-epoch fit with no checkpointing
+    est_straight = _make_estimator(path, epochs=2, learning_rate=0.05)
+    fitted_straight = est_straight.fit(labeled_df)
+
+    got = keras.saving.load_model(fitted2.getModelFile(), compile=False)
+    want = keras.saving.load_model(
+        fitted_straight.getModelFile(), compile=False
+    )
+    for g, w in zip(got.trainable_variables, want.trainable_variables):
+        np.testing.assert_allclose(
+            np.asarray(g.value), np.asarray(w.value), rtol=1e-6, atol=1e-7
+        )
+    # and it is NOT the 4-epoch state
+    m4 = keras.saving.load_model(fitted4.getModelFile(), compile=False)
+    assert any(
+        not np.allclose(np.asarray(a.value), np.asarray(b.value))
+        for a, b in zip(got.trainable_variables, m4.trainable_variables)
+    )
+
+
+class TestTrialParallelSlices:
+    """Trial-parallelism across disjoint device sub-meshes (SURVEY.md §2
+    "trial-parallel across pod slices"; VERDICT r2 missing #3): 8 virtual
+    devices -> 2 concurrent trials x 4-device meshes."""
+
+    def test_partition_devices_disjoint_and_mesh_respects_slice(self):
+        import jax
+
+        from sparkdl_tpu.parallel.trainer import (
+            device_slice,
+            make_mesh,
+            partition_devices,
+        )
+
+        slices = partition_devices(2)
+        assert len(slices) == 2
+        assert len(slices[0]) == len(slices[1]) == 4
+        assert not (set(slices[0]) & set(slices[1]))
+        assert set(slices[0]) | set(slices[1]) == set(jax.devices())
+
+        with device_slice(slices[1]):
+            mesh = make_mesh()
+            assert list(mesh.devices.flat) == slices[1]
+        # out of scope: back to the full mesh
+        assert make_mesh().devices.size == 8
+
+        with pytest.raises(ValueError, match="partition"):
+            partition_devices(3)
+
+    def test_concurrent_sliced_trials_match_sequential(
+        self, labeled_df, tmp_path
+    ):
+        """Two concurrent trials on disjoint 4-device sub-meshes reproduce
+        the sequential full-mesh results exactly, and genuinely overlap."""
+        import time
+        from concurrent.futures import ThreadPoolExecutor
+
+        from sparkdl_tpu.parallel.trainer import (
+            bind_device_slice,
+            partition_devices,
+        )
+
+        _, path = _tiny_model(tmp_path)
+        # batch 8 divides both the 8-dev (sequential) and 4-dev (sliced)
+        # meshes -> identical global batches -> identical update math
+        maps = [
+            {"epochs": 2, "batch_size": 8, "learning_rate": lr, "seed": 0}
+            for lr in (0.05, 0.01)
+        ]
+
+        def weights_of(fitted):
+            m = keras.saving.load_model(fitted.getModelFile(), compile=False)
+            return [np.asarray(v.value) for v in m.trainable_variables]
+
+        # sequential oracle (full mesh per trial)
+        sequential = []
+        t0 = time.perf_counter()
+        for fp in maps:
+            est = _make_estimator(path, **fp)
+            sequential.append(weights_of(est.fit(labeled_df)))
+        seq_wall = time.perf_counter() - t0
+
+        slices = partition_devices(2)
+        windows = [None, None]
+
+        def run_trial(i):
+            bind_device_slice(slices[i])
+            try:
+                start = time.perf_counter()
+                est = _make_estimator(path, **maps[i])
+                out = weights_of(est.fit(labeled_df))
+                windows[i] = (start, time.perf_counter())
+                return out
+            finally:
+                bind_device_slice(None)
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            concurrent = list(pool.map(run_trial, range(2)))
+        par_wall = time.perf_counter() - t0
+
+        for got, want in zip(concurrent, sequential):
+            for g, w in zip(got, want):
+                np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+        # the trials actually overlapped (both started before either ended)
+        (s0, e0), (s1, e1) = windows
+        assert s0 < e1 and s1 < e0, (windows, seq_wall, par_wall)
+        print(f"sequential {seq_wall:.1f}s vs sliced-parallel {par_wall:.1f}s")
+
+    def test_cross_validator_partition_devices_matches_default(
+        self, tpu_session
+    ):
+        """CrossValidator(partitionDevices=True) end-to-end: same
+        avgMetrics and best model as the unpartitioned run."""
+        rng = np.random.RandomState(0)
+        x0 = rng.randn(30, 4).astype(np.float32) + 2
+        x1 = rng.randn(30, 4).astype(np.float32) - 2
+        data = [{"features": v, "label": 0} for v in x0] + [
+            {"features": v, "label": 1} for v in x1
+        ]
+        df = tpu_session.createDataFrame(data).repartition(4)
+        lr = LogisticRegression(stepSize=0.5)
+        grid = ParamGridBuilder().addGrid(lr.maxIter, [1, 100]).build()
+
+        def run(partition):
+            cv = CrossValidator(
+                estimator=lr,
+                estimatorParamMaps=grid,
+                evaluator=MulticlassClassificationEvaluator(
+                    metricName="accuracy"
+                ),
+                numFolds=2,
+                parallelism=2,
+                partitionDevices=partition,
+                seed=7,
+            )
+            return cv.fit(df)
+
+        plain, sliced = run(False), run(True)
+        np.testing.assert_allclose(sliced.avgMetrics, plain.avgMetrics)
+        acc = MulticlassClassificationEvaluator(
+            metricName="accuracy"
+        ).evaluate(sliced.transform(df))
+        assert acc == 1.0
