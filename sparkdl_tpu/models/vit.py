@@ -45,6 +45,10 @@ class ViTEncoderBlock(nn.Module):
     mlp_dim: int
     dtype: Optional[Any] = None
     attn_impl: Union[str, Callable] = "full"
+    # tanh-approximate gelu matches google-research/vision_transformer
+    # (flax default); exact (erf) gelu matches torch/HF ViT — weight ports
+    # from HF set this for bit-faithful oracle parity
+    exact_gelu: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -66,7 +70,7 @@ class ViTEncoderBlock(nn.Module):
 
         y = nn.LayerNorm(dtype=self.dtype, name="ln_2")(x)
         y = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_up")(y)
-        y = nn.gelu(y)
+        y = nn.gelu(y, approximate=not self.exact_gelu)
         y = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(y)
         return x + y
 
@@ -80,6 +84,7 @@ class ViT(nn.Module):
     dtype: Optional[Any] = None
     attn_impl: Union[str, Callable] = "full"
     image_size: int = 224
+    exact_gelu: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False, features_only: bool = False):
@@ -117,6 +122,7 @@ class ViT(nn.Module):
                 mlp_dim=mlp_dim,
                 dtype=self.dtype,
                 attn_impl=self.attn_impl,
+                exact_gelu=self.exact_gelu,
                 name=f"block_{i}",
             )(x)
 

@@ -1,0 +1,272 @@
+"""ViT pretrained-weight ingestion oracle tests (VERDICT r2 missing #2).
+
+Same pattern as the CNN zoo's Keras-weight oracle (``tests/test_models.py``,
+the ``keras_applications.py``† weights contract): port an independent
+implementation's weights, run our Flax model on the same inputs, require
+numerically equal outputs.  The independent source here is HuggingFace
+``transformers``' torch ViT (random-init — no network; the mapping, not the
+values, is what's under test), plus a round-trip through the
+google-research ``.npz`` checkpoint naming (the artifact format actually
+published for ViT).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from sparkdl_tpu.models.vit import VIT_VARIANTS, ViT  # noqa: E402
+from sparkdl_tpu.models.vit_port import (  # noqa: E402
+    export_vit_npz,
+    port_hf_vit,
+    port_vit_npz,
+)
+
+# tiny geometry: (patch, dim, depth, heads, mlp_dim), 32x32 input -> 5 tokens
+TEST_GEOM = (16, 64, 2, 2, 128)
+
+
+@pytest.fixture()
+def tiny_variant():
+    VIT_VARIANTS["ViT-Test"] = TEST_GEOM
+    yield "ViT-Test"
+    del VIT_VARIANTS["ViT-Test"]
+
+
+def _hf_model(num_labels=5, with_head=True):
+    patch, dim, depth, heads, mlp = TEST_GEOM
+    cfg = transformers.ViTConfig(
+        hidden_size=dim,
+        num_hidden_layers=depth,
+        num_attention_heads=heads,
+        intermediate_size=mlp,
+        image_size=32,
+        patch_size=patch,
+        num_labels=num_labels,
+        layer_norm_eps=1e-6,  # match flax nn.LayerNorm's epsilon
+    )
+    torch.manual_seed(0)
+    cls = (
+        transformers.ViTForImageClassification
+        if with_head
+        else transformers.ViTModel
+    )
+    return cls(cfg).eval()
+
+
+def test_hf_port_logits_match_torch_oracle(tiny_variant):
+    """Ported HF weights through our ViT == the torch forward, to float32
+    tolerance (exact_gelu matches HF's erf gelu)."""
+    hf = _hf_model()
+    variables = port_hf_vit(hf)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 32, 32, 3).astype(np.float32)
+
+    module = ViT(
+        variant=tiny_variant, num_classes=5, image_size=32, exact_gelu=True
+    )
+    # CPU XLA convs default to a reduced-precision algorithm (~5e-3 error
+    # vs a float64 oracle); pin full f32 for the comparison
+    with jax.default_matmul_precision("float32"):
+        got = np.asarray(module.apply(variables, x))
+
+    with torch.no_grad():
+        want = hf(
+            torch.from_numpy(x.transpose(0, 3, 1, 2))
+        ).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_hf_port_features_match_headless_model(tiny_variant):
+    """ViTModel (no classifier) ports too; features_only output equals the
+    torch CLS embedding after final layernorm."""
+    hf = _hf_model(with_head=False)
+    variables = port_hf_vit(hf)
+    assert "head" not in variables["params"]
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 32, 32, 3).astype(np.float32)
+    module = ViT(
+        variant=tiny_variant, include_top=False, image_size=32,
+        exact_gelu=True,
+    )
+    with jax.default_matmul_precision("float32"):
+        got = np.asarray(module.apply(variables, x, features_only=True))
+    with torch.no_grad():
+        out = hf(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    want = out.last_hidden_state[:, 0].numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_npz_roundtrip_identity(tiny_variant, tmp_path):
+    """export_vit_npz -> port_vit_npz reproduces the exact tree (the
+    offline stand-in for ingesting a downloaded ViT-B_16.npz)."""
+    module = ViT(variant=tiny_variant, num_classes=5, image_size=32)
+    variables = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3), np.float32)
+    )
+    path = str(tmp_path / "vit_test.npz")
+    export_vit_npz(variables, path, heads=TEST_GEOM[3])
+    restored = port_vit_npz(path)
+
+    flat_a = jax.tree_util.tree_leaves_with_path(variables)
+    flat_b = jax.tree_util.tree_leaves_with_path(restored)
+    assert len(flat_a) == len(flat_b)
+    b_map = {jax.tree_util.keystr(k): v for k, v in flat_b}
+    for k, va in flat_a:
+        vb = b_map[jax.tree_util.keystr(k)]
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb), err_msg=str(k))
+
+
+def test_npz_port_runs_hf_oracle(tiny_variant, tmp_path):
+    """HF weights -> export npz -> port npz -> logits still equal torch:
+    the full artifact path a user takes (download .npz, load, serve)."""
+    hf = _hf_model()
+    variables = port_hf_vit(hf)
+    path = str(tmp_path / "vit_hf.npz")
+    export_vit_npz(variables, path, heads=TEST_GEOM[3])
+    restored = port_vit_npz(path)
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 32, 32, 3).astype(np.float32)
+    module = ViT(
+        variant=tiny_variant, num_classes=5, image_size=32, exact_gelu=True
+    )
+    with jax.default_matmul_precision("float32"):
+        got = np.asarray(module.apply(restored, x))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(x.transpose(0, 3, 1, 2))).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_npz_rejects_pre_logits(tmp_path):
+    path = str(tmp_path / "bad.npz")
+    np.savez(
+        path,
+        **{
+            "pre_logits/kernel": np.zeros((4, 4), np.float32),
+            "embedding/kernel": np.zeros((16, 16, 3, 4), np.float32),
+        },
+    )
+    with pytest.raises(ValueError, match="pre_logits"):
+        port_vit_npz(path)
+
+
+def test_ported_weights_finetune_in_estimator(tiny_variant, tmp_path):
+    """The stretch-config wiring: ported ViT weights feed
+    FlaxImageFileEstimator via initialVariables and the fitted transformer
+    starts from them (not random init)."""
+    from PIL import Image
+
+    from sparkdl_tpu.estimators.flax_image_file_estimator import (
+        FlaxImageFileEstimator,
+    )
+    from sparkdl_tpu.sql.session import TPUSession
+
+    hf = _hf_model(num_labels=2)
+    variables = port_hf_vit(hf)
+
+    rng = np.random.RandomState(0)
+    uris = []
+    for i in range(8):
+        p = str(tmp_path / f"im_{i}.png")
+        Image.fromarray(
+            (rng.rand(32, 32, 3) * 255).astype(np.uint8)
+        ).save(p)
+        uris.append(p)
+
+    spark = TPUSession.builder.getOrCreate()
+    df = spark.createDataFrame(
+        [{"uri": u, "label": i % 2} for i, u in enumerate(uris)]
+    )
+
+    def loader(u):
+        return np.asarray(Image.open(u), np.float32) / 255.0
+
+    module = ViT(
+        variant=tiny_variant, num_classes=2, image_size=32, exact_gelu=True
+    )
+    est = FlaxImageFileEstimator(
+        inputCol="uri",
+        outputCol="out",
+        labelCol="label",
+        imageLoader=loader,
+        module=module,
+        optimizer="sgd",
+        fitParams={"epochs": 1, "batch_size": 8, "learning_rate": 0.0},
+        initialVariables=variables,
+    )
+    fitted = est.fit(df)
+    # lr=0: the "fine-tuned" params must BE the ported pretrained params
+    out_rows = fitted.transform(df).collect()
+    x = np.stack([loader(u) for u in uris])
+    want = np.asarray(module.apply(variables, x))
+    # (transform and oracle share jax's default precision here, so no pin)
+    got_arr = np.stack([np.asarray(r.out.toArray()) for r in out_rows])
+    np.testing.assert_allclose(got_arr, want, rtol=1e-4, atol=1e-5)
+
+
+def test_adapt_vit_variables_geometry_and_head(tiny_variant):
+    """The real-checkpoint fine-tune surgeries: pos-embed grid
+    interpolation to a new resolution (CLS slot untouched) and head
+    replacement for a new label set."""
+    from sparkdl_tpu.models.vit_port import adapt_vit_variables
+
+    # "pretrained" at 64² (4x4 grid + CLS = 17 tokens), 1000-way head
+    module64 = ViT(variant=tiny_variant, num_classes=1000, image_size=64)
+    variables = module64.init(
+        jax.random.PRNGKey(0), np.zeros((1, 64, 64, 3), np.float32)
+    )
+
+    adapted = adapt_vit_variables(variables, image_size=32, num_classes=2)
+    p = adapted["params"]
+    assert p["pos_embed"].shape == (1, 5, 64)  # 2x2 grid + CLS
+    # CLS slot passes through exactly
+    np.testing.assert_array_equal(
+        np.asarray(p["pos_embed"][:, 0]),
+        np.asarray(variables["params"]["pos_embed"][:, 0]),
+    )
+    # grid interpolation oracle
+    src = variables["params"]["pos_embed"][:, 1:].reshape(1, 4, 4, 64)
+    want = jax.image.resize(src, (1, 2, 2, 64), method="bilinear")
+    np.testing.assert_allclose(
+        np.asarray(p["pos_embed"][:, 1:]),
+        np.asarray(want.reshape(1, 4, 64)),
+        rtol=1e-6,
+    )
+    assert p["head"]["kernel"].shape == (64, 2)
+
+    # the adapted tree runs in the target-geometry model
+    module32 = ViT(variant=tiny_variant, num_classes=2, image_size=32)
+    out = module32.apply(adapted, np.zeros((2, 32, 32, 3), np.float32))
+    assert out.shape == (2, 2)
+
+    # same geometry + same head width -> pure pass-through
+    same = adapt_vit_variables(variables, image_size=64, num_classes=1000)
+    np.testing.assert_array_equal(
+        np.asarray(same["params"]["pos_embed"]),
+        np.asarray(variables["params"]["pos_embed"]),
+    )
+    assert same["params"]["head"] is variables["params"]["head"]
+
+    with pytest.raises(ValueError, match="not a multiple"):
+        adapt_vit_variables(variables, image_size=30)
+
+
+def test_sql_kleene_handles_numpy_bools(tpu_session):
+    """Comparisons over numpy scalars yield np.True_/np.False_; the 3VL
+    combinators must treat them as booleans (identity checks on Python
+    True/False do not)."""
+    from sparkdl_tpu.sql.functions import col
+
+    data = [
+        {"id": 1, "score": np.float64(5.0), "lbl": None},
+        {"id": 2, "score": np.float64(1.0), "lbl": 1},
+    ]
+    df = tpu_session.createDataFrame(data)
+    kept = df.filter((col("score") > 3) | (col("lbl") == 1)).collect()
+    assert sorted(r.id for r in kept) == [1, 2]
