@@ -127,3 +127,65 @@ class MetricsRegistry:
 
 #: the process-wide registry
 metrics = MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# MFU (model FLOPs utilization) — achieved FLOP/s as a fraction of the
+# chip's peak.  The analytic FLOP count comes from XLA's own cost model on
+# the compiled executable, so regressions show up numerically in bench
+# output instead of hiding behind wall-clock noise.
+# ---------------------------------------------------------------------------
+
+#: dense peak FLOP/s per chip by device kind (bf16 for TPUs, the MXU rate).
+#: Sources: public TPU spec sheets (v5e 197 TFLOP/s bf16, v4 275, v5p 459,
+#: v6e 918).  Matching is by substring of ``device.device_kind``.
+_PEAK_FLOPS_BY_KIND = (
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12),
+    ("v6e", 918e12),
+    ("v4", 275e12),
+)
+
+
+def peak_flops_per_sec(device=None) -> Optional[float]:
+    """Peak dense bf16 FLOP/s of ``device`` (default: first default-backend
+    device), or None when the chip kind is unknown (e.g. the CPU backend —
+    no honest single peak exists there)."""
+    import jax
+
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for needle, peak in _PEAK_FLOPS_BY_KIND:
+        if needle in kind:
+            return peak
+    return None
+
+
+def compiled_flops(compiled) -> Optional[float]:
+    """Analytic FLOP count of one execution of a ``jax.stages.Compiled``
+    (from ``jitted.lower(...).compile()``), per XLA's cost analysis; None
+    when the backend doesn't expose it."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:  # pragma: no cover - backend-dependent
+        return None
+    if isinstance(cost, (list, tuple)):  # older jax returned [dict]
+        cost = cost[0] if cost else None
+    if not cost:
+        return None
+    flops = cost.get("flops")
+    return float(flops) if flops and flops > 0 else None
+
+
+def mfu(flops_per_step: Optional[float], step_seconds: float,
+        device=None) -> Optional[float]:
+    """Achieved-FLOPs fraction of peak: ``flops_per_step / step_seconds /
+    peak``; None when either the FLOP count or the chip peak is unknown."""
+    if not flops_per_step or step_seconds <= 0:
+        return None
+    peak = peak_flops_per_sec(device)
+    if not peak:
+        return None
+    return (flops_per_step / step_seconds) / peak
