@@ -95,9 +95,11 @@ def data_parallel_mesh() -> Optional[Mesh]:
     over ICI with zero cross-chip traffic.
 
     ``SPARKDL_INFERENCE_DEVICES`` controls it: unset/empty/``all`` uses every
-    local device, ``1``/``off``/``none`` forces single-device, an integer
-    ``N`` uses the first N.  Read once per process — params placed at stage
-    build / UDF registration time and batches placed per call must agree.
+    local device, ``0``/``1``/``off``/``none`` forces single-device (``0``
+    and ``1`` are aliases for ``off`` — there is no zero-device mesh), an
+    integer ``N >= 2`` uses the first N.  Read once per process — params
+    placed at stage build / UDF registration time and batches placed per
+    call must agree.
     """
     global _dp_mesh_choice
     if _dp_mesh_choice is not None:
@@ -342,7 +344,10 @@ def run_batched_multi(
     With a multi-device :func:`data_parallel_mesh`, every (padded, fixed
     shape) chunk is placed with its leading dim sharded across the mesh, so
     ``fn`` — whose params were replicated by :func:`place_params` — compiles
-    to one SPMD program spanning all local chips.
+    to one SPMD program spanning all local chips.  ``batch_size`` is rounded
+    up to the nearest mesh multiple in that case (equal-sized shards per
+    chip), so e.g. ``batchSize=10`` runs as 16-row chunks on 8 chips; row
+    count and output order are unaffected.
 
     Returns one concatenated array per function output.
     """
@@ -357,7 +362,16 @@ def run_batched_multi(
         # padded chunks are always exactly batch_size rows; round the batch
         # up to a mesh multiple so the shards are equal-sized
         n_dev = int(mesh.devices.size)
-        batch_size = -(-batch_size // n_dev) * n_dev
+        rounded = -(-batch_size // n_dev) * n_dev
+        if rounded != batch_size:
+            logger.debug(
+                "run_batched: batch_size %d rounded up to %d (mesh multiple "
+                "of %d devices)",
+                batch_size,
+                rounded,
+                n_dev,
+            )
+        batch_size = rounded
         # P("data") shards the leading dim; unmentioned trailing dims are
         # replicated, so one sharding serves every input rank
         sharding = NamedSharding(mesh, PartitionSpec("data"))
@@ -437,7 +451,12 @@ def place_params(params, device=None):
     """Pin a params pytree to the accelerator(s) once per transform: with
     more than one local device (and no explicit ``device``) the pytree is
     replicated over the :func:`data_parallel_mesh` so batches sharded on the
-    ``data`` axis run SPMD; otherwise it lands on the one default device."""
+    ``data`` axis run SPMD; otherwise it lands on the one default device.
+
+    Passing an explicit ``device`` on a multi-chip host requires
+    ``SPARKDL_INFERENCE_DEVICES=off``: :func:`run_batched_multi` shards
+    batches over the process mesh, and jit rejects mesh-sharded batches
+    against single-device params."""
     if device is None:
         mesh = data_parallel_mesh()
         if mesh is not None:

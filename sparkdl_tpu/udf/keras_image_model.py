@@ -60,7 +60,7 @@ def registerKerasImageUDF(
     preprocessor: Optional[Callable[[str], np.ndarray]] = None,
     session=None,
     batchSize: int = DEFAULT_BATCH_SIZE,
-    computeDtype: str = "float32",
+    computeDtype: Optional[str] = "float32",
 ) -> UserDefinedFunction:
     """Register ``udfName`` so ``SELECT udfName(image) FROM view`` runs the
     model.  Returns the :class:`UserDefinedFunction` (also usable directly in
@@ -73,7 +73,7 @@ def registerKerasImageUDF(
     only: an in-memory model already carries its own dtype policy (build
     it under a keras mixed policy instead).
     """
-    if computeDtype != "float32" and not isinstance(
+    if computeDtype not in (None, "float32") and not isinstance(
         keras_model_or_file, (str, os.PathLike)
     ):
         raise ValueError(
