@@ -132,12 +132,27 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
         ]
+        lib.pjrt_runner_put_async.restype = ctypes.c_int64
+        lib.pjrt_runner_put_async.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ]
+        lib.pjrt_runner_await_buffer.restype = ctypes.c_int
+        lib.pjrt_runner_await_buffer.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+        ]
         lib.pjrt_runner_free_buffer.restype = ctypes.c_int
         lib.pjrt_runner_free_buffer.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.pjrt_runner_execute.restype = ctypes.c_int64
         lib.pjrt_runner_execute.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.pjrt_runner_execute_async.restype = ctypes.c_int64
+        lib.pjrt_runner_execute_async.argtypes = [
             ctypes.c_void_p, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
             ctypes.POINTER(ctypes.c_int64),
@@ -301,6 +316,30 @@ class PjrtRunner:
             raise RuntimeError(f"put failed: {self._err()}")
         return int(buf_id)
 
+    def put_async(self, array: np.ndarray) -> int:
+        """Start a host->device copy and return immediately (the plugin
+        stages the bytes during the call; the device transfer overlaps
+        subsequent work).  Consumers order themselves after the transfer
+        via PJRT buffer definition events."""
+        array = np.ascontiguousarray(array)
+        dims = (ctypes.c_int64 * array.ndim)(*array.shape)
+        buf_id = self._lib.pjrt_runner_put_async(
+            self._h,
+            array.ctypes.data_as(ctypes.c_void_p),
+            _dtype_name(array.dtype).encode(),
+            dims,
+            array.ndim,
+        )
+        if buf_id < 0:
+            raise RuntimeError(f"put_async failed: {self._err()}")
+        return int(buf_id)
+
+    def await_buffer(self, buf_id: int) -> None:
+        """Block until the buffer's contents are defined on device
+        (surfaces asynchronous transfer/compute errors)."""
+        if self._lib.pjrt_runner_await_buffer(self._h, buf_id) != 0:
+            raise RuntimeError(f"await_buffer failed: {self._err()}")
+
     def free(self, buf_id: int) -> None:
         self._lib.pjrt_runner_free_buffer(self._h, buf_id)
 
@@ -313,6 +352,22 @@ class PjrtRunner:
         )
         if got < 0:
             raise RuntimeError(f"execute failed: {self._err()}")
+        return [int(outs[i]) for i in range(got)]
+
+    def execute_async(
+        self, exec_id: int, arg_buf_ids: Sequence[int]
+    ) -> List[int]:
+        """Enqueue an execution and return immediately; fetching an
+        output (or await_buffer) blocks until compute completes.  Pairs
+        with put_async for double-buffered batch streaming."""
+        n_out = max(self.num_outputs(exec_id), 1)
+        args = (ctypes.c_int64 * len(arg_buf_ids))(*arg_buf_ids)
+        outs = (ctypes.c_int64 * n_out)()
+        got = self._lib.pjrt_runner_execute_async(
+            self._h, exec_id, args, len(arg_buf_ids), outs
+        )
+        if got < 0:
+            raise RuntimeError(f"execute_async failed: {self._err()}")
         return [int(outs[i]) for i in range(got)]
 
     def fetch(self, buf_id: int, shape, dtype) -> np.ndarray:

@@ -319,11 +319,15 @@ int64_t pjrt_runner_num_outputs(Runner* r, int64_t exec_id) {
                                          : static_cast<int64_t>(it->second);
 }
 
-// Synchronously copy a dense host array to the device.  Returns a buffer
-// handle > 0, or -1 on error.  `dtype` is one of the short names in
-// dtype_to_pjrt ("f32", "u8", ...).
-int64_t pjrt_runner_put(Runner* r, const void* data, const char* dtype,
-                        const int64_t* dims, int32_t num_dims) {
+// Shared host->device copy body; `semantics` selects sync
+// (kImmutableUntilTransferCompletes — the await blocks until the
+// transfer completes) vs async (kImmutableOnlyDuringCall — the plugin
+// stages the bytes during the call, the await is ready at return, and
+// the device transfer proceeds in the background).
+static int64_t put_impl(Runner* r, const void* data, const char* dtype,
+                        const int64_t* dims, int32_t num_dims,
+                        PJRT_HostBufferSemantics semantics,
+                        const char* what) {
   PJRT_Buffer_Type type;
   size_t itemsize;
   if (!dtype_to_pjrt(dtype, &type, &itemsize)) {
@@ -338,20 +342,68 @@ int64_t pjrt_runner_put(Runner* r, const void* data, const char* dtype,
   args.type = type;
   args.dims = dims;
   args.num_dims = static_cast<size_t>(num_dims);
-  args.host_buffer_semantics =
-      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  args.host_buffer_semantics = semantics;
   args.device = r->device;
   if (take_error(r, r->api->PJRT_Client_BufferFromHostBuffer(&args),
                  "PJRT_Client_BufferFromHostBuffer")) {
     return -1;
   }
-  if (!await_event(r, args.done_with_host_buffer, "host transfer")) {
+  if (!await_event(r, args.done_with_host_buffer, what)) {
     return -1;
   }
   std::lock_guard<std::mutex> lock(r->mu);
   int64_t id = r->next_id++;
   r->buffers[id] = args.buffer;
   return id;
+}
+
+// Synchronously copy a dense host array to the device.  Returns a buffer
+// handle > 0, or -1 on error.  `dtype` is one of the short names in
+// dtype_to_pjrt ("f32", "u8", ...).
+int64_t pjrt_runner_put(Runner* r, const void* data, const char* dtype,
+                        const int64_t* dims, int32_t num_dims) {
+  return put_impl(r, data, dtype, dims, num_dims,
+                  PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes,
+                  "host transfer");
+}
+
+// Asynchronous host->device copy: the plugin stages the host data during
+// the call (kImmutableOnlyDuringCall), so `data` is reusable on return
+// while the device-side transfer proceeds in the background.  Downstream
+// consumers (execute, fetch) order themselves after the transfer via
+// PJRT's buffer definition events — no host-side await needed.  This is
+// the double-buffering primitive: batch i+1's transfer rides under batch
+// i's execute instead of serializing before it (the TensorFrames
+// "blocked pipelining" role — SURVEY.md §2 native table).
+int64_t pjrt_runner_put_async(Runner* r, const void* data, const char* dtype,
+                              const int64_t* dims, int32_t num_dims) {
+  return put_impl(r, data, dtype, dims, num_dims,
+                  PJRT_HostBufferSemantics_kImmutableOnlyDuringCall,
+                  "host staging");
+}
+
+// Block until `buf_id`'s contents are defined on device (transfer or
+// producing execution complete).  Surfaces asynchronous errors.
+int pjrt_runner_await_buffer(Runner* r, int64_t buf_id) {
+  PJRT_Buffer* buf;
+  {
+    std::lock_guard<std::mutex> lock(r->mu);
+    auto it = r->buffers.find(buf_id);
+    if (it == r->buffers.end()) {
+      set_err(r, "bad buffer handle");
+      return -1;
+    }
+    buf = it->second;
+  }
+  PJRT_Buffer_ReadyEvent_Args args;
+  std::memset(&args, 0, sizeof(args));
+  args.struct_size = PJRT_Buffer_ReadyEvent_Args_STRUCT_SIZE;
+  args.buffer = buf;
+  if (take_error(r, r->api->PJRT_Buffer_ReadyEvent(&args),
+                 "PJRT_Buffer_ReadyEvent")) {
+    return -1;
+  }
+  return await_event(r, args.event, "buffer ready") ? 0 : -1;
 }
 
 int pjrt_runner_free_buffer(Runner* r, int64_t buf_id) {
@@ -373,13 +425,12 @@ int pjrt_runner_free_buffer(Runner* r, int64_t buf_id) {
              : 0;
 }
 
-// Execute on the single addressable device.  Inputs are buffer handles;
-// outputs become new buffer handles written to `out_buf_ids` (which must
-// hold at least the executable's output count — query via
-// pjrt_runner_num_outputs).  Returns the output count, or -1.
-int64_t pjrt_runner_execute(Runner* r, int64_t exec_id,
+// Shared execute body: `wait` controls whether the device-complete event
+// is awaited (sync) or never requested (async — outputs become handles
+// with pending definition events; fetch/await orders after compute).
+static int64_t execute_impl(Runner* r, int64_t exec_id,
                             const int64_t* arg_buf_ids, int32_t num_args,
-                            int64_t* out_buf_ids) {
+                            int64_t* out_buf_ids, bool wait) {
   PJRT_LoadedExecutable* exec;
   size_t num_outputs;
   std::vector<PJRT_Buffer*> args_vec(num_args);
@@ -422,12 +473,12 @@ int64_t pjrt_runner_execute(Runner* r, int64_t exec_id,
   eargs.num_devices = 1;
   eargs.num_args = static_cast<size_t>(num_args);
   eargs.output_lists = &output_list;
-  eargs.device_complete_events = &device_complete;
+  eargs.device_complete_events = wait ? &device_complete : nullptr;
   if (take_error(r, r->api->PJRT_LoadedExecutable_Execute(&eargs),
                  "PJRT_LoadedExecutable_Execute")) {
     return -1;
   }
-  if (!await_event(r, device_complete, "execute")) return -1;
+  if (wait && !await_event(r, device_complete, "execute")) return -1;
 
   std::lock_guard<std::mutex> lock(r->mu);
   for (size_t i = 0; i < num_outputs; ++i) {
@@ -436,6 +487,30 @@ int64_t pjrt_runner_execute(Runner* r, int64_t exec_id,
     out_buf_ids[i] = id;
   }
   return static_cast<int64_t>(num_outputs);
+}
+
+// Execute on the single addressable device.  Inputs are buffer handles;
+// outputs become new buffer handles written to `out_buf_ids` (which must
+// hold at least the executable's output count — query via
+// pjrt_runner_num_outputs).  Returns the output count, or -1.
+int64_t pjrt_runner_execute(Runner* r, int64_t exec_id,
+                            const int64_t* arg_buf_ids, int32_t num_args,
+                            int64_t* out_buf_ids) {
+  return execute_impl(r, exec_id, arg_buf_ids, num_args, out_buf_ids,
+                      /*wait=*/true);
+}
+
+// Asynchronous execute: enqueues and returns immediately; output handles
+// carry pending definition events.  A later pjrt_runner_get /
+// pjrt_runner_await_buffer blocks until compute completes (and surfaces
+// any asynchronous failure).  Pairs with pjrt_runner_put_async to
+// double-buffer batches: enqueue batch i+1's transfer+execute, then fetch
+// batch i's outputs while i+1 runs.
+int64_t pjrt_runner_execute_async(Runner* r, int64_t exec_id,
+                                  const int64_t* arg_buf_ids,
+                                  int32_t num_args, int64_t* out_buf_ids) {
+  return execute_impl(r, exec_id, arg_buf_ids, num_args, out_buf_ids,
+                      /*wait=*/false);
 }
 
 // Debug: describe `buf_id`'s device memory layout into `out` as

@@ -44,9 +44,13 @@ int64_t pjrt_runner_compile(PjrtRunner*, const char*, int64_t, const char*,
 int64_t pjrt_runner_num_outputs(PjrtRunner*, int64_t);
 int64_t pjrt_runner_put(PjrtRunner*, const void*, const char*,
                         const int64_t*, int32_t);
+int64_t pjrt_runner_put_async(PjrtRunner*, const void*, const char*,
+                              const int64_t*, int32_t);
 int pjrt_runner_free_buffer(PjrtRunner*, int64_t);
 int64_t pjrt_runner_execute(PjrtRunner*, int64_t, const int64_t*, int32_t,
                             int64_t*);
+int64_t pjrt_runner_execute_async(PjrtRunner*, int64_t, const int64_t*,
+                                  int32_t, int64_t*);
 int64_t pjrt_runner_buffer_size(PjrtRunner*, int64_t);
 int pjrt_runner_get(PjrtRunner*, int64_t, void*, int64_t);
 void pjrt_runner_destroy(PjrtRunner*);
@@ -214,10 +218,36 @@ int main(int argc, char** argv) {
     pjrt_runner_destroy(r);
     return 1;
   }
+  // Double-buffered streaming: batch i+1's host read + host->device
+  // transfer + execute are ENQUEUED (put_async/execute_async) before
+  // batch i's outputs are fetched, so the link transfer and compute of
+  // consecutive batches overlap instead of serializing — previously every
+  // stage awaited its event before the next began (0.33 s/batch pure
+  // serialized link time on the relay rig, BASELINE.md).  One batch in
+  // flight bounds device memory at 2x inputs + 2x outputs.
   std::vector<char> batch(batch_bytes);
-  std::vector<int64_t> out_ids(outputs.size() ? outputs.size() : 1);
   size_t n_batches = 0;
   const size_t n_params = arg_ids.size();
+  struct InFlight {
+    std::vector<int64_t> input_ids;
+    std::vector<int64_t> output_ids;
+  };
+  InFlight prev;
+  bool have_prev = false;
+
+  auto drain = [&](InFlight& f) -> bool {  // fetch, write, free
+    for (int64_t id : f.output_ids) {
+      int64_t sz = pjrt_runner_buffer_size(r, id);
+      if (sz < 0) return false;
+      std::vector<char> host(static_cast<size_t>(sz));
+      if (pjrt_runner_get(r, id, host.data(), sz) != 0) return false;
+      out.write(host.data(), sz);
+      pjrt_runner_free_buffer(r, id);
+    }
+    for (int64_t id : f.input_ids) pjrt_runner_free_buffer(r, id);
+    return true;
+  };
+
   while (true) {
     if (batch_bytes == 0) {
       if (n_batches) break;  // params-only program: run exactly once
@@ -233,33 +263,36 @@ int main(int argc, char** argv) {
       }
       break;
     }
+    InFlight cur;
     size_t boff = 0;
     for (const Spec& s : inputs) {
-      int64_t id = pjrt_runner_put(r, batch.data() + boff, s.dtype.c_str(),
-                                   s.dims.data(),
-                                   static_cast<int32_t>(s.dims.size()));
+      // async put: the plugin stages the bytes during the call, so
+      // `batch` is reusable for the next read while the transfer rides
+      // under the previous batch's execute
+      int64_t id = pjrt_runner_put_async(
+          r, batch.data() + boff, s.dtype.c_str(), s.dims.data(),
+          static_cast<int32_t>(s.dims.size()));
       if (id < 0) return die(r, "batch upload");
-      arg_ids.push_back(id);
+      cur.input_ids.push_back(id);
       boff += s.bytes;
     }
-    int64_t n_out = pjrt_runner_execute(
-        r, exec_id, arg_ids.data(), static_cast<int32_t>(arg_ids.size()),
-        out_ids.data());
-    if (n_out < 0) return die(r, "execute");
-    for (int64_t i = 0; i < n_out; ++i) {
-      int64_t sz = pjrt_runner_buffer_size(r, out_ids[i]);
-      if (sz < 0) return die(r, "output size");
-      std::vector<char> host(static_cast<size_t>(sz));
-      if (pjrt_runner_get(r, out_ids[i], host.data(), sz) != 0)
-        return die(r, "fetch");
-      out.write(host.data(), sz);
-      pjrt_runner_free_buffer(r, out_ids[i]);
-    }
-    for (size_t i = n_params; i < arg_ids.size(); ++i)
-      pjrt_runner_free_buffer(r, arg_ids[i]);
     arg_ids.resize(n_params);
+    arg_ids.insert(arg_ids.end(), cur.input_ids.begin(),
+                   cur.input_ids.end());
+    cur.output_ids.resize(outputs.size() ? outputs.size() : 1);
+    int64_t n_out = pjrt_runner_execute_async(
+        r, exec_id, arg_ids.data(), static_cast<int32_t>(arg_ids.size()),
+        cur.output_ids.data());
+    if (n_out < 0) return die(r, "execute");
+    cur.output_ids.resize(static_cast<size_t>(n_out));
+    // with batch i+1 queued, draining batch i overlaps its fetch with
+    // i+1's transfer+compute
+    if (have_prev && !drain(prev)) return die(r, "fetch");
+    prev = std::move(cur);
+    have_prev = true;
     ++n_batches;
   }
+  if (have_prev && !drain(prev)) return die(r, "fetch");
   std::fprintf(stderr, "pjrt_tool: platform=%s batches=%zu -> %s\n",
                platform, n_batches, out_path.c_str());
   pjrt_runner_destroy(r);

@@ -420,6 +420,140 @@ def run_batched(
     return run_batched_multi(fn, [batch], batch_size)[0]
 
 
+def _serial_inference() -> bool:
+    """Kill switch for the pipelined serving path: SPARKDL_SERIAL_INFERENCE=1
+    restores strict decode-all -> dispatch -> fetch serialization."""
+    return os.environ.get("SPARKDL_SERIAL_INFERENCE", "").strip() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def run_batched_rows(
+    fn: Callable,
+    rows: Sequence,
+    decode: Callable[[Sequence], np.ndarray],
+    batch_size: int = DEFAULT_BATCH_SIZE,
+) -> np.ndarray:
+    """Decode+forward pipeline over row chunks — the serving-path
+    transfer/compute overlap (the reference delegated this to
+    TensorFrames' blocked pipelining; SURVEY.md §2):
+
+    - host decode of chunk i+1 runs on a prefetch thread while chunk i is
+      on device (the inference analog of the estimator's
+      ``StreamingShardLoader``);
+    - chunk i+1 is *dispatched* before chunk i's output is fetched (one
+      in flight — jax dispatch is async, so i+1's host->device transfer
+      and compute ride under i's device->host fetch).
+
+    ``decode(chunk_rows) -> np.ndarray`` must be row-aligned with
+    ``rows``.  Chunks are ``batch_size`` rows (mesh-rounded, as in
+    :func:`run_batched_multi`); the ragged final chunk pads by repeating
+    its last row, so exactly one batch shape is ever compiled per decode
+    shape.  ``SPARKDL_SERIAL_INFERENCE=1`` disables both overlaps.
+    """
+    import queue as queue_mod
+    import threading
+
+    from sparkdl_tpu.utils.metrics import metrics
+    from sparkdl_tpu.utils.profiler import maybe_trace
+
+    n = len(rows)
+    if n == 0:
+        raise ValueError("run_batched_rows requires non-empty rows")
+    mesh = data_parallel_mesh()
+    if mesh is not None:
+        n_dev = int(mesh.devices.size)
+        batch_size = -(-batch_size // n_dev) * n_dev
+        sharding = NamedSharding(mesh, PartitionSpec("data"))
+
+        def _place(c):
+            return jax.device_put(c, sharding)
+
+    else:
+        _place = jnp.asarray
+
+    serial = _serial_inference()
+    bounds = [(lo, min(lo + batch_size, n)) for lo in range(0, n, batch_size)]
+
+    def decode_chunk(lo, hi):
+        batch = decode(rows[lo:hi])
+        k = batch.shape[0]
+        if k < batch_size:
+            batch = np.concatenate(
+                [batch, np.repeat(batch[-1:], batch_size - k, axis=0)], axis=0
+            )
+        return batch, k
+
+    cancel = threading.Event()
+    if serial:
+        chunk_iter = (decode_chunk(lo, hi) for lo, hi in bounds)
+    else:
+        # prefetch thread: maxsize=2 bounds host memory at ~2 extra
+        # chunks; `cancel` (set when the consumer aborts) unblocks the
+        # bounded put so a failed call doesn't leak the thread plus its
+        # decoded chunks
+        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
+
+        def _put(item) -> bool:
+            while not cancel.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for lo, hi in bounds:
+                    if not _put(decode_chunk(lo, hi)):
+                        return
+                _put(None)
+            except BaseException as e:  # surfaced in the consumer
+                _put(e)
+
+        threading.Thread(target=producer, daemon=True).start()
+
+        def drain():
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+
+        chunk_iter = drain()
+
+    # (images_processed is advanced by the decode layer — e.g.
+    # decode_image_batch — not here, to avoid double counting)
+    collected: List[np.ndarray] = []
+    pending: Optional[Tuple[Any, int]] = None
+    forward_timer = metrics.timer("sparkdl.forward")
+    try:
+        with maybe_trace(), forward_timer.time():
+            for batch, k in chunk_iter:
+                result = fn(_place(batch))  # async dispatch
+                if pending is not None:
+                    r_prev, k_prev = pending
+                    collected.append(
+                        np.asarray(jax.device_get(r_prev))[:k_prev]
+                    )
+                    pending = None
+                if serial:
+                    collected.append(np.asarray(jax.device_get(result))[:k])
+                else:
+                    pending = (result, k)
+            if pending is not None:
+                r_prev, k_prev = pending
+                collected.append(np.asarray(jax.device_get(r_prev))[:k_prev])
+    finally:
+        cancel.set()
+    metrics.counter("sparkdl.rows_processed").add(n)
+    metrics.counter("sparkdl.batches_run").add(len(bounds))
+    return np.concatenate(collected, axis=0)
+
+
 def normalize_channels(img: np.ndarray, n_channels: int) -> np.ndarray:
     """Coerce an HWC float array to ``n_channels`` (3: replicate gray / drop
     alpha; 1: ITU-R 601 luminance) so a partition with mixed image modes

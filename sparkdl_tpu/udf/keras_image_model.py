@@ -37,12 +37,11 @@ from sparkdl_tpu.ml.linalg import DenseVector
 from sparkdl_tpu.sql.functions import UserDefinedFunction
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
-    MixedImageSizesError,
     cast_and_resize_on_device,
     decode_image_batch,
     load_keras_function,
     place_params,
-    run_batched,
+    run_batched_rows,
 )
 
 
@@ -95,36 +94,66 @@ def registerKerasImageUDF(
         return inner(params, x)[0]
 
     def evaluate(values):
+        # decode and forward run as a pipeline (run_batched_rows): host
+        # decode of chunk i+1 on a prefetch thread while chunk i is on
+        # device, dispatch one chunk ahead of fetch — the serving-path
+        # transfer/compute overlap (previously the whole partition was
+        # decoded before anything shipped)
         if not values:
             return []
         if preprocessor is not None:
             # file-loader mode: the preprocessor owns the whole input
-            # contract — its output is fed to the model unchanged
-            arrays = [
-                np.asarray(preprocessor(v), dtype=np.float32) for v in values
-            ]
-            shapes = {a.shape for a in arrays}
-            if len(shapes) > 1:
-                raise ValueError(
-                    f"UDF {udfName!r}: preprocessor produced mixed shapes "
-                    f"{sorted(shapes)}; it must emit one fixed shape"
-                )
-            batch = np.stack(arrays)
+            # contract — its output is fed to the model unchanged.  The
+            # one-fixed-shape contract is enforced ACROSS chunks too (the
+            # first chunk's shape binds the partition), so a chunk-aligned
+            # shape change still gets the contract error, not a raw
+            # concatenate failure
+            expected_shape = [None]
+
+            def decode(chunk):
+                arrays = [
+                    np.asarray(preprocessor(v), dtype=np.float32)
+                    for v in chunk
+                ]
+                shapes = {a.shape for a in arrays}
+                if expected_shape[0] is not None:
+                    shapes.add(expected_shape[0])
+                if len(shapes) > 1:
+                    raise ValueError(
+                        f"UDF {udfName!r}: preprocessor produced mixed "
+                        f"shapes {sorted(shapes)}; it must emit one fixed "
+                        "shape"
+                    )
+                expected_shape[0] = arrays[0].shape
+                return np.stack(arrays)
+
         else:
-            try:
-                # stored BGR -> model RGB while packing; uniform partitions
-                # pack at source size (uint8 when possible — the forward
-                # resizes on device); mixed shapes resize-while-packing
-                batch = decode_image_batch(
-                    values, 3, size, to_rgb=True, prefer_uint8=True
-                )
-            except MixedImageSizesError as e:
+            # shape-uniformity is decided over the WHOLE partition so
+            # exactly one batch shape compiles (per-chunk decisions could
+            # alternate between source-size and resized programs)
+            hws = {(int(r["height"]), int(r["width"])) for r in values}
+            uniform = len(hws) == 1
+            if not uniform and size is None:
                 raise ValueError(
                     f"UDF {udfName!r}: model input size is dynamic and "
                     "the column holds mixed shapes; resize in a "
                     "preprocessor or use a fixed-input-size model"
-                ) from e
-        result = run_batched(forward, batch, batchSize)
+                )
+
+            def decode(chunk):
+                # stored BGR -> model RGB while packing; uniform partitions
+                # pack at source size (uint8 when possible — the forward
+                # resizes on device); mixed shapes resize-while-packing
+                return decode_image_batch(
+                    chunk,
+                    3,
+                    size,
+                    to_rgb=True,
+                    prefer_uint8=True,
+                    always_resize=not uniform,
+                )
+
+        result = run_batched_rows(forward, values, decode, batchSize)
         flat = result.reshape(result.shape[0], -1).astype(np.float64)
         return [DenseVector(v) for v in flat]
 
