@@ -1,0 +1,84 @@
+"""Shared orbax checkpoint plumbing for the estimators.
+
+Both :class:`KerasImageFileEstimator` and :class:`FlaxImageFileEstimator`
+implement the same resume contract (SURVEY.md §5.4 — absent in the
+reference): per-configuration namespaces under one ``checkpointDir``,
+``epoch_N`` subdirectories, async commits, commit-marker-aware restore
+(a SIGKILL mid-save leaves an unfinalized directory that must never be
+resumed from), and an epoch cap so a shorter re-fit restores the exact
+earlier epoch.  The estimator-specific parts — payload contents,
+configuration fingerprint, and restored-leaf placement — stay in the
+estimators; everything else lives here so the two cannot drift.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+def make_async_checkpointer():
+    """Async orbax checkpointer: ``save`` snapshots device arrays to host
+    memory synchronously (safe against the train loop donating the state
+    buffers on the next step) and commits to disk on a background thread,
+    so save latency hides behind the following epoch.  Callers must
+    ``wait_until_finished()`` + ``close()`` after the last save."""
+    import orbax.checkpoint as ocp
+
+    return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+
+
+def epoch_path(ckpt_dir: str, namespace: str, epoch: int) -> str:
+    return os.path.join(os.path.abspath(ckpt_dir), namespace, f"epoch_{epoch}")
+
+
+def save_epoch(ckptr, ckpt_dir: str, namespace: str, epoch: int, payload):
+    """Asynchronously save ``payload`` as this namespace's ``epoch_N``."""
+    import orbax.checkpoint as ocp
+
+    ckptr.save(
+        epoch_path(ckpt_dir, namespace, epoch),
+        args=ocp.args.StandardSave(payload),
+        force=True,
+    )
+
+
+def is_committed(root: str, epoch: int) -> bool:
+    """True when ``epoch_N`` is a FINALIZED checkpoint — a SIGKILL mid-save
+    leaves an uncommitted directory orbax has not renamed/marked, and
+    resuming from one restores garbage."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(root, f"epoch_{epoch}")
+    try:
+        return ocp.utils.is_checkpoint_finalized(path)
+    except (AttributeError, ValueError):
+        return os.path.isdir(path)
+
+
+def committed_epochs(
+    ckpt_dir: str, namespace: str, max_epoch: Optional[int] = None
+) -> List[int]:
+    """Sorted committed epoch numbers in this namespace, optionally capped
+    at ``max_epoch`` (never resume past the requested stopping point — a
+    shorter re-fit must reproduce the short run, not return later
+    weights).  Empty when the namespace does not exist."""
+    root = os.path.join(os.path.abspath(ckpt_dir), namespace)
+    if not os.path.isdir(root):
+        return []
+    epochs = sorted(
+        int(d.split("_")[1])
+        for d in os.listdir(root)
+        if d.startswith("epoch_") and d.split("_")[1].isdigit()
+    )
+    if max_epoch is not None:
+        epochs = [e for e in epochs if e <= max_epoch]
+    return [e for e in epochs if is_committed(root, e)]
+
+
+def restore_epoch(ckpt_dir: str, namespace: str, epoch: int, template):
+    """Synchronously restore ``epoch_N`` into ``template``'s structure."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(epoch_path(ckpt_dir, namespace, epoch), template)

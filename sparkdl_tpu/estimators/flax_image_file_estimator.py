@@ -3,7 +3,7 @@
 The ViT stretch config's estimator (SURVEY.md §7 step 8): same param
 surface and outer flow as :class:`KerasImageFileEstimator` (imageLoader /
 optimizer / loss / fitParams; collect URIs, load via the user's loader,
-train, return a fitted transformer — no mid-training checkpointing yet),
+train with orbax checkpoint/resume, return a fitted transformer),
 but the model is a ``flax.linen.Module`` — e.g.
 ``sparkdl_tpu.models.ViT(variant="ViT-B/16")``
 — so the training step can also run tensor-parallel: pass
@@ -26,6 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sparkdl_tpu.estimators import checkpointing
 from sparkdl_tpu.estimators.data import load_host_shard
 from sparkdl_tpu.estimators.losses import (
     get_optimizer,
@@ -176,6 +177,14 @@ class FlaxImageFileEstimator(
         "optional (dp, tp) device-count split for the DPxTP mesh; None "
         "picks dp=2 when the device count is even, else dp=1",
     )
+    checkpointDir = Param(
+        "undefined", "checkpointDir",
+        "orbax checkpoint directory for mid-training save/resume "
+        "(None disables checkpointing); same semantics as "
+        "KerasImageFileEstimator: per-configuration namespace (epochs "
+        "excluded — a re-fit with more epochs resumes, a shorter one "
+        "restores the exact earlier epoch), async commits",
+    )
 
     @keyword_only
     def __init__(
@@ -191,6 +200,7 @@ class FlaxImageFileEstimator(
         initialVariables=None,
         shardingRules: Optional[Sequence] = None,
         meshShape: Optional[Sequence[int]] = None,
+        checkpointDir: Optional[str] = None,
     ):
         super().__init__()
         self._setDefault(
@@ -200,6 +210,7 @@ class FlaxImageFileEstimator(
             initialVariables=None,
             shardingRules=None,
             meshShape=None,
+            checkpointDir=None,
         )
         kwargs = self._input_kwargs
         self.setParams(**kwargs)
@@ -218,6 +229,7 @@ class FlaxImageFileEstimator(
         initialVariables=None,
         shardingRules: Optional[Sequence] = None,
         meshShape: Optional[Sequence[int]] = None,
+        checkpointDir: Optional[str] = None,
     ):
         kwargs = self._input_kwargs
         return self._set(**kwargs)
@@ -358,28 +370,63 @@ class FlaxImageFileEstimator(
         n_dev = int(mesh.devices.size)
         batch_size = max(batch_size - batch_size % n_dev, n_dev)
         n = x.shape[0]
-        rng = np.random.RandomState(seed % 2**32)
-        last_loss = None
-        for epoch in range(epochs):
-            order = rng.permutation(n)
-            for lo in range(0, n, batch_size):
-                idx = order[lo : lo + batch_size]
-                k = len(idx)
-                if k < batch_size:
-                    # pad cyclically; pad rows carry zero weight, so the
-                    # update is the exact mean over the k real rows
-                    idx = np.concatenate(
-                        [idx, np.resize(order, batch_size - k)]
-                    )
-                w = np.zeros(batch_size, np.float32)
-                w[:k] = 1.0
-                state, loss = step_fn(
-                    state, place_batch({"x": x[idx], "y": y[idx], "w": w})
-                )
-            last_loss = float(loss)
-            logger.info(
-                "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
+
+        ckpt_dir = self.getOrDefault(self.checkpointDir)
+        start_epoch = 0
+        namespace = None
+        if ckpt_dir:
+            # computed once per fit: the fingerprint sums every
+            # initialVariables leaf, so per-epoch recomputation would
+            # re-scan the full pretrained pytree each save
+            namespace = self._ckpt_namespace()
+            start_epoch, state = self._maybe_restore(
+                ckpt_dir, namespace, state, max_epoch=epochs
             )
+            if start_epoch >= epochs and start_epoch > 0:
+                logger.info(
+                    "checkpoint already at epoch %d == requested epochs=%d; "
+                    "returning the checkpointed weights without training",
+                    start_epoch,
+                    epochs,
+                )
+        rng = np.random.RandomState(seed % 2**32)
+        # replay restored epochs' draws: epoch e always trains on the e-th
+        # permutation, so a resumed fit is step-for-step identical to an
+        # uninterrupted one (same contract as KerasImageFileEstimator)
+        for _ in range(start_epoch):
+            rng.permutation(n)
+        last_loss = None
+        ckptr = self._make_checkpointer() if ckpt_dir else None
+        try:
+            for epoch in range(start_epoch, epochs):
+                order = rng.permutation(n)
+                for lo in range(0, n, batch_size):
+                    idx = order[lo : lo + batch_size]
+                    k = len(idx)
+                    if k < batch_size:
+                        # pad cyclically; pad rows carry zero weight, so the
+                        # update is the exact mean over the k real rows
+                        idx = np.concatenate(
+                            [idx, np.resize(order, batch_size - k)]
+                        )
+                    w = np.zeros(batch_size, np.float32)
+                    w[:k] = 1.0
+                    state, loss = step_fn(
+                        state, place_batch({"x": x[idx], "y": y[idx], "w": w})
+                    )
+                last_loss = float(loss)
+                logger.info(
+                    "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
+                )
+                if ckptr is not None:
+                    checkpointing.save_epoch(
+                        ckptr, ckpt_dir, namespace, epoch + 1,
+                        self._ckpt_payload(state),
+                    )
+        finally:
+            if ckptr is not None:
+                ckptr.wait_until_finished()
+                ckptr.close()
 
         tuned = jax.tree_util.tree_map(np.asarray, state.params)
         transformer = FlaxImageFileTransformer(
@@ -391,3 +438,115 @@ class FlaxImageFileEstimator(
         )
         transformer._training_loss = last_loss
         return transformer
+
+    # ------------------------------------------------------------------
+    # orbax checkpoint / resume — same contract as KerasImageFileEstimator
+    # (namespaced per configuration, epochs excluded, async commits,
+    # epoch-capped restore); works for both the DP and the GSPMD DP x TP
+    # state (restored leaves are re-placed onto the fresh state's
+    # shardings, so TP-sharded opt states land back where they belong).
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _ckpt_payload(state):
+        payload = {
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "step": state.step,
+        }
+        if getattr(state, "batch_stats", None) is not None:
+            payload["batch_stats"] = state.batch_stats
+        return payload
+
+    def _ckpt_namespace(self) -> str:
+        """Deterministic per-configuration subdirectory.  The trajectory
+        fingerprint covers the module (flax dataclass repr), optimizer,
+        loss, trajectory fitParams (epochs excluded — a stopping point,
+        not a trajectory parameter) and a cheap digest of the initial
+        variables (shapes + per-leaf sums), so different pretrained
+        starting points never restore each other's state.  Sharding knobs
+        (shardingRules/meshShape) are excluded: TP == DP numerics is a
+        pinned invariant, so placement does not change the trajectory."""
+        import hashlib
+        import json
+
+        fit_params = {
+            k: v
+            for k, v in (self.getOrDefault(self.fitParams) or {}).items()
+            if k != "epochs"
+        }
+        init_vars = self.getOrDefault(self.initialVariables)
+        if init_vars is None:
+            vars_digest = "init"
+        else:
+            leaves = jax.tree_util.tree_leaves_with_path(init_vars)
+            vars_digest = hashlib.sha256(
+                json.dumps(
+                    [
+                        (
+                            jax.tree_util.keystr(k),
+                            list(np.shape(v)),
+                            float(np.asarray(v, np.float64).sum()),
+                        )
+                        for k, v in leaves
+                    ],
+                    sort_keys=True,
+                ).encode()
+            ).hexdigest()[:16]
+        payload = json.dumps(
+            {
+                "module": repr(self.getOrDefault(self.module)),
+                "optimizer": repr(self.getOrDefault(self.optimizer)),
+                "loss": repr(self.getOrDefault(self.loss)),
+                "fitParams": sorted(
+                    (str(k), repr(v)) for k, v in fit_params.items()
+                ),
+                "initialVariables": vars_digest,
+                "labelCol": self.getLabelCol(),
+                "inputCol": self.getInputCol(),
+            },
+            sort_keys=True,
+        )
+        return "fit_" + hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+    @staticmethod
+    def _make_checkpointer():
+        return checkpointing.make_async_checkpointer()
+
+    def _maybe_restore(self, ckpt_dir: str, namespace: str, state,
+                       max_epoch: int):
+        epochs = checkpointing.committed_epochs(
+            ckpt_dir, namespace, max_epoch=max_epoch
+        )
+        if not epochs:
+            return 0, state
+        latest = epochs[-1]
+
+        payload = self._ckpt_payload(state)
+        template = jax.tree_util.tree_map(np.asarray, payload)
+        restored = checkpointing.restore_epoch(
+            ckpt_dir, namespace, latest, template
+        )
+        # GSPMD (TP) leaves are re-placed onto the fresh state's
+        # NamedShardings; everything else goes back to HOST arrays — a
+        # single-device-committed restore would be rejected against the
+        # mesh-sharded batch (the same trap KerasImageFileEstimator
+        # documents), while plain numpy lets the shard_map step place it
+        from jax.sharding import NamedSharding as _NS
+
+        def _place(tmpl, arr):
+            if hasattr(tmpl, "sharding") and isinstance(tmpl.sharding, _NS):
+                return jax.device_put(jnp.asarray(arr), tmpl.sharding)
+            return np.asarray(arr)
+
+        placed = jax.tree_util.tree_map(_place, payload, restored)
+        import dataclasses
+
+        new_state = dataclasses.replace(
+            state,
+            params=placed["params"],
+            opt_state=placed["opt_state"],
+            step=placed["step"],
+            batch_stats=placed.get("batch_stats", state.batch_stats),
+        )
+        logger.info("resuming from checkpoint epoch %d", latest)
+        return latest, new_state

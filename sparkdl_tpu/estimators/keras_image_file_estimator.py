@@ -29,6 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sparkdl_tpu.estimators import checkpointing
 from sparkdl_tpu.estimators.data import (
     StreamingShardLoader,
     collect_host_shard_rows,
@@ -189,11 +190,12 @@ class KerasImageFileEstimator(
         )
 
         ckpt_dir = self.getOrDefault(self.checkpointDir)
+        namespace = self._ckpt_namespace() if ckpt_dir else None
         # restore the latest committed epoch <= the requested stopping point:
         # fit(epochs=2) after a completed fit(epochs=4) returns the exact
         # 2-epoch weights (epoch_2 is on disk), not the later ones
         start_epoch, state = self._maybe_restore(
-            ckpt_dir, state, max_epoch=epochs
+            ckpt_dir, namespace, state, max_epoch=epochs
         )
         if start_epoch >= epochs and start_epoch > 0:
             logger.info(
@@ -278,7 +280,10 @@ class KerasImageFileEstimator(
                     # internal sync.  The save is async (SURVEY.md §5.4):
                     # arrays are snapshotted to host synchronously, disk
                     # commit happens behind the next epoch's steps
-                    self._save_checkpoint(ckptr, ckpt_dir, epoch + 1, state)
+                    checkpointing.save_epoch(
+                        ckptr, ckpt_dir, namespace, epoch + 1,
+                        self._ckpt_payload(state),
+                    )
         finally:
             if ckptr is not None:
                 # the final epoch's write must commit before fit returns
@@ -352,57 +357,17 @@ class KerasImageFileEstimator(
 
     @staticmethod
     def _make_checkpointer():
-        """Async orbax checkpointer (SURVEY.md §5.4 "async, multi-host"):
-        ``save`` snapshots device arrays to host memory synchronously —
-        safe against the train loop donating the state buffers on the next
-        step — and commits to disk on a background thread, so save latency
-        hides behind the following epoch instead of blocking the step
-        loop."""
-        import orbax.checkpoint as ocp
-
-        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
-
-    def _save_checkpoint(self, ckptr, ckpt_dir: str, epoch: int, state):
-        import orbax.checkpoint as ocp
-
-        path = os.path.join(
-            os.path.abspath(ckpt_dir), self._ckpt_namespace(), f"epoch_{epoch}"
-        )
-        ckptr.save(
-            path,
-            args=ocp.args.StandardSave(self._ckpt_payload(state)),
-            force=True,
-        )
+        return checkpointing.make_async_checkpointer()
 
     def _maybe_restore(
-        self, ckpt_dir: Optional[str], state, max_epoch: Optional[int] = None
+        self, ckpt_dir: Optional[str], namespace: Optional[str], state,
+        max_epoch: Optional[int] = None,
     ):
         if not ckpt_dir:
             return 0, state
-        root = os.path.join(os.path.abspath(ckpt_dir), self._ckpt_namespace())
-        if not os.path.isdir(root):
-            return 0, state
-        import orbax.checkpoint as ocp
-
-        def committed(epoch: int) -> bool:
-            # a SIGKILL mid-save leaves an uncommitted directory; orbax
-            # marks finalized checkpoints — never resume from a partial one
-            path = os.path.join(root, f"epoch_{epoch}")
-            try:
-                return ocp.utils.is_checkpoint_finalized(path)
-            except (AttributeError, ValueError):
-                return os.path.isdir(path)
-
-        epochs = sorted(
-            int(d.split("_")[1])
-            for d in os.listdir(root)
-            if d.startswith("epoch_") and d.split("_")[1].isdigit()
+        epochs = checkpointing.committed_epochs(
+            ckpt_dir, namespace, max_epoch=max_epoch
         )
-        if max_epoch is not None:
-            # never resume past the requested stopping point — a shorter
-            # re-fit must reproduce the short run, not return later weights
-            epochs = [e for e in epochs if e <= max_epoch]
-        epochs = [e for e in epochs if committed(e)]
         latest = epochs[-1] if epochs else 0
         if runner.is_distributed():
             # every process must resume from the same epoch or the hosts
@@ -424,11 +389,9 @@ class KerasImageFileEstimator(
         if not epochs:
             return 0, state
 
-        with ocp.StandardCheckpointer() as ckptr:
-            restored = ckptr.restore(
-                os.path.join(root, f"epoch_{latest}"),
-                self._ckpt_payload(state),
-            )
+        restored = checkpointing.restore_epoch(
+            ckpt_dir, namespace, latest, self._ckpt_payload(state)
+        )
         # back to host arrays: orbax restores arrays committed to device 0,
         # which a step over a multi-device mesh would reject as incompatible
         # with the sharded batch (caught by tests/test_fault_injection.py)
