@@ -11,6 +11,14 @@ Methodology matches ``bench_finetune.py``: K donated-state-chained steps,
 final loss fetched, wall/K.  ``vs_baseline`` is null — the reference has no
 ViT at all (SURVEY.md §2: the zoo is CNN-only), so there is no number to
 beat; this row exists to fill BASELINE.json config #5.
+
+Weights: the bench uses constant-filled parameters because step time is
+weight-VALUE-invariant (same flops, same layouts); the actual pretrained
+path — google-research ``.npz`` / HF torch ingestion + pos-embed/head
+adaptation — is ``sparkdl_tpu/models/vit_port.py``, exercised end-to-end
+by ``examples/distributed_finetune.py`` and oracle-tested in
+``tests/test_vit_port.py``, and plugs into this same engine via
+``FlaxImageFileEstimator(initialVariables=...)``.
 """
 
 import json

@@ -30,7 +30,7 @@ from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
     load_keras_function,
     place_params,
-    run_batched,
+    run_batched_rows,
 )
 from sparkdl_tpu.image import imageIO
 
@@ -105,20 +105,32 @@ class KerasImageFileTransformer(
                 return out
             from sparkdl_tpu.utils.metrics import metrics
 
-            with metrics.timer("sparkdl.load").time():
-                arrays = [
-                    np.asarray(loader(u), dtype=np.float32) for u in uris
-                ]
-            metrics.counter("sparkdl.images_processed").add(len(arrays))
-            shapes = {a.shape for a in arrays}
-            if len(shapes) > 1:
-                raise ValueError(
-                    "imageLoader must produce one fixed array shape per "
-                    f"image; this partition mixes {sorted(shapes)} — resize "
-                    "inside the loader"
-                )
-            batch = np.stack(arrays)
-            result = run_batched(jitted, batch, batch_size)
+            # loader + forward run pipelined (run_batched_rows): chunk
+            # i+1 loads on a prefetch thread while chunk i is on device.
+            # The one-fixed-shape loader contract binds across chunks, so
+            # a chunk-aligned shape change still gets the contract error.
+            expected_shape = [None]
+
+            def decode(chunk):
+                with metrics.timer("sparkdl.load").time():
+                    arrays = [
+                        np.asarray(loader(u), dtype=np.float32)
+                        for u in chunk
+                    ]
+                metrics.counter("sparkdl.images_processed").add(len(arrays))
+                shapes = {a.shape for a in arrays}
+                if expected_shape[0] is not None:
+                    shapes.add(expected_shape[0])
+                if len(shapes) > 1:
+                    raise ValueError(
+                        "imageLoader must produce one fixed array shape "
+                        f"per image; this partition mixes {sorted(shapes)}"
+                        " — resize inside the loader"
+                    )
+                expected_shape[0] = arrays[0].shape
+                return np.stack(arrays)
+
+            result = run_batched_rows(jitted, uris, decode, batch_size)
             if mode == "vector":
                 flat = result.reshape(result.shape[0], -1).astype(np.float64)
                 out[output_col] = [DenseVector(v) for v in flat]

@@ -35,9 +35,9 @@ from sparkdl_tpu.sql.types import Row
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
     cast_and_resize_on_device,
-    decode_image_batch,
+    make_image_decode_plan,
     place_params,
-    run_batched,
+    run_batched_rows,
 )
 
 logger = logging.getLogger(__name__)
@@ -234,11 +234,13 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
             # uniform-size partitions pack at source size — as uint8 when
             # the rows allow (cast, resize, preprocess and CNN fuse into
             # the one jitted forward program); mixed-size partitions
-            # resize-while-packing (native bridge when available)
-            batch = decode_image_batch(
-                rows, 3, (height, width), prefer_uint8=True
-            )
-            result = run_batched(forward, batch, batch_size)
+            # resize-while-packing (native bridge when available).
+            # Decode and forward run pipelined (run_batched_rows): chunk
+            # i+1 decodes on a prefetch thread and dispatches before chunk
+            # i's fetch.  The decode plan (shape + dtype) is decided over
+            # the whole partition so exactly one program compiles.
+            decode = make_image_decode_plan(rows, 3, (height, width))
+            result = run_batched_rows(forward, rows, decode, batch_size)
             out[output_col] = self._postprocess(result)
             return out
 

@@ -33,9 +33,9 @@ from sparkdl_tpu.param.shared import (
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
     cast_and_resize_on_device,
-    decode_image_batch,
+    make_image_decode_plan,
     place_params,
-    run_batched,
+    run_batched_rows,
 )
 
 
@@ -151,10 +151,12 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol, HasOutputMode):
                 out[output_col] = []
                 return out
             n_channels = 1 if order == "L" else 3
-            batch = decode_image_batch(
-                rows, n_channels, size, prefer_uint8=True
-            )
-            result = run_batched(jitted, batch, batch_size)
+            # pipelined decode/dispatch (run_batched_rows); the decode plan
+            # (shape + dtype) is decided over the whole partition so one
+            # program compiles (raises MixedImageSizesError when sizes mix
+            # and no input size is set)
+            decode = make_image_decode_plan(rows, n_channels, size)
+            result = run_batched_rows(jitted, rows, decode, batch_size)
             out = dict(part)
             if mode == "vector":
                 flat = result.reshape(result.shape[0], -1).astype(np.float64)

@@ -316,6 +316,56 @@ def decode_image_batch(
     return device_resize(images, out_hw)
 
 
+#: image-struct modes whose pixel data is uint8 (CV_8UC1/3/4) — the only
+#: modes the uint8 fast path may ship un-decoded
+_U8_MODES = frozenset({0, 16, 24})
+
+
+def make_image_decode_plan(
+    rows: Sequence,
+    n_channels: int,
+    size: Optional[Tuple[int, int]],
+    to_rgb: bool = False,
+) -> Callable[[Sequence], np.ndarray]:
+    """One whole-partition decode policy for the chunked serving pipeline.
+
+    The policy — (a) pack at source size vs resize-while-packing and
+    (b) uint8 fast path vs float32 — must be decided over ALL rows, not
+    per chunk: a chunk-local decision could alternate (mixed sizes where
+    one chunk is incidentally uniform; uniform sizes where only some
+    chunks' OpenCV modes are uint8), feeding two dtypes/shapes — two XLA
+    programs — to the one jitted forward.
+
+    Returns a ``decode(chunk) -> np.ndarray`` closure for
+    :func:`run_batched_rows`.  Raises :class:`MixedImageSizesError` when
+    the partition mixes sizes and ``size`` is None.
+    """
+    hws = {(int(r["height"]), int(r["width"])) for r in rows}
+    uniform = len(hws) == 1
+    if not uniform and size is None:
+        raise MixedImageSizesError(
+            f"partition mixes image sizes {sorted(hws)} and no target size "
+            "is configured; resize upstream or set an input size"
+        )
+    prefer_u8 = (
+        uniform
+        and n_channels in (1, 3)
+        and all(int(r["mode"]) in _U8_MODES for r in rows)
+    )
+
+    def decode(chunk):
+        return decode_image_batch(
+            chunk,
+            n_channels,
+            size,
+            to_rgb=to_rgb,
+            prefer_uint8=prefer_u8,
+            always_resize=not uniform,
+        )
+
+    return decode
+
+
 def cast_and_resize_on_device(x, size: Optional[Tuple[int, int]] = None):
     """The device half of :func:`decode_image_batch`'s uint8 contract — to
     be called at the top of a jitted forward: cast (uint8 ingest) and

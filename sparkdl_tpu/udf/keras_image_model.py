@@ -37,9 +37,10 @@ from sparkdl_tpu.ml.linalg import DenseVector
 from sparkdl_tpu.sql.functions import UserDefinedFunction
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
+    MixedImageSizesError,
     cast_and_resize_on_device,
-    decode_image_batch,
     load_keras_function,
+    make_image_decode_plan,
     place_params,
     run_batched_rows,
 )
@@ -128,30 +129,17 @@ def registerKerasImageUDF(
                 return np.stack(arrays)
 
         else:
-            # shape-uniformity is decided over the WHOLE partition so
-            # exactly one batch shape compiles (per-chunk decisions could
-            # alternate between source-size and resized programs)
-            hws = {(int(r["height"]), int(r["width"])) for r in values}
-            uniform = len(hws) == 1
-            if not uniform and size is None:
+            # stored BGR -> model RGB while packing; the decode plan
+            # (shape + dtype) is decided over the WHOLE partition so
+            # exactly one program compiles
+            try:
+                decode = make_image_decode_plan(values, 3, size, to_rgb=True)
+            except MixedImageSizesError as e:
                 raise ValueError(
                     f"UDF {udfName!r}: model input size is dynamic and "
                     "the column holds mixed shapes; resize in a "
                     "preprocessor or use a fixed-input-size model"
-                )
-
-            def decode(chunk):
-                # stored BGR -> model RGB while packing; uniform partitions
-                # pack at source size (uint8 when possible — the forward
-                # resizes on device); mixed shapes resize-while-packing
-                return decode_image_batch(
-                    chunk,
-                    3,
-                    size,
-                    to_rgb=True,
-                    prefer_uint8=True,
-                    always_resize=not uniform,
-                )
+                ) from e
 
         result = run_batched_rows(forward, values, decode, batchSize)
         flat = result.reshape(result.shape[0], -1).astype(np.float64)

@@ -326,3 +326,28 @@ class TestServingPipeline:
         )
         with pytest.raises(ValueError, match="one fixed shape"):
             df.select(udf("path")).collect()
+
+    def test_mode_mixed_partition_one_dtype(self, tpu_session, keras_model_file,
+                                            keras_model):
+        """Uniform-size partition mixing uint8 and float32 OpenCV modes:
+        the whole-partition decode plan must feed ONE dtype to the forward
+        (a chunk-local uint8 decision would compile two programs), and the
+        output must equal the oracle."""
+        rng = np.random.RandomState(11)
+        rows = []
+        for i in range(6):
+            arr = (rng.rand(INPUT_SIZE, INPUT_SIZE, 3) * 255)
+            if i < 3:  # uint8 modes first (chunk-aligned with batchSize=3)
+                rows.append(imageIO.imageArrayToStruct(arr.astype(np.uint8)))
+            else:  # float32 mode
+                rows.append(imageIO.imageArrayToStruct(arr.astype(np.float32)))
+        df = tpu_session.createDataFrame([{"image": r} for r in rows],
+                                         numPartitions=1)
+        from sparkdl_tpu.udf.keras_image_model import registerKerasImageUDF
+
+        udf = registerKerasImageUDF("modemix_udf", keras_model_file,
+                                    batchSize=3)
+        got = df.select(udf("image").alias("f")).collect()
+        out = np.stack([np.asarray(r.f.toArray()) for r in got])
+        want = _oracle(keras_model, [{"image": r} for r in rows])
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
