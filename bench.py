@@ -5,11 +5,14 @@ device program (uint8 decode -> BGR flip -> preprocess -> InceptionV3 ->
 2048-d features, bf16 compute) — the hot loop of the reference's
 ``DeepImageFeaturizer.transform`` (SURVEY.md §3.1) rebuilt for TPU.
 
-Methodology: K model applications run inside one jitted ``lax.scan`` over
-distinct pre-staged batches, returning a scalar reduction fetched to host.
-This amortizes the PJRT-tunnel round trip (~200ms through the loopback
-relay, which also acks dispatch before completion — ``block_until_ready``
-alone under-measures) and forces real execution of every batch.
+Methodology (shared harness — ``sparkdl_tpu.utils.benchlib``): K model
+applications inside one jitted ``lax.scan`` over distinct pre-staged
+batches, scalar reduction fetched to host.  This amortizes the PJRT-tunnel
+round trip (~200ms through the loopback relay, which also acks dispatch
+before completion — ``block_until_ready`` alone under-measures) and forces
+real execution of every batch.  The MFU field uses an empirical probe of
+cost_analysis's While-body counting convention (benchlib), not a
+plausibility guess.
 
 Baseline (``BASELINE.md``): the reference publishes no numbers; the
 driver-defined target is ">= V100 images/sec/chip".  ``V100_IMAGES_PER_SEC``
@@ -22,12 +25,6 @@ Prints exactly one JSON line:
 
 import json
 import sys
-import time
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
 
 V100_IMAGES_PER_SEC = 1000.0
 BATCH = 512
@@ -38,92 +35,21 @@ REPEATS = 3
 
 
 def main():
-    from sparkdl_tpu.models import get_keras_application_model
+    from sparkdl_tpu.utils.benchlib import measure_featurizer
 
-    entry = get_keras_application_model("InceptionV3")
-    module = entry.make_module(dtype=jnp.bfloat16)
-    shapes = jax.eval_shape(
-        module.init, jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3),
-                                                      jnp.float32)
-    )
-    # deterministic nonzero weights; values don't change the FLOP rate
-    variables = jax.tree_util.tree_map(
-        lambda l: jnp.full(l.shape, 0.01, l.dtype), shapes
-    )
-    # fold the BGR flip into the stem conv (what DeepImageFeaturizer's
-    # forward does for "tf"-mode models — drops a pure-bandwidth rev op)
-    from sparkdl_tpu.models.registry import fold_bgr_flip_into_stem
-
-    folded = fold_bgr_flip_into_stem(variables)
-    flip_in_program = folded is None
-    if folded is not None:
-        variables = folded
-    device = jax.devices()[0]
-    variables = jax.device_put(variables, device)
-
-    rng = np.random.RandomState(0)
-    stack = jax.device_put(
-        jnp.asarray(
-            (rng.rand(SCAN_LEN, BATCH, 299, 299, 3) * 255).astype(np.uint8)
-        ),
-        device,
-    )
-
-    def forward(v, x):
-        if flip_in_program:
-            x = x[..., ::-1]  # stored BGR -> RGB
-        x = entry.preprocess(x.astype(jnp.bfloat16))
-        return module.apply(
-            v, x.astype(jnp.bfloat16), features_only=True
-        ).astype(jnp.float32)
-
-    def run_many(v, stack):
-        def body(carry, xb):
-            return carry + forward(v, xb).sum(), None
-
-        acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), stack)
-        return acc
-
-    compiled = jax.jit(run_many).lower(variables, stack).compile()
-    np.asarray(compiled(variables, stack))  # warm
-    times = []
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        np.asarray(compiled(variables, stack))  # host fetch forces completion
-        times.append(time.perf_counter() - t0)
-
-    images_per_sec = SCAN_LEN * BATCH / min(times)
-
-    # MFU: XLA's analytic FLOP count over the best wall time, as a fraction
-    # of the chip's peak bf16 rate (VERDICT r2 #9 — regressions become
-    # visible numerically).  cost_analysis's treatment of a While (scan)
-    # body is XLA-version-dependent — counted once (current stack;
-    # verified against a single-batch compile) or trip-count times — so
-    # normalize by picking the interpretation that yields the largest
-    # physically possible (<= 1.0) MFU: at this program's ~0.37 the wrong
-    # reading is 12x off and lands > 1, so the choice is unambiguous.
-    from sparkdl_tpu.utils.metrics import compiled_flops, mfu
-
-    flops = compiled_flops(compiled)
-    mfu_frac = None
-    if flops:
-        candidates = [
-            mfu(flops * SCAN_LEN, min(times), device),  # body counted once
-            mfu(flops, min(times), device),  # body counted x trip-count
-        ]
-        mfu_frac = next(
-            (c for c in candidates if c is not None and c <= 1.0), None
-        )
-
+    out = measure_featurizer("InceptionV3", BATCH, SCAN_LEN, REPEATS)
     print(
         json.dumps(
             {
                 "metric": "DeepImageFeaturizer(InceptionV3) bf16 batch "
                 "inference throughput",
-                "value": round(images_per_sec, 1),
+                "value": round(out["images_per_sec"], 1),
                 "unit": "images/sec/chip",
-                "vs_baseline": round(images_per_sec / V100_IMAGES_PER_SEC, 3),
-                "mfu": round(mfu_frac, 4) if mfu_frac is not None else None,
+                "vs_baseline": round(
+                    out["images_per_sec"] / V100_IMAGES_PER_SEC, 3
+                ),
+                "mfu": round(out["mfu"], 4) if out["mfu"] is not None
+                else None,
             }
         )
     )

@@ -1,0 +1,54 @@
+"""Model-zoo breadth benchmark: the BASELINE.md zoo table, reproducibly.
+
+Measures every registry model through the same fused uint8->preprocess->CNN
+program and scan-amortized methodology as ``bench.py`` (one shared harness:
+``sparkdl_tpu.utils.benchlib.measure_featurizer``), printing one JSON line
+per model with images/sec/chip and MFU.
+
+    python benchmarks/bench_zoo.py [--batch 512] [--scan 6] [Model ...]
+
+Defaults to the full registry.  ``--scan 6`` (vs the headline's 12) keeps
+total stage+run time reasonable across 6 models; the shallower scan leaves
+~5% fetch overhead, so these are mildly conservative numbers.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+
+def main():
+    from sparkdl_tpu.models.registry import SUPPORTED_MODELS
+    from sparkdl_tpu.utils.benchlib import measure_featurizer
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("models", nargs="*", default=None)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--scan", type=int, default=6)
+    args = ap.parse_args()
+    names = args.models or sorted(SUPPORTED_MODELS)
+    for name in names:
+        out = measure_featurizer(name, args.batch, args.scan)
+        h, w = out["input_hw"]
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name} bf16 featurize throughput",
+                    "value": round(out["images_per_sec"], 1),
+                    "unit": "images/sec/chip",
+                    "input": f"{h}x{w}",
+                    "mfu": round(out["mfu"], 4)
+                    if out["mfu"] is not None
+                    else None,
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -594,6 +594,70 @@ class NativeProgram:
                 self.runner.free(bid)
         return outs
 
+    def stream(self, batches):
+        """Double-buffered batch streaming (generator): batch i+1's
+        host->device transfer and execute are ENQUEUED (put_async /
+        execute_async) before batch i's outputs are fetched, so transfer
+        and compute of consecutive batches overlap — the in-process
+        analog of pjrt_tool's pipelined loop.  Yields one output list per
+        input batch, in order.  ``batches`` yields a single array (or a
+        tuple for multi-input programs) per step."""
+        specs = self.manifest["inputs"]
+        out_specs = self.manifest["outputs"]
+        pending = None  # (input_ids, out_ids)
+
+        def fetch(entry):
+            input_ids, out_ids = entry
+            try:
+                return [
+                    self.runner.fetch(oid, spec["shape"], spec["dtype"])
+                    for oid, spec in zip(out_ids, out_specs)
+                ]
+            finally:
+                for bid in input_ids + out_ids:
+                    self.runner.free(bid)
+
+        try:
+            for inputs in batches:
+                if not isinstance(inputs, (tuple, list)):
+                    inputs = (inputs,)
+                if len(inputs) != len(specs):
+                    raise ValueError(
+                        f"program takes {len(specs)} inputs, got "
+                        f"{len(inputs)}"
+                    )
+                input_ids = []
+                try:
+                    for x, spec in zip(inputs, specs):
+                        arr = np.ascontiguousarray(
+                            x, dtype=_np_dtype(spec["dtype"])
+                        )
+                        if list(arr.shape) != spec["shape"]:
+                            raise ValueError(
+                                f"input {spec['name']} expects shape "
+                                f"{spec['shape']}, got {list(arr.shape)}"
+                            )
+                        input_ids.append(self.runner.put_async(arr))
+                    out_ids = self.runner.execute_async(
+                        self.exec_id, self.param_ids + input_ids
+                    )
+                except BaseException:
+                    # free THIS batch's already-placed inputs; `pending`
+                    # (the previous batch) is freed by the outer finally
+                    for bid in input_ids:
+                        self.runner.free(bid)
+                    raise
+                prev, pending = pending, (input_ids, out_ids)
+                if prev is not None:
+                    yield fetch(prev)
+            if pending is not None:
+                prev, pending = pending, None
+                yield fetch(prev)
+        finally:
+            if pending is not None:  # consumer abandoned the generator
+                for bid in pending[0] + pending[1]:
+                    self.runner.free(bid)
+
     def close(self):
         self.runner.close()
 

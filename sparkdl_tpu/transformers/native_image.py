@@ -215,21 +215,29 @@ class NativeDeepImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             # Not run_batched: that engine stages chunks onto the *jax*
             # device, which here would round-trip every batch through the
             # jax client before the native client ships it again.  Same
-            # chunk/pad/slice policy and the same metrics counters though.
+            # chunk/pad/slice policy and the same metrics counters though;
+            # batches stream double-buffered (NativeProgram.stream: batch
+            # i+1's transfer+execute enqueue before batch i's fetch).
             from sparkdl_tpu.utils.metrics import metrics
 
             n = x.shape[0]
-            feats = []
-            with metrics.timer("sparkdl.forward").time():
+
+            def chunks():
                 for lo in range(0, n, batch):
                     chunk = x[lo:lo + batch]
-                    k = chunk.shape[0]
-                    if k < batch:  # static shapes: pad the ragged tail
+                    if chunk.shape[0] < batch:  # pad the ragged tail
                         chunk = np.concatenate(
                             [chunk,
-                             np.repeat(chunk[-1:], batch - k, axis=0)]
+                             np.repeat(chunk[-1:],
+                                       batch - chunk.shape[0], axis=0)]
                         )
-                    feats.append(np.asarray(prog(chunk)[0])[:k])
+                    yield chunk
+
+            feats = []
+            with metrics.timer("sparkdl.forward").time():
+                for i, outs in enumerate(prog.stream(chunks())):
+                    k = min(batch, n - i * batch)
+                    feats.append(np.asarray(outs[0])[:k])
             metrics.counter("sparkdl.rows_processed").add(n)
             metrics.counter("sparkdl.batches_run").add(-(-n // batch))
             flat = np.concatenate(feats).astype(np.float64)

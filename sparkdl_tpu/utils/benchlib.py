@@ -1,0 +1,133 @@
+"""Shared featurizer-benchmark harness (bench.py + benchmarks/bench_zoo.py).
+
+One implementation of the measurement methodology so the headline and the
+zoo numbers cannot drift: the fused uint8 -> BGR-fold/flip -> preprocess ->
+CNN forward, K applications inside one jitted ``lax.scan`` over distinct
+pre-staged batches with a scalar fetch (the only stable methodology through
+the loopback relay — per-call timing is wrong in both directions; see
+BASELINE.md measurement notes), plus MFU from XLA's cost analysis.
+
+The While-body FLOP-counting convention (cost_analysis may count a scan
+body once or trip-count times depending on XLA version) is determined
+empirically ONCE per process by a tiny known-FLOPs scan probe — a
+guess-by-plausibility heuristic would silently mis-scale models whose true
+MFU is below 1/scan.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sparkdl_tpu.utils.metrics import compiled_flops, mfu
+
+_SCAN_COUNTS_BODY_ONCE: Optional[bool] = None
+
+
+def scan_body_counted_once() -> Optional[bool]:
+    """True when ``cost_analysis`` on a compiled ``lax.scan`` program counts
+    the body's FLOPs once, False when it multiplies by trip count, None
+    when the backend exposes no cost analysis.  Probed once per process
+    with a known-FLOPs matmul scan (length 8, 128³: one body = 4.2 MFLOP,
+    trip-multiplied = 33.6 MFLOP — unambiguous either way)."""
+    global _SCAN_COUNTS_BODY_ONCE
+    if _SCAN_COUNTS_BODY_ONCE is not None:
+        return _SCAN_COUNTS_BODY_ONCE
+    length = 8
+    body_flops = 2 * 128**3
+
+    def run(c, w):
+        def body(carry, _):
+            return (carry @ w).astype(carry.dtype), None
+
+        out, _ = jax.lax.scan(body, c, None, length=length)
+        return out.sum()
+
+    c = jnp.zeros((128, 128), jnp.float32)
+    flops = compiled_flops(jax.jit(run).lower(c, c).compile())
+    if not flops:
+        return None
+    # attribute non-body overhead (the sum) generously; the two readings
+    # differ 8x so a 2x threshold cannot misclassify
+    _SCAN_COUNTS_BODY_ONCE = flops < 2 * body_flops
+    return _SCAN_COUNTS_BODY_ONCE
+
+
+def measure_featurizer(
+    model_name: str, batch: int, scan: int, repeats: int = 3
+) -> dict:
+    """Sustained on-chip throughput + MFU of ``model_name``'s fused
+    featurize program.  Returns ``{images_per_sec, mfu, input_hw}``."""
+    from sparkdl_tpu.models import get_keras_application_model
+    from sparkdl_tpu.models.registry import fold_bgr_flip_into_stem
+
+    entry = get_keras_application_model(model_name)
+    module = entry.make_module(dtype=jnp.bfloat16)
+    h, w = entry.input_size
+    shapes = jax.eval_shape(
+        module.init, jax.random.PRNGKey(0),
+        jnp.zeros((1, h, w, 3), jnp.float32),
+    )
+    # deterministic nonzero weights; values don't change the FLOP rate
+    variables = jax.tree_util.tree_map(
+        lambda l: jnp.full(l.shape, 0.01, l.dtype), shapes
+    )
+    # fold the BGR flip into the stem conv where preprocessing is
+    # channel-symmetric (drops a pure-bandwidth rev op)
+    folded = None
+    if entry.preprocess_mode == "tf":
+        folded = fold_bgr_flip_into_stem(variables)
+    flip_in_program = folded is None
+    if folded is not None:
+        variables = folded
+    device = jax.devices()[0]
+    variables = jax.device_put(variables, device)
+
+    rng = np.random.RandomState(0)
+    stack = jax.device_put(
+        jnp.asarray((rng.rand(scan, batch, h, w, 3) * 255).astype(np.uint8)),
+        device,
+    )
+
+    def forward(v, x):
+        if flip_in_program:
+            x = x[..., ::-1]  # stored BGR -> RGB
+        x = entry.preprocess(x.astype(jnp.bfloat16))
+        return module.apply(
+            v, x.astype(jnp.bfloat16), features_only=True
+        ).astype(jnp.float32)
+
+    def run_many(v, stack):
+        def body(carry, xb):
+            return carry + forward(v, xb).sum(), None
+
+        acc, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), stack)
+        return acc
+
+    compiled = jax.jit(run_many).lower(variables, stack).compile()
+    np.asarray(compiled(variables, stack))  # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(compiled(variables, stack))  # host fetch forces the chain
+        times.append(time.perf_counter() - t0)
+
+    images_per_sec = scan * batch / min(times)
+
+    flops = compiled_flops(compiled)
+    mfu_frac = None
+    if flops:
+        once = scan_body_counted_once()
+        if once is not None:
+            per_call = flops * scan if once else flops
+            mfu_frac = mfu(per_call, min(times), device)
+    return {
+        "images_per_sec": images_per_sec,
+        "mfu": mfu_frac,
+        "input_hw": (h, w),
+    }
