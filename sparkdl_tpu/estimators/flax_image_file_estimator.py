@@ -49,8 +49,9 @@ from sparkdl_tpu.parallel.trainer import (
 )
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
+    make_loader_decode_plan,
     place_params,
-    run_batched,
+    run_batched_rows,
 )
 
 logger = logging.getLogger(__name__)
@@ -125,7 +126,12 @@ class FlaxImageFileTransformer(
             variables = place_params(self.variables)
 
             def forward(x):
-                return module.apply(variables, x, features_only=feats)
+                out = module.apply(variables, x, features_only=feats)
+                if isinstance(out, (tuple, list)):
+                    # first-output semantics for multi-output modules
+                    # (what the pre-pipeline run_batched engine returned)
+                    out = out[0]
+                return out
 
             self._jitted = jax.jit(forward)
         return self._jitted
@@ -142,10 +148,12 @@ class FlaxImageFileTransformer(
             if not uris:
                 out[output_col] = []
                 return out
-            batch = np.stack(
-                [np.asarray(loader(u), dtype=np.float32) for u in uris]
-            )
-            result = run_batched(fn, batch, self.batchSize)
+
+            # loader + forward pipelined (run_batched_rows), same contract
+            # as KerasImageFileTransformer: one fixed loader shape bound
+            # across chunks
+            decode = make_loader_decode_plan(loader)
+            result = run_batched_rows(fn, uris, decode, self.batchSize)
             flat = result.reshape(result.shape[0], -1).astype(np.float64)
             out[output_col] = [DenseVector(v) for v in flat]
             return out

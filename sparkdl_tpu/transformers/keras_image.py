@@ -29,6 +29,7 @@ from sparkdl_tpu.param.shared import (
 from sparkdl_tpu.transformers.utils import (
     DEFAULT_BATCH_SIZE,
     load_keras_function,
+    make_loader_decode_plan,
     place_params,
     run_batched_rows,
 )
@@ -103,33 +104,10 @@ class KerasImageFileTransformer(
             if not uris:
                 out[output_col] = []
                 return out
-            from sparkdl_tpu.utils.metrics import metrics
-
             # loader + forward run pipelined (run_batched_rows): chunk
-            # i+1 loads on a prefetch thread while chunk i is on device.
-            # The one-fixed-shape loader contract binds across chunks, so
-            # a chunk-aligned shape change still gets the contract error.
-            expected_shape = [None]
-
-            def decode(chunk):
-                with metrics.timer("sparkdl.load").time():
-                    arrays = [
-                        np.asarray(loader(u), dtype=np.float32)
-                        for u in chunk
-                    ]
-                metrics.counter("sparkdl.images_processed").add(len(arrays))
-                shapes = {a.shape for a in arrays}
-                if expected_shape[0] is not None:
-                    shapes.add(expected_shape[0])
-                if len(shapes) > 1:
-                    raise ValueError(
-                        "imageLoader must produce one fixed array shape "
-                        f"per image; this partition mixes {sorted(shapes)}"
-                        " — resize inside the loader"
-                    )
-                expected_shape[0] = arrays[0].shape
-                return np.stack(arrays)
-
+            # i+1 loads on a prefetch thread while chunk i is on device;
+            # the one-fixed-shape loader contract binds across chunks
+            decode = make_loader_decode_plan(loader)
             result = run_batched_rows(jitted, uris, decode, batch_size)
             if mode == "vector":
                 flat = result.reshape(result.shape[0], -1).astype(np.float64)

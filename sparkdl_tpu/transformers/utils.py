@@ -321,6 +321,42 @@ def decode_image_batch(
 _U8_MODES = frozenset({0, 16, 24})
 
 
+def make_loader_decode_plan(
+    load_one: Callable, what: str = "imageLoader"
+) -> Callable[[Sequence], np.ndarray]:
+    """Chunked decode plan for user-loader inputs (``load_one(uri) ->
+    ndarray``), for :func:`run_batched_rows`.
+
+    Enforces the one-fixed-shape loader contract ACROSS chunks (the first
+    chunk's shape binds the partition), so a chunk-aligned shape change
+    still raises the contract error instead of a raw concatenate failure.
+    Advances the ``sparkdl.load`` timer and the images counter.
+    """
+    from sparkdl_tpu.utils.metrics import metrics
+
+    expected_shape: List[Optional[Tuple[int, ...]]] = [None]
+
+    def decode(chunk):
+        with metrics.timer("sparkdl.load").time():
+            arrays = [
+                np.asarray(load_one(v), dtype=np.float32) for v in chunk
+            ]
+        metrics.counter("sparkdl.images_processed").add(len(arrays))
+        shapes = {a.shape for a in arrays}
+        if expected_shape[0] is not None:
+            shapes.add(expected_shape[0])
+        if len(shapes) > 1:
+            raise ValueError(
+                f"{what} must produce one fixed array shape per image; "
+                f"this partition mixes {sorted(shapes)} — resize inside "
+                f"the {what}"
+            )
+        expected_shape[0] = arrays[0].shape
+        return np.stack(arrays)
+
+    return decode
+
+
 def make_image_decode_plan(
     rows: Sequence,
     n_channels: int,
@@ -584,6 +620,12 @@ def run_batched_rows(
         with maybe_trace(), forward_timer.time():
             for batch, k in chunk_iter:
                 result = fn(_place(batch))  # async dispatch
+                if isinstance(result, (tuple, list)):
+                    raise TypeError(
+                        "run_batched_rows requires a single-output fn "
+                        f"(got {len(result)} outputs); unwrap the output "
+                        "in the forward, or use run_batched_multi"
+                    )
                 if pending is not None:
                     r_prev, k_prev = pending
                     collected.append(

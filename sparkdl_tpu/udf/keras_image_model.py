@@ -41,6 +41,7 @@ from sparkdl_tpu.transformers.utils import (
     cast_and_resize_on_device,
     load_keras_function,
     make_image_decode_plan,
+    make_loader_decode_plan,
     place_params,
     run_batched_rows,
 )
@@ -104,30 +105,11 @@ def registerKerasImageUDF(
             return []
         if preprocessor is not None:
             # file-loader mode: the preprocessor owns the whole input
-            # contract — its output is fed to the model unchanged.  The
-            # one-fixed-shape contract is enforced ACROSS chunks too (the
-            # first chunk's shape binds the partition), so a chunk-aligned
-            # shape change still gets the contract error, not a raw
-            # concatenate failure
-            expected_shape = [None]
-
-            def decode(chunk):
-                arrays = [
-                    np.asarray(preprocessor(v), dtype=np.float32)
-                    for v in chunk
-                ]
-                shapes = {a.shape for a in arrays}
-                if expected_shape[0] is not None:
-                    shapes.add(expected_shape[0])
-                if len(shapes) > 1:
-                    raise ValueError(
-                        f"UDF {udfName!r}: preprocessor produced mixed "
-                        f"shapes {sorted(shapes)}; it must emit one fixed "
-                        "shape"
-                    )
-                expected_shape[0] = arrays[0].shape
-                return np.stack(arrays)
-
+            # contract — its output is fed to the model unchanged; one
+            # fixed output shape, enforced across chunk boundaries
+            decode = make_loader_decode_plan(
+                preprocessor, what=f"UDF {udfName!r} preprocessor"
+            )
         else:
             # stored BGR -> model RGB while packing; the decode plan
             # (shape + dtype) is decided over the WHOLE partition so

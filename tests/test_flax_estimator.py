@@ -192,3 +192,32 @@ class TestFlaxCheckpointing:
             initialVariables=vb,
         ).fit(vector_dataset)
         assert len(os.listdir(ck)) == 2  # one namespace per starting point
+
+
+def test_multi_output_module_uses_first_output(vector_dataset):
+    """A flax module returning a tuple keeps the engine's first-output
+    semantics through the pipelined transform path."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class TwoHead(nn.Module):
+        @nn.compact
+        def __call__(self, x, features_only=False):
+            h = x.reshape(x.shape[0], -1)
+            a = nn.Dense(2, name="a")(h)
+            b = nn.Dense(3, name="b")(h)
+            return a, b
+
+    import jax
+
+    module = TwoHead()
+    variables = module.init(
+        jax.random.PRNGKey(0), np.zeros((1, IMG, IMG, 3), np.float32)
+    )
+    t = FlaxImageFileTransformer(
+        inputCol="uri", outputCol="out", imageLoader=_loader,
+        module=module, variables=variables, batchSize=16,
+    )
+    out = t.transform(vector_dataset).collect()
+    assert len(out) == N
+    assert len(out[0]["out"]) == 2  # head "a", not a mangled stack

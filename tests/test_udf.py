@@ -324,7 +324,7 @@ class TestServingPipeline:
         df = tpu_session.createDataFrame(
             [{"path": f"p{i}"} for i in range(4)], numPartitions=1
         )
-        with pytest.raises(ValueError, match="one fixed shape"):
+        with pytest.raises(ValueError, match="one fixed array shape"):
             df.select(udf("path")).collect()
 
     def test_mode_mixed_partition_one_dtype(self, tpu_session, keras_model_file,
