@@ -324,6 +324,17 @@ class DataFrame:
 
     sort = orderBy
 
+    def groupBy(self, *cols: "Column | str") -> "GroupedData":
+        """Group by one or more columns (pyspark ``GroupedData`` subset:
+        ``count/sum/avg/mean/min/max/agg``)."""
+        keys = [c if isinstance(c, str) else c._name for c in cols]
+        for k in keys:
+            if k not in self.columns:
+                raise KeyError(f"No such column: {k!r}")
+        return GroupedData(self, keys)
+
+    groupby = groupBy
+
     def cache(self) -> "DataFrame":
         return self
 
@@ -389,3 +400,150 @@ class DataFrame:
             f"{f.name}: {f.dataType.simpleString()}" for f in self._schema
         )
         return f"DataFrame[{cols}]"
+
+
+#: SQL/GroupedData aggregate functions: name -> (fn(values) -> scalar).
+#: NULLs are excluded before aggregation (SQL semantics); COUNT(*) counts
+#: rows, COUNT(col) counts non-null values.
+_AGG_FNS: Dict[str, Callable[[List[Any]], Any]] = {
+    "count": len,
+    "sum": lambda vs: sum(vs) if vs else None,
+    "avg": lambda vs: (sum(vs) / len(vs)) if vs else None,
+    "min": lambda vs: min(vs) if vs else None,
+    "max": lambda vs: max(vs) if vs else None,
+}
+_AGG_FNS["mean"] = _AGG_FNS["avg"]
+
+
+class GroupedData:
+    """Result of :meth:`DataFrame.groupBy` — the pyspark ``GroupedData``
+    subset the engine needs (count/sum/avg/min/max/agg).  Groups preserve
+    first-appearance order; aggregation collects to the driver (the engine
+    is a local substrate — SURVEY.md §7 — so no shuffle is involved)."""
+
+    def __init__(self, df: DataFrame, keys: List[str]):
+        self._df = df
+        self._keys = keys
+
+    # -- core -----------------------------------------------------------
+    def agg(self, exprs: "Dict[str, str] | None" = None, **kwargs: str
+            ) -> DataFrame:
+        """``agg({"score": "avg", "*": "count"})`` or
+        ``agg(score="avg")``; output columns are named ``fn(col)`` as in
+        pyspark."""
+        spec = dict(exprs or {})
+        spec.update(kwargs)
+        if not spec:
+            raise ValueError("agg requires at least one aggregate")
+        pairs = []
+        for col_name, fn_name in spec.items():
+            fn_key = fn_name.lower()
+            pairs.append((col_name, fn_key, f"{fn_key}({col_name})"))
+        return self._aggregate(pairs)
+
+    def _aggregate(self, pairs: List[tuple]) -> DataFrame:
+        """``pairs``: (column-or-*, fn key, OUTPUT column name).  All
+        validation lives here (every caller path gets the same errors):
+        fn must be known, columns must exist, ``*`` only pairs with
+        count, and output names must be unique."""
+        for col_name, fn_key, _ in pairs:
+            if fn_key not in _AGG_FNS:
+                raise ValueError(
+                    f"Unsupported aggregate {fn_key!r}; supported: "
+                    f"{sorted(_AGG_FNS)}"
+                )
+            if col_name == "*":
+                if fn_key != "count":
+                    raise ValueError(
+                        f"{fn_key}(*) is not defined; use a column"
+                    )
+            elif col_name not in self._df.columns:
+                raise KeyError(f"No such column: {col_name!r}")
+        out_names = list(self._keys) + [label for _, _, label in pairs]
+        if len(set(out_names)) != len(out_names):
+            raise ValueError(
+                f"duplicate output columns in aggregation: {out_names}; "
+                "alias repeated aggregates distinctly"
+            )
+
+        rows = self._df.collect()
+        groups: "Dict[tuple, List[Row]]" = {}
+        order: List[tuple] = []
+        for r in rows:
+            key = tuple(r[k] for k in self._keys)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(r)
+        if not self._keys and not order:
+            # SQL semantics: an ungrouped aggregate over zero rows yields
+            # exactly one row (COUNT(*) = 0, SUM/AVG/... = NULL)
+            groups[()] = []
+            order.append(())
+
+        part: Partition = {name: [] for name in out_names}
+        for key in order:
+            for k, v in zip(self._keys, key):
+                part[k].append(v)
+            for col_name, fn_key, label in pairs:
+                grp = groups[key]
+                if col_name == "*":
+                    result = len(grp)
+                else:
+                    values = [
+                        r[col_name] for r in grp if r[col_name] is not None
+                    ]
+                    result = _AGG_FNS[fn_key](values)
+                part[label].append(result)
+
+        st = StructType()
+        for name in out_names:
+            probe = next((v for v in part[name] if v is not None), None)
+            st.add(name, infer_type(probe))
+        return DataFrame([part], st, self._df.sparkSession)
+
+    # -- named helpers (pyspark surface) --------------------------------
+    def count(self) -> DataFrame:
+        df = self._aggregate([("*", "count", "count")])
+        return df
+
+    def _each(self, fn_key: str, cols: Sequence[str]) -> DataFrame:
+        if not cols:
+            # pyspark semantics: the no-arg form aggregates every NUMERIC
+            # non-key column (a string column would crash sum/avg)
+            from sparkdl_tpu.sql.types import (
+                DoubleType,
+                FloatType,
+                IntegerType,
+                LongType,
+            )
+
+            numeric = (IntegerType, LongType, FloatType, DoubleType)
+            cols = [
+                f.name
+                for f in self._df.schema
+                if f.name not in self._keys
+                and isinstance(f.dataType, numeric)
+            ]
+            if not cols:
+                raise ValueError(
+                    f"no numeric columns to {fn_key} over; name columns "
+                    "explicitly"
+                )
+        return self._aggregate(
+            [(c, fn_key, f"{fn_key}({c})") for c in cols]
+        )
+
+    def sum(self, *cols: str) -> DataFrame:
+        return self._each("sum", cols)
+
+    def avg(self, *cols: str) -> DataFrame:
+        return self._each("avg", cols)
+
+    mean = avg
+
+    def min(self, *cols: str) -> DataFrame:
+        return self._each("min", cols)
+
+    def max(self, *cols: str) -> DataFrame:
+        return self._each("max", cols)

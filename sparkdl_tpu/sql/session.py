@@ -209,17 +209,25 @@ class TPUSession:
         )
 
     # ------------------------------------------------------------------
-    # Minimal SQL: SELECT <exprs> FROM <view> [WHERE <pred>] [LIMIT n]
+    # Minimal SQL: SELECT <exprs> FROM <view> [WHERE <pred>]
+    #   [GROUP BY <cols>] [ORDER BY <col> [ASC|DESC]] [LIMIT n]
     #   expr := * | ident | fn(ident, ...) [AS alias]
+    #           | COUNT(*|ident) | SUM/AVG/MEAN/MIN/MAX(ident) [AS alias]
     #   pred := comparisons composed with AND / OR / NOT / IN (...) / parens
     # ------------------------------------------------------------------
     _SQL_RE = re.compile(
         r"^\s*SELECT\s+(?P<proj>.+?)\s+FROM\s+(?P<table>\w+)"
         r"(?:\s+WHERE\s+(?P<where>.+?))?"
+        r"(?:\s+GROUP\s+BY\s+(?P<group>[\w\s,\.]+?))?"
+        r"(?:\s+ORDER\s+BY\s+(?P<order>\w+(?:\s+(?:ASC|DESC))?))?"
         r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
         re.IGNORECASE | re.DOTALL,
     )
-    _FUNC_RE = re.compile(r"^(?P<fn>\w+)\s*\(\s*(?P<args>[\w\s,\.]*)\s*\)$")
+    _FUNC_RE = re.compile(r"^(?P<fn>\w+)\s*\(\s*(?P<args>[\w\s,\.\*]*)\s*\)$")
+    _AGG_RE = re.compile(
+        r"^(?P<fn>count|sum|avg|mean|min|max)\s*\(\s*(?P<arg>\*|\w+)\s*\)$",
+        re.IGNORECASE,
+    )
 
     def sql(self, query: str) -> DataFrame:
         m = self._SQL_RE.match(query)
@@ -229,14 +237,110 @@ class TPUSession:
         where = m.group("where")
         if where:
             out = out.filter(self._parse_predicate(where.strip()))
-        if m.group("proj").strip() != "*":
-            exprs: List[Column] = [
-                self._parse_projection(raw.strip())
-                for raw in self._split_projections(m.group("proj"))
-            ]
-            out = out.select(*exprs)
+
+        proj_raw = [
+            raw.strip() for raw in self._split_projections(m.group("proj"))
+        ]
+        group = m.group("group")
+
+        def _is_agg_call(p: str) -> bool:
+            am = self._AGG_RE.match(self._strip_alias(p)[0])
+            if not am:
+                return False
+            # a registered scalar UDF named e.g. `min` keeps its per-row
+            # meaning outside GROUP BY queries (as before this dialect
+            # grew aggregates); inside one, SQL aggregate semantics win
+            return group is not None or am.group("fn").lower() not in self.udf
+
+        is_agg = group is not None or any(_is_agg_call(p) for p in proj_raw)
+        order = m.group("order")
+        order_col, ascending = None, True
+        if order:
+            parts = order.split()
+            order_col = parts[0]
+            ascending = len(parts) == 1 or parts[1].upper() != "DESC"
+
+        if is_agg:
+            out = self._sql_aggregate(out, proj_raw, group)
+            if order_col is not None:
+                if order_col not in out.columns:
+                    raise ValueError(
+                        f"ORDER BY {order_col!r}: not an output column of "
+                        f"the aggregation ({out.columns})"
+                    )
+                out = out.orderBy(order_col, ascending=ascending)
+        else:
+            if order_col is not None:
+                # sort BEFORE projecting (standard SQL: the sort column
+                # need not be selected; select preserves row order)
+                if order_col not in out.columns:
+                    raise ValueError(
+                        f"ORDER BY {order_col!r}: no such column "
+                        f"({out.columns})"
+                    )
+                out = out.orderBy(order_col, ascending=ascending)
+            if m.group("proj").strip() != "*":
+                exprs: List[Column] = [
+                    self._parse_projection(raw) for raw in proj_raw
+                ]
+                out = out.select(*exprs)
         if m.group("limit"):
             out = out.limit(int(m.group("limit")))
+        return out
+
+    @staticmethod
+    def _strip_alias(text: str):
+        m = re.match(
+            r"^(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", text, re.IGNORECASE
+        )
+        if m:
+            return m.group("expr").strip(), m.group("alias")
+        return text, None
+
+    def _sql_aggregate(
+        self, df: DataFrame, proj_raw: List[str], group: Optional[str]
+    ) -> DataFrame:
+        """The GROUP BY path: every projection must be a group key or an
+        aggregate call (as in Spark); aliases rename the pyspark-style
+        ``fn(col)`` output columns."""
+        keys = (
+            [k.strip() for k in group.split(",") if k.strip()]
+            if group
+            else []
+        )
+        pairs = []  # (col, fn, OUTPUT name) for GroupedData._aggregate
+        renames = []  # (key, alias) — keys only; aggregates alias directly
+        passthrough = []
+        for raw in proj_raw:
+            expr, alias = self._strip_alias(raw)
+            am = self._AGG_RE.match(expr)
+            if am:
+                fn_key = am.group("fn").lower()
+                if fn_key == "mean":
+                    fn_key = "avg"
+                arg = am.group("arg")
+                # the alias IS the output column (aliasing after the fact
+                # breaks for repeated aggregates — duplicate default
+                # labels would collide)
+                pairs.append((arg, fn_key, alias or f"{fn_key}({arg})"))
+            elif expr in keys:
+                if alias:
+                    renames.append((expr, alias))
+                passthrough.append(expr)
+            else:
+                raise ValueError(
+                    f"Projection {raw!r} must be a GROUP BY key or an "
+                    "aggregate (COUNT/SUM/AVG/MIN/MAX)"
+                )
+        if not pairs:
+            raise ValueError("GROUP BY query needs at least one aggregate")
+        out = df.groupBy(*keys)._aggregate(pairs)
+        # drop group keys the projection didn't ask for
+        for k in keys:
+            if k not in passthrough:
+                out = out.drop(k)
+        for key, alias in renames:
+            out = out.withColumnRenamed(key, alias)
         return out
 
     @staticmethod

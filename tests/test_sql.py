@@ -260,3 +260,121 @@ class TestWherePredicates:
     def test_leading_dot_float_literal(self, view):
         # regression: `score > .5` parsed before the tokenizer rewrite
         assert self._ids(view, "score > .5") == list(range(1, 10))
+
+
+class TestGroupByAggregates:
+    """GroupedData + the SQL GROUP BY / aggregate / ORDER BY surface."""
+
+    @pytest.fixture()
+    def gdf(self, tpu_session):
+        data = [
+            (i, i % 3, float(i), None if i == 4 else i * 2) for i in range(9)
+        ]
+        df = tpu_session.createDataFrame(
+            data, ["id", "label", "score", "maybe"]
+        )
+        df.createOrReplaceTempView("agg_t")
+        return df
+
+    def test_grouped_data_api(self, gdf):
+        out = gdf.groupBy("label").agg({"score": "avg", "*": "count"})
+        rows = {r.label: r for r in out.collect()}
+        assert rows[0]["count(*)"] == 3 and rows[0]["avg(score)"] == 3.0
+        assert rows[1]["count(*)"] == 3 and rows[1]["avg(score)"] == 4.0
+
+        counts = {r.label: r["count"] for r in gdf.groupBy("label").count().collect()}
+        assert counts == {0: 3, 1: 3, 2: 3}
+
+        sums = {r.label: r["sum(score)"] for r in gdf.groupBy("label").sum("score").collect()}
+        assert sums == {0: 9.0, 1: 12.0, 2: 15.0}
+
+    def test_null_excluded_from_aggregates(self, gdf):
+        # id=4 (label 1) has maybe=None: COUNT(col) skips it, AVG ignores it
+        out = {r.label: r for r in gdf.groupBy("label").agg(
+            {"maybe": "count"}).collect()}
+        assert out[1]["count(maybe)"] == 2
+        avg = {r.label: r["avg(maybe)"] for r in gdf.groupBy("label").avg(
+            "maybe").collect()}
+        assert avg[1] == (1 * 2 + 7 * 2) / 2
+
+    def test_sql_group_by(self, gdf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT label, COUNT(*) AS n, AVG(score) AS m FROM agg_t "
+            "WHERE id < 8 GROUP BY label ORDER BY label"
+        ).collect()
+        assert [r.label for r in out] == [0, 1, 2]
+        assert [r.n for r in out] == [3, 3, 2]
+        assert out[2].m == (2.0 + 5.0) / 2
+
+    def test_sql_global_aggregate(self, gdf, tpu_session):
+        (row,) = tpu_session.sql(
+            "SELECT COUNT(*) AS n, MAX(score) AS mx FROM agg_t"
+        ).collect()
+        assert row.n == 9 and row.mx == 8.0
+
+    def test_sql_order_by_desc_limit(self, gdf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT id FROM agg_t ORDER BY id DESC LIMIT 3"
+        ).collect()
+        assert [r.id for r in out] == [8, 7, 6]
+
+    def test_sql_rejects_bare_column_in_group_query(self, gdf, tpu_session):
+        with pytest.raises(ValueError, match="GROUP BY key or an aggregate"):
+            tpu_session.sql(
+                "SELECT score, COUNT(*) FROM agg_t GROUP BY label"
+            )
+
+    def test_duplicate_aggregates_need_distinct_aliases(self, gdf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT label, AVG(score) AS a, AVG(score) AS b FROM agg_t "
+            "GROUP BY label ORDER BY label"
+        ).collect()
+        assert out[0].a == out[0].b == 3.0
+        with pytest.raises(ValueError, match="duplicate output columns"):
+            tpu_session.sql(
+                "SELECT AVG(score), AVG(score) FROM agg_t GROUP BY label"
+            )
+
+    def test_global_aggregate_on_empty_view(self, tpu_session):
+        df = tpu_session.createDataFrame([(1, 2.0)], ["id", "v"]).filter(
+            lambda r: False
+        )
+        df.createOrReplaceTempView("empty_t")
+        (row,) = tpu_session.sql(
+            "SELECT COUNT(*) AS n, SUM(v) AS s FROM empty_t"
+        ).collect()
+        assert row.n == 0 and row.s is None
+
+    def test_aggregate_unknown_column_raises(self, gdf, tpu_session):
+        with pytest.raises(KeyError, match="nope"):
+            tpu_session.sql("SELECT SUM(nope) FROM agg_t GROUP BY label")
+
+    def test_order_by_non_projected_column(self, gdf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT label FROM agg_t ORDER BY score DESC LIMIT 2"
+        ).collect()
+        assert [r.label for r in out] == [8 % 3, 7 % 3]
+        with pytest.raises(ValueError, match="ORDER BY"):
+            tpu_session.sql("SELECT label FROM agg_t ORDER BY nope")
+
+    def test_scalar_udf_named_like_aggregate_wins_outside_group_by(
+        self, gdf, tpu_session
+    ):
+        tpu_session.udf.register("min", lambda x: x * 10)
+        out = tpu_session.sql("SELECT min(score) AS m FROM agg_t LIMIT 3")
+        assert [r.m for r in out.collect()] == [0.0, 10.0, 20.0]
+        # inside GROUP BY, SQL aggregate semantics win
+        out2 = tpu_session.sql(
+            "SELECT MIN(score) AS m FROM agg_t GROUP BY label ORDER BY m"
+        ).collect()
+        assert [r.m for r in out2] == [0.0, 1.0, 2.0]
+
+    def test_no_arg_sum_skips_non_numeric(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [(0, "a", 1.0), (0, "b", 2.0), (1, "c", 3.0)],
+            ["k", "name", "v"],
+        )
+        out = {r.k: r["sum(v)"] for r in df.groupBy("k").sum().collect()}
+        assert out == {0: 3.0, 1: 3.0}
+        with pytest.raises(ValueError, match="sum\\(\\*\\) is not defined"):
+            df.groupBy("k").agg({"*": "sum"})
