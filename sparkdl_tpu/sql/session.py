@@ -210,7 +210,8 @@ class TPUSession:
 
     # ------------------------------------------------------------------
     # Minimal SQL: SELECT <exprs> FROM <view> [WHERE <pred>]
-    #   [GROUP BY <cols>] [ORDER BY <col> [ASC|DESC]] [LIMIT n]
+    #   [GROUP BY <cols>] [HAVING <pred>] [ORDER BY <col> [ASC|DESC]]
+    #   [LIMIT n]
     #   expr := * | ident | fn(ident, ...) [AS alias]
     #           | COUNT(*|ident) | SUM/AVG/MEAN/MIN/MAX(ident) [AS alias]
     #   pred := comparisons composed with AND / OR / NOT / IN (...) / parens
@@ -219,6 +220,7 @@ class TPUSession:
         r"^\s*SELECT\s+(?P<proj>.+?)\s+FROM\s+(?P<table>\w+)"
         r"(?:\s+WHERE\s+(?P<where>.+?))?"
         r"(?:\s+GROUP\s+BY\s+(?P<group>[\w\s,\.]+?))?"
+        r"(?:\s+HAVING\s+(?P<having>.+?))?"
         r"(?:\s+ORDER\s+BY\s+(?P<order>\w+(?:\s+(?:ASC|DESC))?))?"
         r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
         re.IGNORECASE | re.DOTALL,
@@ -253,6 +255,8 @@ class TPUSession:
             return group is not None or am.group("fn").lower() not in self.udf
 
         is_agg = group is not None or any(_is_agg_call(p) for p in proj_raw)
+        if m.group("having") and not is_agg:
+            raise ValueError("HAVING requires a GROUP BY / aggregate query")
         order = m.group("order")
         order_col, ascending = None, True
         if order:
@@ -261,7 +265,9 @@ class TPUSession:
             ascending = len(parts) == 1 or parts[1].upper() != "DESC"
 
         if is_agg:
-            out = self._sql_aggregate(out, proj_raw, group)
+            out = self._sql_aggregate(
+                out, proj_raw, group, having=m.group("having")
+            )
             if order_col is not None:
                 if order_col not in out.columns:
                     raise ValueError(
@@ -298,7 +304,11 @@ class TPUSession:
         return text, None
 
     def _sql_aggregate(
-        self, df: DataFrame, proj_raw: List[str], group: Optional[str]
+        self,
+        df: DataFrame,
+        proj_raw: List[str],
+        group: Optional[str],
+        having: Optional[str] = None,
     ) -> DataFrame:
         """The GROUP BY path: every projection must be a group key or an
         aggregate call (as in Spark); aliases rename the pyspark-style
@@ -335,7 +345,21 @@ class TPUSession:
         if not pairs:
             raise ValueError("GROUP BY query needs at least one aggregate")
         out = df.groupBy(*keys)._aggregate(pairs)
-        # drop group keys the projection didn't ask for
+        if having:
+            # standard SQL: HAVING may reference any group key (even one
+            # the projection drops) or an aggregate BY ITS ALIAS — the
+            # default ``fn(col)`` output labels are not parseable as
+            # predicate identifiers, so unaliased aggregates need an AS
+            try:
+                predicate = self._parse_predicate(having.strip())
+            except ValueError as e:
+                raise ValueError(
+                    f"Unsupported HAVING clause {having.strip()!r}: {e}; "
+                    "reference group keys or aliased aggregates (use AS)"
+                ) from None
+            out = out.filter(predicate)
+        # drop group keys the projection didn't ask for (AFTER the HAVING
+        # filter, which may reference them)
         for k in keys:
             if k not in passthrough:
                 out = out.drop(k)

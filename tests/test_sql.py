@@ -378,3 +378,28 @@ class TestGroupByAggregates:
         assert out == {0: 3.0, 1: 3.0}
         with pytest.raises(ValueError, match="sum\\(\\*\\) is not defined"):
             df.groupBy("k").agg({"*": "sum"})
+
+    def test_having(self, gdf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT label, COUNT(*) AS n, SUM(score) AS s FROM agg_t "
+            "GROUP BY label HAVING s > 10 ORDER BY label"
+        ).collect()
+        assert [(r.label, r.s) for r in out] == [(1, 12.0), (2, 15.0)]
+        with pytest.raises(ValueError, match="HAVING requires"):
+            tpu_session.sql("SELECT id FROM agg_t HAVING id > 1")
+
+    def test_having_on_non_projected_key_and_alias_hint(
+        self, gdf, tpu_session
+    ):
+        # HAVING may reference a group key the projection drops
+        out = tpu_session.sql(
+            "SELECT SUM(score) AS s FROM agg_t GROUP BY label "
+            "HAVING label > 0 ORDER BY s"
+        ).collect()
+        assert [r.s for r in out] == [12.0, 15.0]
+        # unaliased aggregate labels are not predicate identifiers
+        with pytest.raises(ValueError, match="HAVING.*AS"):
+            tpu_session.sql(
+                "SELECT label, COUNT(*) FROM agg_t GROUP BY label "
+                "HAVING count(*) > 1"
+            )
