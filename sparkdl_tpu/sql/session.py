@@ -352,12 +352,12 @@ class TPUSession:
             # predicate identifiers, so unaliased aggregates need an AS
             try:
                 predicate = self._parse_predicate(having.strip())
-            except ValueError as e:
+                out = out.filter(predicate)
+            except (ValueError, KeyError) as e:
                 raise ValueError(
                     f"Unsupported HAVING clause {having.strip()!r}: {e}; "
                     "reference group keys or aliased aggregates (use AS)"
                 ) from None
-            out = out.filter(predicate)
         # drop group keys the projection didn't ask for (AFTER the HAVING
         # filter, which may reference them)
         for k in keys:

@@ -403,3 +403,10 @@ class TestGroupByAggregates:
                 "SELECT label, COUNT(*) FROM agg_t GROUP BY label "
                 "HAVING count(*) > 1"
             )
+
+    def test_having_unknown_column_gets_hint(self, gdf, tpu_session):
+        with pytest.raises(ValueError, match="HAVING.*AS"):
+            tpu_session.sql(
+                "SELECT label, SUM(score) AS s FROM agg_t GROUP BY label "
+                "HAVING cnt > 1"
+            )
