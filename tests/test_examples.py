@@ -69,3 +69,9 @@ def test_distributed_finetune_example(tmp_path):
     )
     assert "fitMultiple trained 2 models" in out
     assert "train accuracy" in out
+
+
+@pytest.mark.slow
+def test_sql_analytics_example():
+    out = _run_example("sql_analytics.py")
+    assert "sql analytics OK" in out
