@@ -15,8 +15,10 @@ Methodology notes (hard-won, see BASELINE.md):
   host wall time through the loopback relay is ±3x noise;
 - achieved GB/s = (operand bytes + output bytes) / device time, an
   *upper bound* on true traffic (operands may come from on-chip reuse);
-- compare TF/s against the chip's *demonstrated* matmul ceiling (76 TF/s
-  measured on this tunnel chip at 8192³), not the 197 TF/s spec.
+- compare TF/s against the chip's *demonstrated* conv ceiling (~139 TF/s,
+  measured via VGG19's 3x3 convs on this tunnel chip; see BASELINE.md's
+  corrected calibration), not the 197 TF/s spec.  The earlier 76 TF/s
+  figure was XLA's DOT-emitter plateau at 8192³, not the chip limit.
 """
 
 from __future__ import annotations
@@ -55,7 +57,10 @@ def build_forward(model_name: str, batch: int):
     variables = jax.tree_util.tree_map(
         lambda l: jnp.full(l.shape, 0.01, l.dtype), shapes
     )
-    folded = fold_bgr_flip_into_stem(variables)
+    # the mode gate (fold only under channel-symmetric 'tf' preprocessing)
+    # lives inside the helper, so this profiles exactly the production
+    # program for every model
+    folded = fold_bgr_flip_into_stem(variables, entry.preprocess_mode)
     flip = folded is None
     if folded is not None:
         variables = folded

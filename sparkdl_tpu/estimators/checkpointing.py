@@ -13,8 +13,141 @@ estimators; everything else lives here so the two cannot drift.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import re
 from typing import List, Optional
+
+
+def _code_digest(code) -> str:
+    """Digest of a function body: bytecode + referenced names + non-code
+    consts + nested code objects.  Two defs with the same qualname but
+    different bodies (an edited lambda loss, or one calling a different
+    global — LOAD_GLOBAL indexes into co_names, not co_code) must not
+    share a checkpoint namespace."""
+    h = hashlib.sha256(code.co_code)
+    h.update(repr(code.co_names).encode())
+    for c in code.co_consts:
+        if hasattr(c, "co_code"):
+            h.update(_code_digest(c).encode())
+        else:
+            h.update(repr(c).encode())
+    return h.hexdigest()[:8]
+
+
+def stable_description(obj, depth: int = 0, seen=None) -> str:
+    """A process-stable structural description of a configuration value,
+    for checkpoint-namespace fingerprints.
+
+    ``repr()`` of a flax module holding a callable ``attn_impl``, or of an
+    optax ``GradientTransformation`` (a NamedTuple of closures), embeds
+    ``<function ... at 0x7f...>`` memory addresses that change every
+    process — hashing those would silently fork a fresh namespace on every
+    re-fit instead of resuming.  Callables reduce to qualified name + body
+    digest + defaults + bound-instance state + a recursive description of
+    their closure cells (optax keeps the hyperparameters there — qualname
+    alone would make ``adam(1e-3)`` and ``sgd(1e-2)`` collide);
+    state-bearing objects with the default repr traverse their
+    ``__dict__`` (or slot attributes); sets render sorted (their repr
+    order is PYTHONHASHSEED-dependent); residual addresses in plain reprs
+    are stripped.  Traversal order is structural, so the string is
+    identical across processes; ``seen`` is path-scoped (ids are removed
+    on the way out) so aliased-but-equal configs render identically,
+    guarding only true reference cycles; the depth cap is a backstop above
+    any real optax nesting."""
+    if seen is None:
+        seen = set()
+    if depth > 24:
+        return "<deep>"
+    if (callable(obj) and hasattr(obj, "__qualname__")
+            and not isinstance(obj, type)):
+        if id(obj) in seen:
+            return "<cycle>"
+        seen.add(id(obj))
+        try:
+            name = f"{getattr(obj, '__module__', '')}.{obj.__qualname__}"
+            parts = []
+            code = getattr(obj, "__code__", None)
+            if code is not None:
+                parts.append(_code_digest(code))
+            bound_self = getattr(obj, "__self__", None)
+            if bound_self is not None:
+                parts.append(
+                    "self=" + stable_description(bound_self, depth + 1, seen)
+                )
+            defaults = getattr(obj, "__defaults__", None)
+            if defaults:
+                parts.append(
+                    "defaults="
+                    + stable_description(defaults, depth + 1, seen)
+                )
+            kwdefaults = getattr(obj, "__kwdefaults__", None)
+            if kwdefaults:
+                parts.append(
+                    "kwdefaults="
+                    + stable_description(
+                        sorted(kwdefaults.items()), depth + 1, seen
+                    )
+                )
+            for cell in (getattr(obj, "__closure__", None) or ()):
+                try:
+                    parts.append(
+                        stable_description(
+                            cell.cell_contents, depth + 1, seen
+                        )
+                    )
+                except ValueError:
+                    parts.append("<empty>")
+            return f"{name}({','.join(parts)})" if parts else name
+        finally:
+            seen.discard(id(obj))
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        inner = ",".join(
+            f"{f}={stable_description(getattr(obj, f), depth + 1, seen)}"
+            for f in obj._fields
+        )
+        return f"{type(obj).__name__}({inner})"
+    if isinstance(obj, (tuple, list)):
+        return "[" + ",".join(
+            stable_description(v, depth + 1, seen) for v in obj
+        ) + "]"
+    if isinstance(obj, dict):
+        return "{" + ",".join(
+            f"{stable_description(k, depth + 1, seen)}:"
+            f"{stable_description(v, depth + 1, seen)}"
+            for k, v in sorted(obj.items(), key=lambda kv: str(kv[0]))
+        ) + "}"
+    if isinstance(obj, (set, frozenset)):
+        return "{" + ",".join(
+            sorted(stable_description(v, depth + 1, seen) for v in obj)
+        ) + "}"
+    r = re.sub(r" at 0x[0-9a-fA-F]+", "", repr(obj))
+    # the default object repr ('<m.FocalLoss object>') carries no state:
+    # a loss instance with gamma=2 vs gamma=5 must differ, so describe
+    # the instance state too — __dict__, or slot attributes for
+    # __slots__ classes (path-scoped cycle guard, as above)
+    # (qualnames may contain '<locals>', hence \S+ not [\w.]+)
+    if re.fullmatch(r"<\S+ object>", r):
+        state = getattr(obj, "__dict__", None)
+        if not state:
+            slot_names = []
+            for klass in type(obj).__mro__:
+                slots = getattr(klass, "__slots__", ()) or ()
+                if isinstance(slots, str):
+                    slots = (slots,)
+                slot_names.extend(slots)
+            state = {
+                s: getattr(obj, s) for s in slot_names if hasattr(obj, s)
+            }
+        if state:
+            if id(obj) in seen:
+                return r + "<cycle>"
+            seen.add(id(obj))
+            try:
+                r += stable_description(state, depth + 1, seen)
+            finally:
+                seen.discard(id(obj))
+    return r
 
 
 def make_async_checkpointer():

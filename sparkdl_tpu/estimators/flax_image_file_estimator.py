@@ -467,7 +467,9 @@ class FlaxImageFileEstimator(
 
     def _ckpt_namespace(self) -> str:
         """Deterministic per-configuration subdirectory.  The trajectory
-        fingerprint covers the module (flax dataclass repr), optimizer,
+        fingerprint covers the module (via
+        :func:`checkpointing.stable_description` — process-stable even
+        for callable attn_impl / optax closures), optimizer,
         loss, trajectory fitParams (epochs excluded — a stopping point,
         not a trajectory parameter) and a cheap digest of the initial
         variables (shapes + per-leaf sums), so different pretrained
@@ -476,6 +478,8 @@ class FlaxImageFileEstimator(
         pinned invariant, so placement does not change the trajectory."""
         import hashlib
         import json
+
+        stable = checkpointing.stable_description
 
         fit_params = {
             k: v
@@ -502,11 +506,11 @@ class FlaxImageFileEstimator(
             ).hexdigest()[:16]
         payload = json.dumps(
             {
-                "module": repr(self.getOrDefault(self.module)),
-                "optimizer": repr(self.getOrDefault(self.optimizer)),
-                "loss": repr(self.getOrDefault(self.loss)),
+                "module": stable(self.getOrDefault(self.module)),
+                "optimizer": stable(self.getOrDefault(self.optimizer)),
+                "loss": stable(self.getOrDefault(self.loss)),
                 "fitParams": sorted(
-                    (str(k), repr(v)) for k, v in fit_params.items()
+                    (str(k), stable(v)) for k, v in fit_params.items()
                 ),
                 "initialVariables": vars_digest,
                 "labelCol": self.getLabelCol(),

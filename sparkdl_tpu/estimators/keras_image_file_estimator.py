@@ -340,13 +340,17 @@ class KerasImageFileEstimator(
             # fit(epochs=2) instead of resuming two more epochs
             if k not in ("streaming", "epochs")
         }
+        # stable_description, not repr: a callable loss or optimizer
+        # object would otherwise embed per-process memory addresses and
+        # fork a fresh namespace on every re-fit
+        stable = checkpointing.stable_description
         payload = json.dumps(
             {
                 "modelFile": os.path.abspath(str(self.getModelFile())),
-                "optimizer": repr(self.getKerasOptimizer()),
-                "loss": repr(self.getKerasLoss()),
+                "optimizer": stable(self.getKerasOptimizer()),
+                "loss": stable(self.getKerasLoss()),
                 "fitParams": sorted(
-                    (str(k), repr(v)) for k, v in fit_params.items()
+                    (str(k), stable(v)) for k, v in fit_params.items()
                 ),
                 "labelCol": self.getLabelCol(),
                 "inputCol": self.getInputCol(),

@@ -140,7 +140,7 @@ def get_keras_application_model(name: str) -> KerasApplicationModel:
 getKerasApplicationModel = get_keras_application_model
 
 
-def fold_bgr_flip_into_stem(variables):
+def fold_bgr_flip_into_stem(variables, preprocess_mode: str):
     """Fold the BGR->RGB input flip into the stem conv's weights.
 
     The transformers' fused forward flips the stored-BGR batch before the
@@ -150,10 +150,19 @@ def fold_bgr_flip_into_stem(variables):
     conv kernel* is mathematically identical, and the flip disappears from
     the program entirely.
 
-    Returns the folded variables, or ``None`` when folding is unsafe (not
-    exactly one 3-input-channel conv kernel — caller keeps the runtime
-    flip).
+    Pass the entry's ``preprocess_mode``: folding under channel-asymmetric
+    preprocessing (``"caffe"`` per-channel mean subtraction) would change
+    the numerics, so any mode other than ``"tf"`` returns ``None`` here —
+    the gate lives in this helper precisely so call sites cannot forget it
+    (benchmarks/profile_ops.py once did, and profiled a numerically wrong
+    program for VGG/ResNet).
+
+    Returns the folded variables, or ``None`` when folding is unsafe
+    (non-'tf' preprocessing, or not exactly one 3-input-channel conv
+    kernel — caller keeps the runtime flip).
     """
+    if preprocess_mode != "tf":
+        return None
     flat, treedef = jax.tree_util.tree_flatten_with_path(variables)
     hits = [
         i

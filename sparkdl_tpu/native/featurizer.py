@@ -50,9 +50,7 @@ def export_featurizer(
         source_hw = (height, width)
     preprocess = entry.preprocess
 
-    folded = None
-    if entry.preprocess_mode == "tf":
-        folded = fold_bgr_flip_into_stem(variables)
+    folded = fold_bgr_flip_into_stem(variables, entry.preprocess_mode)
     flip_in_program = folded is None
     if folded is not None:
         variables = folded

@@ -193,11 +193,9 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
         # channel-symmetric preprocessing ("tf" mode): fold the BGR->RGB
         # flip into the stem conv's input channels — the flip op (pure HBM
         # bandwidth) vanishes from the program
-        folded = None
-        if entry.preprocess_mode == "tf":
-            from sparkdl_tpu.models.registry import fold_bgr_flip_into_stem
+        from sparkdl_tpu.models.registry import fold_bgr_flip_into_stem
 
-            folded = fold_bgr_flip_into_stem(resolved)
+        folded = fold_bgr_flip_into_stem(resolved, entry.preprocess_mode)
         variables = place_params(folded if folded is not None else resolved)
         flip_in_program = folded is None
 

@@ -203,41 +203,47 @@ class NativeDeepImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
             if not rows:
                 out[output_col] = []
                 return out
-            # native decode + resize to the program's fixed source size;
-            # rounded back to uint8 (awt-resize parity — the program
-            # ingests u8)
-            x = decode_image_batch(
-                rows, 3, (height, width), to_rgb=False, always_resize=True,
-                prefer_uint8=True,
-            )
-            if x.dtype != np.uint8:
-                x = np.clip(np.rint(x), 0, 255).astype(np.uint8)
-            # Not run_batched: that engine stages chunks onto the *jax*
-            # device, which here would round-trip every batch through the
-            # jax client before the native client ships it again.  Same
-            # chunk/pad/slice policy and the same metrics counters though;
-            # batches stream double-buffered (NativeProgram.stream: batch
-            # i+1's transfer+execute enqueue before batch i's fetch).
             from sparkdl_tpu.utils.metrics import metrics
 
-            n = x.shape[0]
+            # 'sparkdl.serve' covers decode through fetch so the sustained
+            # images_per_sec means the same thing here as in the flax
+            # serving paths (end-to-end, load included); 'sparkdl.forward'
+            # is the dispatch+fetch subset — see metrics.py
+            with metrics.timer("sparkdl.serve").time():
+                # native decode + resize to the program's fixed source
+                # size; rounded back to uint8 (awt-resize parity — the
+                # program ingests u8)
+                x = decode_image_batch(
+                    rows, 3, (height, width), to_rgb=False,
+                    always_resize=True, prefer_uint8=True,
+                )
+                if x.dtype != np.uint8:
+                    x = np.clip(np.rint(x), 0, 255).astype(np.uint8)
+                # Not run_batched: that engine stages chunks onto the
+                # *jax* device, which here would round-trip every batch
+                # through the jax client before the native client ships
+                # it again.  Same chunk/pad/slice policy and the same
+                # metrics counters though; batches stream double-buffered
+                # (NativeProgram.stream: batch i+1's transfer+execute
+                # enqueue before batch i's fetch).
+                n = x.shape[0]
 
-            def chunks():
-                for lo in range(0, n, batch):
-                    chunk = x[lo:lo + batch]
-                    if chunk.shape[0] < batch:  # pad the ragged tail
-                        chunk = np.concatenate(
-                            [chunk,
-                             np.repeat(chunk[-1:],
-                                       batch - chunk.shape[0], axis=0)]
-                        )
-                    yield chunk
+                def chunks():
+                    for lo in range(0, n, batch):
+                        chunk = x[lo:lo + batch]
+                        if chunk.shape[0] < batch:  # pad the ragged tail
+                            chunk = np.concatenate(
+                                [chunk,
+                                 np.repeat(chunk[-1:],
+                                           batch - chunk.shape[0], axis=0)]
+                            )
+                        yield chunk
 
-            feats = []
-            with metrics.timer("sparkdl.forward").time():
-                for i, outs in enumerate(prog.stream(chunks())):
-                    k = min(batch, n - i * batch)
-                    feats.append(np.asarray(outs[0])[:k])
+                feats = []
+                with metrics.timer("sparkdl.forward").time():
+                    for i, outs in enumerate(prog.stream(chunks())):
+                        k = min(batch, n - i * batch)
+                        feats.append(np.asarray(outs[0])[:k])
             metrics.counter("sparkdl.rows_processed").add(n)
             metrics.counter("sparkdl.batches_run").add(-(-n // batch))
             flat = np.concatenate(feats).astype(np.float64)

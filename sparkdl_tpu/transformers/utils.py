@@ -468,8 +468,13 @@ def run_batched_multi(
     else:
         _place = jnp.asarray
     collected: Optional[List[List[np.ndarray]]] = None
+    # 'sparkdl.serve' is end-to-end loop wall time (the sustained-rate
+    # denominator); 'sparkdl.forward' is the dispatch+fetch subset.  Here
+    # inputs are pre-decoded so the two coincide; run_batched_rows (lazy
+    # decode in the loop) is where they diverge.
+    serve_timer = metrics.timer("sparkdl.serve")
     forward_timer = metrics.timer("sparkdl.forward")
-    with maybe_trace(), forward_timer.time():
+    with maybe_trace(), serve_timer.time(), forward_timer.time():
         for lo in range(0, n, batch_size):
             chunks = [a[lo : lo + batch_size] for a in arrays]
             k = chunks[0].shape[0]
@@ -615,30 +620,44 @@ def run_batched_rows(
     # decode_image_batch — not here, to avoid double counting)
     collected: List[np.ndarray] = []
     pending: Optional[Tuple[Any, int]] = None
+    # 'sparkdl.forward' times only dispatch + device fetch: pulling the
+    # next chunk (lazy decode in serial mode, queue wait in pipelined
+    # mode) advances 'sparkdl.load' inside the decode closure, so timing
+    # the whole loop would double-count load under forward.  The whole
+    # loop — load waits included — runs under 'sparkdl.serve', the
+    # sustained end-to-end rate images_per_sec() reports.
+    serve_timer = metrics.timer("sparkdl.serve")
     forward_timer = metrics.timer("sparkdl.forward")
     try:
-        with maybe_trace(), forward_timer.time():
+        with maybe_trace(), serve_timer.time():
             for batch, k in chunk_iter:
-                result = fn(_place(batch))  # async dispatch
-                if isinstance(result, (tuple, list)):
-                    raise TypeError(
-                        "run_batched_rows requires a single-output fn "
-                        f"(got {len(result)} outputs); unwrap the output "
-                        "in the forward, or use run_batched_multi"
-                    )
-                if pending is not None:
-                    r_prev, k_prev = pending
+                with forward_timer.time():
+                    result = fn(_place(batch))  # async dispatch
+                    if isinstance(result, (tuple, list)):
+                        raise TypeError(
+                            "run_batched_rows requires a single-output fn "
+                            f"(got {len(result)} outputs); unwrap the "
+                            "output in the forward, or use "
+                            "run_batched_multi"
+                        )
+                    if pending is not None:
+                        r_prev, k_prev = pending
+                        collected.append(
+                            np.asarray(jax.device_get(r_prev))[:k_prev]
+                        )
+                        pending = None
+                    if serial:
+                        collected.append(
+                            np.asarray(jax.device_get(result))[:k]
+                        )
+                    else:
+                        pending = (result, k)
+            if pending is not None:
+                r_prev, k_prev = pending
+                with forward_timer.time():
                     collected.append(
                         np.asarray(jax.device_get(r_prev))[:k_prev]
                     )
-                    pending = None
-                if serial:
-                    collected.append(np.asarray(jax.device_get(result))[:k])
-                else:
-                    pending = (result, k)
-            if pending is not None:
-                r_prev, k_prev = pending
-                collected.append(np.asarray(jax.device_get(r_prev))[:k_prev])
     finally:
         cancel.set()
     metrics.counter("sparkdl.rows_processed").add(n)

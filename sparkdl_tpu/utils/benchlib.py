@@ -78,10 +78,9 @@ def measure_featurizer(
         lambda l: jnp.full(l.shape, 0.01, l.dtype), shapes
     )
     # fold the BGR flip into the stem conv where preprocessing is
-    # channel-symmetric (drops a pure-bandwidth rev op)
-    folded = None
-    if entry.preprocess_mode == "tf":
-        folded = fold_bgr_flip_into_stem(variables)
+    # channel-symmetric (drops a pure-bandwidth rev op; the mode gate
+    # lives inside the helper)
+    folded = fold_bgr_flip_into_stem(variables, entry.preprocess_mode)
     flip_in_program = folded is None
     if folded is not None:
         variables = folded

@@ -111,12 +111,17 @@ class MetricsRegistry:
         return out
 
     def images_per_sec(self) -> Optional[float]:
-        """Sustained rows/sec through the batched forward — the north-star
-        images/sec metric when the pipeline is an image transformer (tensor
-        transformers count their rows here too; the counter is honest about
-        that, hence its name)."""
+        """Sustained rows/sec through the batched serving loop — the
+        north-star images/sec metric when the pipeline is an image
+        transformer (tensor transformers count their rows here too; the
+        counter is honest about that, hence its name).  The denominator is
+        'sparkdl.serve' (end-to-end loop wall time, load waits included);
+        'sparkdl.forward' — the dispatch+fetch subset — is the fallback
+        for callers that only ran device work."""
         n = self.counter("sparkdl.rows_processed").value
-        s = self.timer("sparkdl.forward").seconds
+        s = self.timer("sparkdl.serve").seconds
+        if not s:
+            s = self.timer("sparkdl.forward").seconds
         return (n / s) if (n and s) else None
 
     def reset(self) -> None:

@@ -167,6 +167,94 @@ class TestFlaxCheckpointing:
                 err_msg=str(ka),
             )
 
+    def test_namespace_stable_across_equal_objects(self):
+        """Equal-but-distinct callable/optimizer objects hash to the SAME
+        namespace: plain ``repr`` embeds ``at 0x...`` addresses, which
+        change per process and would silently fork a fresh namespace on
+        every re-fit instead of resuming (ADVICE r3)."""
+        import optax
+
+        from sparkdl_tpu.ops import flash_attention
+
+        def make(opt):
+            return FlaxImageFileEstimator(
+                inputCol="uri", outputCol="out", labelCol="label",
+                imageLoader=_loader,
+                module=ViT(variant="ViT-Ti/16", num_classes=2,
+                           image_size=IMG, attn_impl=flash_attention),
+                optimizer=opt,
+                fitParams=self._fit_params(2),
+            )
+
+        # two separate optax.adam calls build distinct closure objects at
+        # distinct addresses — the config is identical
+        a, b = make(optax.adam(1e-3)), make(optax.adam(1e-3))
+        assert a._ckpt_namespace() == b._ckpt_namespace()
+        # and a genuinely different optimizer still separates
+        c = make(optax.sgd(1e-3))
+        assert c._ckpt_namespace() != a._ckpt_namespace()
+        # hyperparameters buried in nested closures (schedules, nested
+        # chains) must separate too — a depth-truncated description would
+        # resume the wrong trajectory
+        s1 = make(optax.adam(optax.exponential_decay(1e-3, 1000, 0.9)))
+        s2 = make(optax.adam(optax.exponential_decay(1e-2, 1000, 0.9)))
+        s3 = make(optax.adam(optax.exponential_decay(1e-3, 1000, 0.9)))
+        assert s1._ckpt_namespace() != s2._ckpt_namespace()
+        assert s1._ckpt_namespace() == s3._ckpt_namespace()
+        n1 = make(optax.chain(optax.clip(1.0), optax.chain(optax.adam(1e-3))))
+        n2 = make(optax.chain(optax.clip(1.0), optax.chain(optax.adam(1e-2))))
+        assert n1._ckpt_namespace() != n2._ckpt_namespace()
+        # aliased vs rebuilt-equal configs must agree (the seen-guard is
+        # path-scoped, not first-visit-wins)
+        tx = optax.adam(1e-3)
+        aliased = make(optax.chain(tx, tx))
+        rebuilt = make(optax.chain(optax.adam(1e-3), optax.adam(1e-3)))
+        assert aliased._ckpt_namespace() == rebuilt._ckpt_namespace()
+
+    def test_namespace_sees_callable_state_and_bodies(self):
+        """State-bearing callables (instances, bound methods) and
+        function *bodies* participate in the namespace: hyperparameters
+        on a loss object, a swapped global in a lambda, or a changed
+        kw-only default each get their own trajectory."""
+
+        class FocalLoss:
+            def __init__(self, gamma):
+                self.gamma = gamma
+
+            def __call__(self, logits, labels):
+                return (logits - labels).mean() * self.gamma
+
+        def make(loss):
+            return FlaxImageFileEstimator(
+                inputCol="uri", outputCol="out", labelCol="label",
+                imageLoader=_loader,
+                module=ViT(variant="ViT-Ti/16", num_classes=2,
+                           image_size=IMG),
+                loss=loss,
+                fitParams=self._fit_params(2),
+            )
+
+        ns = lambda e: e._ckpt_namespace()  # noqa: E731
+        assert ns(make(FocalLoss(2.0))) != ns(make(FocalLoss(5.0)))
+        assert ns(make(FocalLoss(2.0))) == ns(make(FocalLoss(2.0)))
+        # bound methods carry __self__ state
+        assert (ns(make(FocalLoss(2.0).__call__))
+                != ns(make(FocalLoss(5.0).__call__)))
+        # same-qualname lambdas calling different globals differ (the
+        # global name lives in co_names, not co_code)
+        l1 = lambda l, y: np.mean(l - y)  # noqa: E731
+        l2 = lambda l, y: np.sum(l - y)  # noqa: E731
+        assert ns(make(l1)) != ns(make(l2))
+
+        def lk1(l, y, *, weight=1.0):
+            return l
+
+        def lk2(l, y, *, weight=2.0):
+            return l
+
+        lk2.__qualname__ = lk1.__qualname__
+        assert ns(make(lk1)) != ns(make(lk2))
+
     def test_different_pretrained_weights_namespace_apart(
         self, vector_dataset, tmp_path
     ):

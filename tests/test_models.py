@@ -137,8 +137,11 @@ def test_fold_bgr_flip_into_stem_is_exact():
     )
     with jax.default_device(jax.local_devices(backend="cpu")[0]):
         variables = module.init(jax.random.PRNGKey(0), x_bgr)
-        folded = fold_bgr_flip_into_stem(variables)
+        folded = fold_bgr_flip_into_stem(variables, entry.preprocess_mode)
         assert folded is not None
+        # the gate lives in the helper: caffe-mode (channel-asymmetric
+        # preprocessing) must refuse to fold
+        assert fold_bgr_flip_into_stem(variables, "caffe") is None
         want = module.apply(
             variables, entry.preprocess(x_bgr[..., ::-1]), features_only=True
         )
