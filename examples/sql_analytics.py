@@ -1,7 +1,8 @@
 """SQL model serving + analytics — score images with a Keras model through
-``registerKerasImageUDF`` and aggregate the predictions with the engine's
-SQL dialect (WHERE / GROUP BY / HAVING / ORDER BY), the serving-side flow
-the reference enabled with TensorFrames UDFs + Spark SQL (SURVEY.md §3.3).
+``registerKerasImageUDF``, JOIN the scored view against a ground-truth
+table, and aggregate with the engine's SQL dialect (WHERE / JOIN / GROUP
+BY / HAVING / ORDER BY), the serving-side flow the reference enabled with
+TensorFrames UDFs + Spark SQL (SURVEY.md §3.3).
 
 Offline-safe (synthetic images, tiny random-init model).  Works on the
 real TPU or the virtual CPU mesh:
@@ -76,6 +77,31 @@ def main():
             f"best={r.best:.4f}"
         )
     assert len(out) == 3 and all(r.n == 8 for r in out)
+
+    # JOIN the predictions against a metadata/ground-truth table — the
+    # canonical "score then analyze" flow: which label class does each
+    # annotated category score highest on?
+    spark.createDataFrame(
+        [(0, "landscape"), (1, "portrait"), (2, "abstract")],
+        ["label", "category"],
+    ).createOrReplaceTempView("categories")
+    joined = spark.sql(
+        "SELECT category, COUNT(*) AS n, AVG(score) AS mean_score "
+        "FROM scored JOIN categories ON scored.label = categories.label "
+        "GROUP BY category ORDER BY mean_score DESC"
+    ).collect()
+    for r in joined:
+        print(f"category={r.category}  n={r.n}  mean={r.mean_score:.4f}")
+    assert len(joined) == 3 and all(r.n == 8 for r in joined)
+    # LEFT JOIN keeps rows whose label has no category annotation
+    spark.createDataFrame(
+        [(0, "landscape")], ["label", "category"]
+    ).createOrReplaceTempView("sparse_categories")
+    uncat = spark.sql(
+        "SELECT label, category FROM scored LEFT JOIN sparse_categories "
+        "ON scored.label = sparse_categories.label WHERE category IS NULL"
+    ).collect()
+    assert {r.label for r in uncat} == {1, 2}
     print("sql analytics OK")
 
 

@@ -19,6 +19,20 @@ import re
 from typing import List, Optional
 
 
+def _const_repr(c) -> str:
+    """Process-stable repr of a code constant: set/frozenset literals
+    (e.g. ``x in {"mean", "sum"}`` compiles a frozenset into co_consts)
+    repr in string-hash order, which is PYTHONHASHSEED-randomized —
+    render them sorted; tuples may nest them."""
+    if hasattr(c, "co_code"):
+        return _code_digest(c)
+    if isinstance(c, (set, frozenset)):
+        return "{" + ",".join(sorted(_const_repr(v) for v in c)) + "}"
+    if isinstance(c, tuple):
+        return "(" + ",".join(_const_repr(v) for v in c) + ")"
+    return repr(c)
+
+
 def _code_digest(code) -> str:
     """Digest of a function body: bytecode + referenced names + non-code
     consts + nested code objects.  Two defs with the same qualname but
@@ -28,10 +42,7 @@ def _code_digest(code) -> str:
     h = hashlib.sha256(code.co_code)
     h.update(repr(code.co_names).encode())
     for c in code.co_consts:
-        if hasattr(c, "co_code"):
-            h.update(_code_digest(c).encode())
-        else:
-            h.update(repr(c).encode())
+        h.update(_const_repr(c).encode())
     return h.hexdigest()[:8]
 
 

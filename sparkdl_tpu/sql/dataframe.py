@@ -24,6 +24,15 @@ from sparkdl_tpu.sql.types import (
 
 Partition = Dict[str, List[Any]]
 
+#: accepted ``how`` spellings (pyspark's aliases) -> canonical join type
+_JOIN_HOW: Dict[str, str] = {
+    "inner": "inner",
+    "left": "left", "left_outer": "left", "leftouter": "left",
+    "right": "right", "right_outer": "right", "rightouter": "right",
+    "outer": "full", "full": "full",
+    "full_outer": "full", "fullouter": "full",
+}
+
 
 def _partition_nrows(part: Partition) -> int:
     if not part:
@@ -260,6 +269,174 @@ class DataFrame:
         if not out_parts:
             out_parts = [{c: [] for c in self.columns}]
         return self._with_partitions(out_parts)
+
+    def join(
+        self,
+        other: "DataFrame",
+        on: "str | Sequence",
+        how: str = "inner",
+    ) -> "DataFrame":
+        """Equality hash join (the pyspark ``DataFrame.join`` subset the
+        reference's serving-analytics flow used — it delegated joins to
+        Spark SQL/Catalyst, SURVEY.md §1 L0 / §3.3).
+
+        ``on`` is a key column name or list of names present on BOTH
+        sides (the pyspark same-name form: the output carries each key
+        column once, keys first, as Spark's USING join does), or a list
+        of ``(left_name, right_name)`` pairs for differently-named keys
+        (both columns kept).  ``how`` is one of ``inner``,
+        ``left``/``left_outer``, ``right``/``right_outer``,
+        ``outer``/``full``/``full_outer``.
+
+        Spark semantics throughout: NULL keys never match anything (rows
+        with a NULL key still appear, unmatched, in the outer variants).
+        Non-key output name collisions raise immediately with the
+        offending names — rename or drop before joining (the engine's
+        column dicts cannot carry duplicate names the way Spark's
+        attribute-id plans can).
+
+        Execution is partition-wise: both sides hash-partition by key
+        into the same bucket count, then each bucket builds a map of the
+        right rows and probes with the left rows — no cross-bucket data
+        dependence, so buckets are output partitions.
+        """
+        how_key = _JOIN_HOW.get(str(how).lower())
+        if how_key is None:
+            raise ValueError(
+                f"Unsupported join type {how!r}; supported: "
+                f"{sorted(set(_JOIN_HOW))}"
+            )
+        if isinstance(on, str):
+            pairs = [(on, on)]
+        else:
+            entries = list(on)
+            if not entries:
+                raise ValueError("join requires at least one key column")
+            pairs = []
+            for e in entries:
+                if isinstance(e, str):
+                    pairs.append((e, e))
+                elif (isinstance(e, (tuple, list)) and len(e) == 2
+                        and all(isinstance(k, str) for k in e)):
+                    pairs.append((e[0], e[1]))
+                else:
+                    raise ValueError(
+                        f"join key entry {e!r} must be a column name or a "
+                        "(left_name, right_name) pair"
+                    )
+        return self._hash_join(other, pairs, how_key)
+
+    def _hash_join(
+        self,
+        other: "DataFrame",
+        pairs: "List[tuple]",
+        how: str,
+    ) -> "DataFrame":
+        """``pairs``: (left key, right key) per equality; ``how`` is one
+        of inner/left/right/full (already normalized)."""
+        left_keys = [l for l, _ in pairs]
+        right_keys = [r for _, r in pairs]
+        for k in left_keys:
+            if k not in self.columns:
+                raise KeyError(
+                    f"join key {k!r} not among left columns {self.columns}"
+                )
+        for k in right_keys:
+            if k not in other.columns:
+                raise KeyError(
+                    f"join key {k!r} not among right columns {other.columns}"
+                )
+        # same-named key pairs collapse to one output column (USING
+        # semantics); differently-named pairs keep both
+        shared = [l for l, r in pairs if l == r]
+        left_rest = [c for c in self.columns if c not in shared]
+        right_out = [c for c in other.columns if c not in shared]
+        clashes = sorted(set(left_rest) & set(right_out))
+        if clashes:
+            raise ValueError(
+                f"join would produce duplicate column names {clashes}; "
+                "rename (withColumnRenamed) or drop them on one side first"
+            )
+        out_cols = shared + left_rest + right_out
+
+        def rows_of(df: "DataFrame") -> List[tuple]:
+            names = df.columns
+            out = []
+            for part in df._partitions:
+                out.extend(zip(*[part[c] for c in names]) if names else [])
+            return out
+
+        l_idx = {c: i for i, c in enumerate(self.columns)}
+        r_idx = {c: i for i, c in enumerate(other.columns)}
+        n_buckets = max(
+            self.getNumPartitions(), other.getNumPartitions(), 1
+        )
+
+        def bucket_key(row, idx, keys):
+            key = tuple(row[idx[k]] for k in keys)
+            try:
+                return hash(key) % n_buckets, key
+            except TypeError:
+                raise TypeError(
+                    f"unhashable join key value {key!r}; join keys must "
+                    "be hashable scalars"
+                ) from None
+
+        left_buckets: List[List[tuple]] = [[] for _ in range(n_buckets)]
+        for row in rows_of(self):
+            b, key = bucket_key(row, l_idx, left_keys)
+            left_buckets[b].append((key, row))
+        # right buckets: key -> row indices, plus a matched flag per row
+        right_buckets: List[Dict[tuple, List[int]]] = [
+            {} for _ in range(n_buckets)
+        ]
+        right_rows: List[List[tuple]] = [[] for _ in range(n_buckets)]
+        for row in rows_of(other):
+            b, key = bucket_key(row, r_idx, right_keys)
+            i = len(right_rows[b])
+            right_rows[b].append(row)
+            if not any(v is None for v in key):  # NULL keys never match
+                right_buckets[b].setdefault(key, []).append(i)
+
+        out_parts: List[Partition] = []
+        for b in range(n_buckets):
+            cols: Partition = {c: [] for c in out_cols}
+            matched = [False] * len(right_rows[b])
+
+            def emit(lrow, rrow):
+                for c in shared:
+                    src = lrow if lrow is not None else rrow
+                    idx = l_idx if lrow is not None else r_idx
+                    cols[c].append(src[idx[c]])
+                for c in left_rest:
+                    cols[c].append(None if lrow is None else lrow[l_idx[c]])
+                for c in right_out:
+                    cols[c].append(None if rrow is None else rrow[r_idx[c]])
+
+            for key, lrow in left_buckets[b]:
+                hits = (
+                    right_buckets[b].get(key, [])
+                    if not any(v is None for v in key)
+                    else []
+                )
+                if hits:
+                    for i in hits:
+                        matched[i] = True
+                        emit(lrow, right_rows[b][i])
+                elif how in ("left", "full"):
+                    emit(lrow, None)
+            if how in ("right", "full"):
+                for i, rrow in enumerate(right_rows[b]):
+                    if not matched[i]:
+                        emit(None, rrow)
+            out_parts.append(cols)
+
+        schema = StructType()
+        for c in shared + left_rest:
+            schema.add(c, self._field_type(c))
+        for c in right_out:
+            schema.add(c, other._field_type(c))
+        return DataFrame(out_parts, schema, self.sparkSession)
 
     def union(self, other: "DataFrame") -> "DataFrame":
         if self.columns != other.columns:

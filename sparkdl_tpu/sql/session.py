@@ -209,21 +209,38 @@ class TPUSession:
         )
 
     # ------------------------------------------------------------------
-    # Minimal SQL: SELECT <exprs> FROM <view> [WHERE <pred>]
-    #   [GROUP BY <cols>] [HAVING <pred>] [ORDER BY <col> [ASC|DESC]]
-    #   [LIMIT n]
+    # Minimal SQL: SELECT <exprs> FROM <view> [<alias>]
+    #   [[INNER|LEFT [OUTER]|RIGHT [OUTER]|FULL [OUTER]] JOIN <view>
+    #    [<alias>] ON a.k = b.k [AND ...]]*
+    #   [WHERE <pred>] [GROUP BY <cols>] [HAVING <pred>]
+    #   [ORDER BY <col> [ASC|DESC]] [LIMIT n]
     #   expr := * | ident | fn(ident, ...) [AS alias]
     #           | COUNT(*|ident) | SUM/AVG/MEAN/MIN/MAX(ident) [AS alias]
     #   pred := comparisons composed with AND / OR / NOT / IN (...) / parens
     # ------------------------------------------------------------------
+    _KEYWORDS = (
+        r"WHERE|GROUP|HAVING|ORDER|LIMIT|JOIN|INNER|LEFT|RIGHT|FULL|ON"
+    )
     _SQL_RE = re.compile(
         r"^\s*SELECT\s+(?P<proj>.+?)\s+FROM\s+(?P<table>\w+)"
+        rf"(?:\s+(?:AS\s+)?(?!(?:{_KEYWORDS})\b)(?P<talias>\w+))?"
+        r"(?P<joins>(?:\s+(?:INNER\s+|LEFT\s+(?:OUTER\s+)?|RIGHT\s+"
+        r"(?:OUTER\s+)?|FULL\s+(?:OUTER\s+)?)?JOIN\s+\w+"
+        r"(?:\s+(?:AS\s+)?(?!ON\b)\w+)?\s+ON\s+[\w\s.=]+?)*)"
         r"(?:\s+WHERE\s+(?P<where>.+?))?"
         r"(?:\s+GROUP\s+BY\s+(?P<group>[\w\s,\.]+?))?"
         r"(?:\s+HAVING\s+(?P<having>.+?))?"
         r"(?:\s+ORDER\s+BY\s+(?P<order>\w+(?:\s+(?:ASC|DESC))?))?"
         r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
         re.IGNORECASE | re.DOTALL,
+    )
+    _JOIN_CLAUSE_RE = re.compile(
+        r"\s+(?P<how>INNER\s+|LEFT\s+(?:OUTER\s+)?|RIGHT\s+(?:OUTER\s+)?"
+        r"|FULL\s+(?:OUTER\s+)?)?JOIN\s+(?P<table>\w+)"
+        r"(?:\s+(?:AS\s+)?(?!ON\b)(?P<alias>\w+))?\s+ON\s+"
+        r"(?P<cond>[\w\s.=]+?)"
+        r"(?=\s+(?:INNER|LEFT|RIGHT|FULL|JOIN)\b|$)",
+        re.IGNORECASE,
     )
     _FUNC_RE = re.compile(r"^(?P<fn>\w+)\s*\(\s*(?P<args>[\w\s,\.\*]*)\s*\)$")
     _AGG_RE = re.compile(
@@ -236,6 +253,10 @@ class TPUSession:
         if not m:
             raise ValueError(f"Unsupported SQL (minimal dialect): {query!r}")
         out = self.table(m.group("table"))
+        if m.group("joins"):
+            out = self._apply_joins(
+                out, m.group("table"), m.group("talias"), m.group("joins")
+            )
         where = m.group("where")
         if where:
             out = out.filter(self._parse_predicate(where.strip()))
@@ -292,6 +313,66 @@ class TPUSession:
                 out = out.select(*exprs)
         if m.group("limit"):
             out = out.limit(int(m.group("limit")))
+        return out
+
+    def _apply_joins(
+        self,
+        out: DataFrame,
+        base_table: str,
+        base_alias: Optional[str],
+        joins_text: str,
+    ) -> DataFrame:
+        """Left-associative chain of ``JOIN <view> [alias] ON`` clauses.
+
+        Each ON condition is one or more qualified equalities
+        (``a.k = b.k AND ...``); one side of every equality must
+        reference an already-joined table (or its alias), the other the
+        table being joined.  Same-named key pairs collapse to one output
+        column (the engine's USING semantics — Spark SQL would keep both,
+        which a dict-backed partition cannot represent); differently-
+        named pairs keep both columns.  Downstream clauses (WHERE/GROUP
+        BY/projections) reference the joined columns UNQUALIFIED.
+        """
+        # an alias HIDES the table name (Spark semantics) — this is what
+        # makes self-joins expressible: FROM t a JOIN t b ON a.k = b.k
+        left_quals = {base_alias} if base_alias else {base_table}
+        for jm in self._JOIN_CLAUSE_RE.finditer(joins_text):
+            how = (jm.group("how") or "inner").strip().split()[0].lower()
+            rtable, ralias = jm.group("table"), jm.group("alias")
+            right = self.table(rtable)
+            rquals = {ralias} if ralias else {rtable}
+            overlap = sorted(rquals & left_quals)
+            if overlap:
+                raise ValueError(
+                    f"JOIN: qualifier(s) {overlap} already name a table "
+                    "on the left side; alias the second occurrence "
+                    "(self-joins need distinct aliases)"
+                )
+            pairs = []
+            for clause in re.split(
+                r"\s+AND\s+", jm.group("cond").strip(), flags=re.IGNORECASE
+            ):
+                cm = re.match(
+                    r"^\s*(\w+)\.(\w+)\s*=\s*(\w+)\.(\w+)\s*$", clause
+                )
+                if not cm:
+                    raise ValueError(
+                        f"Unsupported JOIN condition {clause!r}: use "
+                        "qualified equalities like a.k = b.k [AND ...]"
+                    )
+                q1, c1, q2, c2 = cm.groups()
+                if q1 in left_quals and q2 in rquals:
+                    pairs.append((c1, c2))
+                elif q2 in left_quals and q1 in rquals:
+                    pairs.append((c2, c1))
+                else:
+                    raise ValueError(
+                        f"JOIN condition {clause!r}: one side must "
+                        f"reference the left tables {sorted(left_quals)} "
+                        f"and the other {sorted(rquals)}"
+                    )
+            out = out._hash_join(right, pairs, how)
+            left_quals |= rquals
         return out
 
     @staticmethod

@@ -255,6 +255,33 @@ class TestFlaxCheckpointing:
         lk2.__qualname__ = lk1.__qualname__
         assert ns(make(lk1)) != ns(make(lk2))
 
+    def test_stable_description_survives_hash_randomization(self):
+        """A callable whose body holds a set literal (frozenset in
+        co_consts, repr order PYTHONHASHSEED-dependent) must describe
+        identically across interpreter processes."""
+        import subprocess
+        import sys
+
+        prog = (
+            "from sparkdl_tpu.estimators.checkpointing import "
+            "stable_description\n"
+            "def loss(l, y, reduction='mean'):\n"
+            "    if reduction in {'mean', 'sum', 'none', 'batch'}:\n"
+            "        return l\n"
+            "    return y\n"
+            "print(stable_description(loss))\n"
+        )
+        outs = {
+            subprocess.run(
+                [sys.executable, "-c", prog],
+                env={**__import__("os").environ, "PYTHONHASHSEED": seed,
+                     "JAX_PLATFORMS": "cpu"},
+                capture_output=True, text=True, check=True,
+            ).stdout.strip()
+            for seed in ("1", "2", "3")
+        }
+        assert len(outs) == 1, f"description varies across seeds: {outs}"
+
     def test_different_pretrained_weights_namespace_apart(
         self, vector_dataset, tmp_path
     ):

@@ -410,3 +410,207 @@ class TestGroupByAggregates:
                 "SELECT label, SUM(score) AS s FROM agg_t GROUP BY label "
                 "HAVING cnt > 1"
             )
+
+
+class TestJoins:
+    """DataFrame.join + SQL JOIN...ON (the reference delegated joins to
+    Spark SQL/Catalyst — SURVEY.md §1 L0, §3.3; semantics pinned here
+    follow documented Spark behavior: USING-form key dedup with keys
+    first, NULL keys never match, outer variants keep unmatched rows)."""
+
+    @pytest.fixture()
+    def preds(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [(1, 0.9, "cat"), (2, 0.4, "dog"), (3, 0.7, "cat"),
+             (None, 0.5, "bird")],
+            ["img_id", "score", "pred"],
+        )
+        df.createOrReplaceTempView("preds")
+        return df
+
+    @pytest.fixture()
+    def labels(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [(1, "cat"), (2, "cat"), (4, "dog"), (None, "fish")],
+            ["img_id", "truth"],
+        )
+        df.createOrReplaceTempView("labels")
+        return df
+
+    # -- DataFrame API ---------------------------------------------------
+    def test_inner_join_dedupes_key_keys_first(self, preds, labels):
+        out = preds.join(labels, on="img_id")
+        assert out.columns == ["img_id", "score", "pred", "truth"]
+        rows = sorted(out.collect(), key=lambda r: r.img_id)
+        assert [(r.img_id, r.pred, r.truth) for r in rows] == [
+            (1, "cat", "cat"), (2, "dog", "cat")
+        ]
+
+    def test_null_keys_never_match(self, preds, labels):
+        # both sides have an img_id=None row; SQL equality on NULL is
+        # not true, so no combined row may appear
+        out = preds.join(labels, on="img_id")
+        assert all(r.img_id is not None for r in out.collect())
+
+    def test_left_outer_keeps_unmatched_and_null_keys(self, preds, labels):
+        out = preds.join(labels, on="img_id", how="left")
+        rows = out.collect()
+        assert len(rows) == 4  # every preds row survives
+        by_pred = {r.pred: r for r in rows}
+        assert by_pred["cat"].truth in ("cat", None)  # img 1 or 3
+        assert by_pred["bird"].img_id is None and by_pred["bird"].truth is None
+        unmatched = [r for r in rows if r.truth is None]
+        assert {r.score for r in unmatched} == {0.7, 0.5}
+
+    def test_right_and_full_outer(self, preds, labels):
+        right = preds.join(labels, on="img_id", how="right_outer")
+        rrows = right.collect()
+        assert len(rrows) == 4  # every labels row survives
+        assert {r.truth for r in rrows} == {"cat", "dog", "fish"}
+        # img_id=4 has no pred: left columns null, key from the right
+        lbl4 = next(r for r in rrows if r.img_id == 4)
+        assert lbl4.score is None and lbl4.pred is None
+
+        full = preds.join(labels, on="img_id", how="outer")
+        # 2 matches + 2 left-only (3, None) + 2 right-only (4, None)
+        assert len(full.collect()) == 6
+
+    def test_pair_keys_keep_both_columns(self, tpu_session, preds):
+        meta = tpu_session.createDataFrame(
+            [(1, "s3://a"), (3, "s3://b")], ["image", "origin"]
+        )
+        out = preds.join(meta, on=[("img_id", "image")])
+        assert out.columns == ["img_id", "score", "pred", "image", "origin"]
+        rows = sorted(out.collect(), key=lambda r: r.img_id)
+        assert [(r.img_id, r.image, r.origin) for r in rows] == [
+            (1, 1, "s3://a"), (3, 3, "s3://b")
+        ]
+
+    def test_duplicate_rows_multiply(self, tpu_session):
+        a = tpu_session.createDataFrame([(1, "x"), (1, "y")], ["k", "a"])
+        b = tpu_session.createDataFrame([(1, "p"), (1, "q")], ["k", "b"])
+        out = a.join(b, on="k")
+        assert len(out.collect()) == 4  # cross product within the key
+
+    def test_join_errors(self, preds, labels, tpu_session):
+        with pytest.raises(KeyError, match="join key 'nope'"):
+            preds.join(labels, on="nope")
+        with pytest.raises(ValueError, match="Unsupported join type"):
+            preds.join(labels, on="img_id", how="sideways")
+        # non-key name collision ('pred' vs a second 'pred') errors with
+        # the offending names instead of silently shadowing
+        dup = tpu_session.createDataFrame(
+            [(1, "cat")], ["img_id", "pred"]
+        )
+        with pytest.raises(ValueError, match=r"duplicate column names \['pred'\]"):
+            preds.join(dup, on="img_id")
+
+    def test_join_partitioned_inputs(self, tpu_session):
+        n = 100
+        a = tpu_session.createDataFrame(
+            [(i, i * 2) for i in range(n)], ["k", "a"], numPartitions=7
+        )
+        b = tpu_session.createDataFrame(
+            [(i, i * 3) for i in range(0, n, 2)], ["k", "b"],
+            numPartitions=3,
+        )
+        out = a.join(b, on="k")
+        rows = sorted(out.collect(), key=lambda r: r.k)
+        assert len(rows) == 50
+        assert all(r.a == r.k * 2 and r.b == r.k * 3 for r in rows)
+        assert out.getNumPartitions() == 7  # bucketed by the wider side
+
+    # -- SQL dialect -----------------------------------------------------
+    def test_sql_inner_join(self, preds, labels, tpu_session):
+        out = tpu_session.sql(
+            "SELECT img_id, pred, truth FROM preds "
+            "JOIN labels ON preds.img_id = labels.img_id"
+        )
+        rows = sorted(out.collect(), key=lambda r: r.img_id)
+        assert [(r.img_id, r.pred, r.truth) for r in rows] == [
+            (1, "cat", "cat"), (2, "dog", "cat")
+        ]
+
+    def test_sql_left_join_with_where(self, preds, labels, tpu_session):
+        out = tpu_session.sql(
+            "SELECT img_id, score, truth FROM preds "
+            "LEFT OUTER JOIN labels ON preds.img_id = labels.img_id "
+            "WHERE truth IS NULL"
+        )
+        assert {r.score for r in out.collect()} == {0.7, 0.5}
+
+    def test_sql_join_aliases(self, preds, labels, tpu_session):
+        out = tpu_session.sql(
+            "SELECT img_id, pred, truth FROM preds p "
+            "JOIN labels l ON p.img_id = l.img_id"
+        )
+        assert len(out.collect()) == 2
+
+    def test_sql_join_group_by(self, preds, labels, tpu_session):
+        # accuracy-style analytics over the joined result
+        out = tpu_session.sql(
+            "SELECT truth, COUNT(*) AS n, AVG(score) AS mean_score "
+            "FROM preds JOIN labels ON preds.img_id = labels.img_id "
+            "GROUP BY truth HAVING n >= 1 ORDER BY truth"
+        )
+        rows = out.collect()
+        assert [(r.truth, r.n) for r in rows] == [("cat", 2)]
+        assert rows[0].mean_score == pytest.approx((0.9 + 0.4) / 2)
+
+    def test_sql_three_table_chain(self, preds, labels, tpu_session):
+        tpu_session.createDataFrame(
+            [("cat", 1), ("dog", 2)], ["truth", "species_id"]
+        ).createOrReplaceTempView("species")
+        out = tpu_session.sql(
+            "SELECT img_id, species_id FROM preds "
+            "JOIN labels ON preds.img_id = labels.img_id "
+            "JOIN species ON labels.truth = species.truth"
+        )
+        rows = sorted(out.collect(), key=lambda r: r.img_id)
+        assert [(r.img_id, r.species_id) for r in rows] == [(1, 1), (2, 1)]
+
+    def test_sql_self_join_with_aliases(self, preds, tpu_session):
+        # aliases hide the table name (Spark semantics), so self-joins
+        # with distinct aliases resolve; same-named NON-key columns
+        # still collide by design (the engine's duplicate-name error),
+        # so a same-table self-join keys on every shared column
+        out = tpu_session.sql(
+            "SELECT pred FROM preds a JOIN preds b ON a.img_id = b.img_id "
+            "AND a.score = b.score AND a.pred = b.pred"
+        )
+        assert len(out.collect()) == 3  # 1, 2, 3 match themselves
+
+    def test_mixed_on_list(self, tpu_session, preds):
+        meta = tpu_session.createDataFrame(
+            [(1, "cat", "s3://a")], ["image", "pred", "origin"]
+        )
+        out = preds.join(meta, on=["pred", ("img_id", "image")])
+        rows = out.collect()
+        assert out.columns == [
+            "pred", "img_id", "score", "image", "origin"
+        ]
+        assert [(r.img_id, r.pred) for r in rows] == [(1, "cat")]
+        with pytest.raises(ValueError, match="join key entry"):
+            preds.join(meta, on=[("img_id", "image", "extra")])
+        with pytest.raises(ValueError, match="Unsupported JOIN condition"):
+            tpu_session.sql(
+                "SELECT img_id FROM preds JOIN labels ON img_id = img_id"
+            )
+        with pytest.raises(ValueError, match="one side must reference"):
+            tpu_session.sql(
+                "SELECT img_id FROM preds "
+                "JOIN labels ON mystery.img_id = labels.img_id"
+            )
+        with pytest.raises(ValueError, match="distinct aliases"):
+            tpu_session.sql(
+                "SELECT img_id FROM preds "
+                "JOIN preds ON preds.img_id = preds.img_id"
+            )
+
+    def test_sql_without_join_still_parses(self, preds, tpu_session):
+        # the FROM-alias and joins extensions must not disturb plain
+        # queries (regression: alias regex could swallow WHERE)
+        out = tpu_session.sql(
+            "SELECT img_id FROM preds WHERE score > 0.5 ORDER BY img_id"
+        )
+        assert [r.img_id for r in out.collect()] == [1, 3]

@@ -351,3 +351,49 @@ class TestServingPipeline:
         out = np.stack([np.asarray(r.f.toArray()) for r in got])
         want = _oracle(keras_model, [{"image": r} for r in rows])
         np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_scored_view_joins_labels(
+    tpu_session, image_df, keras_model, keras_model_file
+):
+    """The reference's canonical serving-analytics flow (SURVEY.md §3.3):
+    score images with a registered model UDF, then JOIN the scored view
+    against a labels table and aggregate — in both the DataFrame API and
+    the SQL dialect."""
+    from sparkdl_tpu.udf import registerKerasImageUDF
+
+    registerKerasImageUDF("join_cnn_udf", keras_model_file)
+    image_df.createOrReplaceTempView("images_join")
+    scored = tpu_session.sql(
+        "SELECT filePath, join_cnn_udf(image) AS preds FROM images_join"
+    )
+    scored.createOrReplaceTempView("scored")
+
+    paths = [r.filePath for r in image_df.collect()]
+    labels = tpu_session.createDataFrame(
+        # one known path, one unknown path, one NULL path
+        [(paths[0], "cat"), ("/nope.png", "dog"), (None, "fish")],
+        ["filePath", "truth"],
+    )
+    labels.createOrReplaceTempView("truth_tbl")
+
+    # API form: left join keeps every scored row; only paths[0] matches
+    api = scored.join(labels, on="filePath", how="left")
+    rows = api.collect()
+    assert len(rows) == len(paths)
+    matched = [r for r in rows if r.truth is not None]
+    assert [r.filePath for r in matched] == [paths[0]]
+    # predictions survive the join unchanged
+    want = _oracle(keras_model, image_df.collect())
+    by_path = {r.filePath: np.asarray(r.preds) for r in rows}
+    np.testing.assert_allclose(
+        by_path[paths[0]], want[0], rtol=1e-4, atol=1e-4
+    )
+
+    # SQL form, aggregated over the joined result
+    agg = tpu_session.sql(
+        "SELECT truth, COUNT(*) AS n FROM scored "
+        "JOIN truth_tbl ON scored.filePath = truth_tbl.filePath "
+        "GROUP BY truth"
+    ).collect()
+    assert [(r.truth, r.n) for r in agg] == [("cat", 1)]
