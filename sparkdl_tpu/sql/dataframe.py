@@ -590,6 +590,8 @@ _AGG_FNS: Dict[str, Callable[[List[Any]], Any]] = {
     "max": lambda vs: max(vs) if vs else None,
 }
 _AGG_FNS["mean"] = _AGG_FNS["avg"]
+# COUNT(DISTINCT c): nulls were already excluded, so this is set-size
+_AGG_FNS["count_distinct"] = lambda vs: len(set(vs))
 
 
 class GroupedData:

@@ -87,6 +87,14 @@ class Column:
     def __truediv__(self, other):
         return self._binop(other, operator.truediv, "/")
 
+    def __neg__(self):
+        return Column(
+            lambda cols, n: [
+                None if v is None else -v for v in self._eval(cols, n)
+            ],
+            f"(- {self._name})",
+        )
+
     def __eq__(self, other):  # type: ignore[override]
         return self._binop(other, operator.eq, "==")
 
@@ -163,6 +171,41 @@ class Column:
                 None if v is None else v in vals for v in self._eval(cols, n)
             ],
             "(%s IN (%s))" % (self._name, ", ".join(map(repr, values))),
+        )
+
+    def like(self, pattern: str) -> "Column":
+        """SQL ``LIKE``: ``%`` matches any run, ``_`` any one character,
+        anchored to the whole string; NULL input yields NULL (pyspark
+        ``Column.like`` analog)."""
+        import re as _re
+
+        rx = _re.compile(
+            "".join(
+                ".*" if ch == "%" else "." if ch == "_" else _re.escape(ch)
+                for ch in pattern
+            ),
+            _re.DOTALL,
+        )
+
+        def match(v):
+            if v is None:
+                return None
+            if not isinstance(v, str):
+                raise TypeError(
+                    f"LIKE requires a string operand, got {type(v).__name__}"
+                )
+            return rx.fullmatch(v) is not None
+
+        return Column(
+            lambda cols, n: [match(v) for v in self._eval(cols, n)],
+            f"({self._name} LIKE {pattern!r})",
+        )
+
+    def between(self, lower, upper) -> "Column":
+        """``lower <= col <= upper`` with SQL null semantics (pyspark
+        ``Column.between`` analog; what SQL ``BETWEEN`` lowers to)."""
+        return ((self >= lower) & (self <= upper)).alias(
+            f"({self._name} BETWEEN {lower} AND {upper})"
         )
 
     def isNull(self):

@@ -221,12 +221,20 @@ class TPUSession:
     _KEYWORDS = (
         r"WHERE|GROUP|HAVING|ORDER|LIMIT|JOIN|INNER|LEFT|RIGHT|FULL|ON"
     )
+    # The ON condition is a sequence of non-keyword tokens (each token
+    # guarded by a lookahead) rather than a lazy [\w\s.=]+? blob: a blob
+    # could also absorb a following "JOIN ..." clause, making the outer
+    # (...)* ambiguous — which is catastrophic-backtracking territory on
+    # malformed queries (measured ~4x slower per 2 extra JOIN clauses).
+    _ON_COND = (
+        rf"(?:\s*(?!(?:{_KEYWORDS})\b)[\w.=]+)+"
+    )
     _SQL_RE = re.compile(
         r"^\s*SELECT\s+(?P<proj>.+?)\s+FROM\s+(?P<table>\w+)"
         rf"(?:\s+(?:AS\s+)?(?!(?:{_KEYWORDS})\b)(?P<talias>\w+))?"
         r"(?P<joins>(?:\s+(?:INNER\s+|LEFT\s+(?:OUTER\s+)?|RIGHT\s+"
         r"(?:OUTER\s+)?|FULL\s+(?:OUTER\s+)?)?JOIN\s+\w+"
-        r"(?:\s+(?:AS\s+)?(?!ON\b)\w+)?\s+ON\s+[\w\s.=]+?)*)"
+        rf"(?:\s+(?:AS\s+)?(?!ON\b)\w+)?\s+ON\b{_ON_COND})*)"
         r"(?:\s+WHERE\s+(?P<where>.+?))?"
         r"(?:\s+GROUP\s+BY\s+(?P<group>[\w\s,\.]+?))?"
         r"(?:\s+HAVING\s+(?P<having>.+?))?"
@@ -237,15 +245,17 @@ class TPUSession:
     _JOIN_CLAUSE_RE = re.compile(
         r"\s+(?P<how>INNER\s+|LEFT\s+(?:OUTER\s+)?|RIGHT\s+(?:OUTER\s+)?"
         r"|FULL\s+(?:OUTER\s+)?)?JOIN\s+(?P<table>\w+)"
-        r"(?:\s+(?:AS\s+)?(?!ON\b)(?P<alias>\w+))?\s+ON\s+"
-        r"(?P<cond>[\w\s.=]+?)"
-        r"(?=\s+(?:INNER|LEFT|RIGHT|FULL|JOIN)\b|$)",
+        r"(?:\s+(?:AS\s+)?(?!ON\b)(?P<alias>\w+))?\s+ON\b"
+        rf"(?P<cond>{_ON_COND})",
         re.IGNORECASE,
     )
-    _FUNC_RE = re.compile(r"^(?P<fn>\w+)\s*\(\s*(?P<args>[\w\s,\.\*]*)\s*\)$")
     _AGG_RE = re.compile(
-        r"^(?P<fn>count|sum|avg|mean|min|max)\s*\(\s*(?P<arg>\*|\w+)\s*\)$",
-        re.IGNORECASE,
+        r"^(?P<fn>count|sum|avg|mean|min|max)\s*\(\s*"
+        r"(?P<distinct>DISTINCT\s+)?(?P<arg>\*|.+?)\s*\)$",
+        re.IGNORECASE | re.DOTALL,
+    )
+    _AGG_CALL_RE = re.compile(
+        r"\b(?P<fn>count|sum|avg|mean|min|max)\s*\(", re.IGNORECASE
     )
 
     def sql(self, query: str) -> DataFrame:
@@ -253,13 +263,16 @@ class TPUSession:
         if not m:
             raise ValueError(f"Unsupported SQL (minimal dialect): {query!r}")
         out = self.table(m.group("table"))
+        # table names/aliases usable as column qualifiers downstream
+        # (WHERE t.score > 1 resolves t.score -> score)
+        quals = {m.group("talias") or m.group("table")}
         if m.group("joins"):
-            out = self._apply_joins(
+            out, quals = self._apply_joins(
                 out, m.group("table"), m.group("talias"), m.group("joins")
             )
         where = m.group("where")
         if where:
-            out = out.filter(self._parse_predicate(where.strip()))
+            out = out.filter(self._parse_predicate(where.strip(), quals))
 
         proj_raw = [
             raw.strip() for raw in self._split_projections(m.group("proj"))
@@ -287,7 +300,8 @@ class TPUSession:
 
         if is_agg:
             out = self._sql_aggregate(
-                out, proj_raw, group, having=m.group("having")
+                out, proj_raw, group, having=m.group("having"),
+                qualifiers=quals,
             )
             if order_col is not None:
                 if order_col not in out.columns:
@@ -297,20 +311,30 @@ class TPUSession:
                     )
                 out = out.orderBy(order_col, ascending=ascending)
         else:
+            star = m.group("proj").strip() == "*"
+            exprs: List[Column] = (
+                [] if star
+                else [self._parse_projection(raw, quals) for raw in proj_raw]
+            )
+            sort_after = False
             if order_col is not None:
-                # sort BEFORE projecting (standard SQL: the sort column
-                # need not be selected; select preserves row order)
-                if order_col not in out.columns:
+                # SQL resolution order: a select-list alias wins over an
+                # input column of the same name (sort AFTER projecting);
+                # otherwise the sort column need not be selected (sort
+                # before — select preserves row order)
+                if any(e._name == order_col for e in exprs):
+                    sort_after = True
+                elif order_col not in out.columns:
                     raise ValueError(
                         f"ORDER BY {order_col!r}: no such column "
-                        f"({out.columns})"
+                        f"({out.columns}) or projection alias"
                     )
+            if order_col is not None and not sort_after:
                 out = out.orderBy(order_col, ascending=ascending)
-            if m.group("proj").strip() != "*":
-                exprs: List[Column] = [
-                    self._parse_projection(raw) for raw in proj_raw
-                ]
+            if not star:
                 out = out.select(*exprs)
+            if sort_after:
+                out = out.orderBy(order_col, ascending=ascending)
         if m.group("limit"):
             out = out.limit(int(m.group("limit")))
         return out
@@ -321,8 +345,9 @@ class TPUSession:
         base_table: str,
         base_alias: Optional[str],
         joins_text: str,
-    ) -> DataFrame:
+    ):
         """Left-associative chain of ``JOIN <view> [alias] ON`` clauses.
+        Returns ``(joined_df, qualifier_names)``.
 
         Each ON condition is one or more qualified equalities
         (``a.k = b.k AND ...``); one side of every equality must
@@ -373,7 +398,7 @@ class TPUSession:
                     )
             out = out._hash_join(right, pairs, how)
             left_quals |= rquals
-        return out
+        return out, left_quals
 
     @staticmethod
     def _strip_alias(text: str):
@@ -384,16 +409,56 @@ class TPUSession:
             return m.group("expr").strip(), m.group("alias")
         return text, None
 
+    def _agg_pair(
+        self,
+        df: DataFrame,
+        fn_key: str,
+        distinct: bool,
+        arg: str,
+        label: str,
+        tmp_idx: List[int],
+        qualifiers=frozenset(),
+    ):
+        """Normalize one aggregate call into a ``GroupedData._aggregate``
+        pair, materializing expression arguments (``AVG(score * 100)``)
+        as derived columns first.  Returns ``(df, pair)``."""
+        if fn_key == "mean":
+            fn_key = "avg"
+        if distinct:
+            if fn_key != "count":
+                raise ValueError(
+                    f"DISTINCT is supported with COUNT only, not "
+                    f"{fn_key.upper()}"
+                )
+            fn_key = "count_distinct"
+        if arg == "*":
+            if fn_key != "count":
+                raise ValueError(f"{fn_key}(*) is not defined; use a column")
+            return df, ("*", fn_key, label)
+        if not re.fullmatch(r"\w+", arg):
+            expr = _PredicateParser(
+                arg, udf_registry=self.udf, qualifiers=qualifiers
+            ).parse_expression()
+            tmp = f"__agg_arg_{tmp_idx[0]}"
+            tmp_idx[0] += 1
+            df = df.withColumn(tmp, expr)
+            return df, (tmp, fn_key, label)
+        return df, (arg, fn_key, label)
+
     def _sql_aggregate(
         self,
         df: DataFrame,
         proj_raw: List[str],
         group: Optional[str],
         having: Optional[str] = None,
+        qualifiers=frozenset(),
     ) -> DataFrame:
         """The GROUP BY path: every projection must be a group key or an
         aggregate call (as in Spark); aliases rename the pyspark-style
-        ``fn(col)`` output columns."""
+        ``fn(col)`` output columns.  Aggregate arguments may be
+        arithmetic expressions (``AVG(score * 100)``) or
+        ``COUNT(DISTINCT col)``; HAVING may use direct aggregate calls
+        (computed as hidden columns and dropped after the filter)."""
         keys = (
             [k.strip() for k in group.split(",") if k.strip()]
             if group
@@ -402,18 +467,25 @@ class TPUSession:
         pairs = []  # (col, fn, OUTPUT name) for GroupedData._aggregate
         renames = []  # (key, alias) — keys only; aggregates alias directly
         passthrough = []
+        tmp_idx = [0]
         for raw in proj_raw:
             expr, alias = self._strip_alias(raw)
             am = self._AGG_RE.match(expr)
             if am:
                 fn_key = am.group("fn").lower()
-                if fn_key == "mean":
-                    fn_key = "avg"
-                arg = am.group("arg")
+                arg = am.group("arg").strip()
+                distinct = bool(am.group("distinct"))
                 # the alias IS the output column (aliasing after the fact
                 # breaks for repeated aggregates — duplicate default
                 # labels would collide)
-                pairs.append((arg, fn_key, alias or f"{fn_key}({arg})"))
+                label = alias or (
+                    f"{fn_key}(DISTINCT {arg})" if distinct
+                    else f"{fn_key}({arg})"
+                )
+                df, pair = self._agg_pair(
+                    df, fn_key, distinct, arg, label, tmp_idx, qualifiers
+                )
+                pairs.append(pair)
             elif expr in keys:
                 if alias:
                     renames.append((expr, alias))
@@ -425,20 +497,34 @@ class TPUSession:
                 )
         if not pairs:
             raise ValueError("GROUP BY query needs at least one aggregate")
+        hidden: List[str] = []
+        having_text = having.strip() if having else None
+        if having_text:
+            # direct aggregate calls in HAVING (COUNT(DISTINCT origin) >
+            # 1) compute as hidden output columns; the clause text is
+            # rewritten to reference them before predicate parsing
+            having_text, df, extra = self._rewrite_having_aggs(
+                having_text, df, tmp_idx, qualifiers
+            )
+            for pair in extra:
+                pairs.append(pair)
+                hidden.append(pair[2])
         out = df.groupBy(*keys)._aggregate(pairs)
-        if having:
+        if having_text:
             # standard SQL: HAVING may reference any group key (even one
-            # the projection drops) or an aggregate BY ITS ALIAS — the
-            # default ``fn(col)`` output labels are not parseable as
-            # predicate identifiers, so unaliased aggregates need an AS
+            # the projection drops), an aggregate BY ITS ALIAS, or a
+            # direct aggregate call (rewritten above)
             try:
-                predicate = self._parse_predicate(having.strip())
+                predicate = self._parse_predicate(having_text, qualifiers)
                 out = out.filter(predicate)
             except (ValueError, KeyError) as e:
                 raise ValueError(
                     f"Unsupported HAVING clause {having.strip()!r}: {e}; "
-                    "reference group keys or aliased aggregates (use AS)"
+                    "reference group keys, aliased aggregates (use AS) or "
+                    "direct aggregate calls"
                 ) from None
+        for h in hidden:
+            out = out.drop(h)
         # drop group keys the projection didn't ask for (AFTER the HAVING
         # filter, which may reference them)
         for k in keys:
@@ -447,6 +533,54 @@ class TPUSession:
         for key, alias in renames:
             out = out.withColumnRenamed(key, alias)
         return out
+
+    def _rewrite_having_aggs(
+        self, text: str, df: DataFrame, tmp_idx: List[int],
+        qualifiers=frozenset(),
+    ):
+        """Replace direct aggregate calls in a HAVING clause with hidden
+        output-column references.  Returns ``(rewritten_text, df,
+        extra_pairs)``; quoted strings are left untouched."""
+        # mark string-literal spans so `count(` inside a quote survives
+        spans = [
+            m.span()
+            for m in re.finditer(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"",
+                                 text)
+        ]
+
+        def in_string(i: int) -> bool:
+            return any(lo <= i < hi for lo, hi in spans)
+
+        out_text, pos, extra = [], 0, []
+        for m in self._AGG_CALL_RE.finditer(text):
+            if m.start() < pos or in_string(m.start()):
+                continue
+            depth, j = 1, m.end()
+            while j < len(text) and depth:
+                depth += text[j] == "("
+                depth -= text[j] == ")"
+                j += 1
+            if depth:
+                raise ValueError(
+                    f"Unbalanced parentheses in HAVING: {text!r}"
+                )
+            inner = text[m.end():j - 1].strip()
+            fn_key = m.group("fn").lower()
+            dm = re.match(r"^DISTINCT\s+(?P<rest>.+)$", inner,
+                          re.IGNORECASE | re.DOTALL)
+            distinct = dm is not None
+            arg = dm.group("rest").strip() if dm else inner
+            label = f"__having_{tmp_idx[0]}"
+            tmp_idx[0] += 1
+            df, pair = self._agg_pair(
+                df, fn_key, distinct, arg, label, tmp_idx, qualifiers
+            )
+            extra.append(pair)
+            out_text.append(text[pos:m.start()])
+            out_text.append(label)
+            pos = j
+        out_text.append(text[pos:])
+        return "".join(out_text), df, extra
 
     @staticmethod
     def _split_projections(proj: str) -> List[str]:
@@ -462,25 +596,34 @@ class TPUSession:
         parts.append("".join(cur))
         return parts
 
-    def _parse_projection(self, text: str) -> Column:
+    def _parse_projection(self, text: str, qualifiers=frozenset()) -> Column:
         alias = None
         m_as = re.match(r"^(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", text, re.IGNORECASE)
         if m_as:
             text, alias = m_as.group("expr").strip(), m_as.group("alias")
         if text == "*":
             raise ValueError("'*' must be the only projection")
-        m_fn = self._FUNC_RE.match(text)
-        if m_fn:
-            fn_name = m_fn.group("fn")
-            args = [a.strip() for a in m_fn.group("args").split(",") if a.strip()]
-            expr = self.udf.get(fn_name)(*[col(a) for a in args])
-        else:
+        m_q = re.fullmatch(r"(\w+)\.(\w+)", text)
+        if m_q and m_q.group(1) in qualifiers:
+            # qualified simple column (t.score): output name is the bare
+            # column, as in Spark
+            expr = col(m_q.group(2))
+        elif re.fullmatch(r"\w+", text):
             expr = col(text)
+        else:
+            # full expression projection: arithmetic over columns,
+            # literals and registered-UDF calls (`score * 100`,
+            # `my_udf(image)`, `a + b / 2`)
+            expr = _PredicateParser(
+                text, udf_registry=self.udf, qualifiers=qualifiers
+            ).parse_expression()
+            expr = expr.alias(re.sub(r"\s+", " ", text))
         return expr.alias(alias) if alias else expr
 
-    @staticmethod
-    def _parse_predicate(text: str) -> Column:
-        return _PredicateParser(text).parse()
+    def _parse_predicate(self, text: str, qualifiers=frozenset()) -> Column:
+        return _PredicateParser(
+            text, udf_registry=self.udf, qualifiers=qualifiers
+        ).parse()
 
     def stop(self):
         TPUSession._active = None
@@ -501,34 +644,53 @@ class TPUSession:
 
 
 class _PredicateParser:
-    """Recursive-descent WHERE parser lowering to :class:`Column` combinators.
+    """Recursive-descent WHERE/expression parser lowering to
+    :class:`Column` combinators.
 
     Grammar (SQL92 subset; precedence NOT > AND > OR, as in Spark SQL):
 
         pred   := and_e (OR and_e)*
         and_e  := not_e (AND not_e)*
         not_e  := NOT not_e | '(' pred ')' | cmp
-        cmp    := ref ( op literal
+        cmp    := sum ( op sum
                       | [NOT] IN '(' literal (',' literal)* ')'
-                      | IS [NOT] NULL )
+                      | IS [NOT] NULL
+                      | [NOT] LIKE str
+                      | [NOT] BETWEEN sum AND sum )
+        sum    := term (('+'|'-') term)*       -- arithmetic expressions
+        term   := factor (('*'|'/') factor)*
+        factor := '-' factor | literal | ref | fn '(' sum (',' sum)* ')'
+                | '(' sum ')'
         ref    := ident ('.' ident)*         -- struct fields: image.height
         op     := = | == | != | <> | <= | >= | < | >
 
+    ``fn`` resolves against the session's UDF registry (model-serving
+    UDFs compose into expressions: ``score_img(image) * 100``).
+
     Reference analog: the reference delegated WHERE to Spark Catalyst; this
     covers the predicate shapes its examples/tests exercise (e.g.
-    ``label IN (0,1) AND height > 100``).
+    ``label IN (0,1) AND height > 100``, ``origin LIKE '%.png'``,
+    ``score * 100 BETWEEN 10 AND 90``).
     """
 
     _TOKEN_RE = re.compile(
-        r"\s*(?:(?P<num>-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)"
+        r"\s*(?:(?P<num>(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)"
         r"|(?P<str>'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\")"
         r"|(?P<ident>\w+)"
         r"|(?P<op><=|>=|==|!=|<>|=|<|>)"
+        r"|(?P<arith>[+\-*/])"
         r"|(?P<punct>[(),.]))"
     )
 
-    def __init__(self, text: str):
+    _AGG_NAMES = frozenset(
+        ("count", "sum", "avg", "mean", "min", "max")
+    )
+
+    def __init__(self, text: str, udf_registry=None,
+                 qualifiers=frozenset()):
         self.text = text
+        self.udf = udf_registry
+        self.qualifiers = qualifiers
         self.tokens: List[tuple] = []
         pos = 0
         while pos < len(text):
@@ -579,6 +741,17 @@ class _PredicateParser:
             )
         return out
 
+    def parse_expression(self) -> Column:
+        """Parse the whole text as one arithmetic/value expression (the
+        projection entry point — no boolean connectives)."""
+        out = self._sum_expr()
+        if self.i != len(self.tokens):
+            kind, val = self._peek()
+            raise ValueError(
+                f"Unsupported expression: trailing {val!r} in {self.text!r}"
+            )
+        return out
+
     def _or_expr(self) -> Column:
         left = self._and_expr()
         while self._accept_kw("OR"):
@@ -596,35 +769,29 @@ class _PredicateParser:
             return ~self._not_expr()
         kind, val = self._peek()
         if kind == "punct" and val == "(":
-            self.i += 1
-            inner = self._or_expr()
-            self._expect("punct", ")")
-            return inner
+            # '(' opens either a parenthesized predicate or an arithmetic
+            # group ("(a + b) * 2 > 4"): try the predicate read, and on
+            # failure rewind and let _comparison's expression grammar
+            # consume the paren itself
+            save = self.i
+            try:
+                self.i += 1
+                inner = self._or_expr()
+                self._expect("punct", ")")
+                return inner
+            except ValueError:
+                self.i = save
         return self._comparison()
 
     def _comparison(self) -> Column:
-        kind, name = self._next()
-        if kind != "ident":
-            raise ValueError(
-                f"Unsupported WHERE clause: expected column name, got "
-                f"{name!r} in {self.text!r}"
-            )
-        c = col(name)
-        while self._peek() == ("punct", "."):
-            self.i += 1
-            k, field = self._next()
-            if k != "ident":
-                raise ValueError(
-                    f"Expected field name after '.' in {self.text!r}"
-                )
-            c = c.getField(field)
+        c = self._sum_expr()
         if self._accept_kw("IS"):
             negate = self._accept_kw("NOT")
             k, v = self._next()
             if k != "ident" or v.upper() != "NULL":
                 raise ValueError(f"Expected NULL after IS in {self.text!r}")
             return c.isNotNull() if negate else c.isNull()
-        negate_in = self._accept_kw("NOT")
+        negate = self._accept_kw("NOT")
         if self._accept_kw("IN"):
             self._expect("punct", "(")
             values = [self._literal()]
@@ -633,29 +800,148 @@ class _PredicateParser:
                 values.append(self._literal())
             self._expect("punct", ")")
             membership = c.isin(*values)
-            return ~membership if negate_in else membership
-        if negate_in:
-            raise ValueError(f"Expected IN after NOT in {self.text!r}")
+            return ~membership if negate else membership
+        if self._accept_kw("LIKE"):
+            kind, val = self._next()
+            if kind != "str":
+                raise ValueError(
+                    f"LIKE requires a string pattern literal in {self.text!r}"
+                )
+            matched = c.like(self._unquote(val))
+            return ~matched if negate else matched
+        if self._accept_kw("BETWEEN"):
+            lower = self._sum_expr()
+            if not self._accept_kw("AND"):
+                raise ValueError(
+                    f"Expected AND in BETWEEN ... AND ... ({self.text!r})"
+                )
+            upper = self._sum_expr()
+            ranged = (c >= lower) & (c <= upper)
+            return ~ranged if negate else ranged
+        if negate:
+            raise ValueError(
+                f"Expected IN, LIKE or BETWEEN after NOT in {self.text!r}"
+            )
         kind, op = self._next()
         if kind != "op":
             raise ValueError(
                 f"Unsupported WHERE clause: expected operator after "
-                f"{name!r} in {self.text!r}"
+                f"{c._name!r} in {self.text!r}"
             )
-        value = self._literal()
+        value = self._sum_expr()
         if op in ("=", "=="):
             return c == value
         if op in ("!=", "<>"):
             return c != value
         return {"<": c < value, "<=": c <= value, ">": c > value, ">=": c >= value}[op]
 
+    # -- arithmetic expressions -----------------------------------------
+    def _sum_expr(self) -> Column:
+        left = self._term_expr()
+        while self._peek()[0] == "arith" and self._peek()[1] in "+-":
+            _, sym = self._next()
+            right = self._term_expr()
+            left = (left + right) if sym == "+" else (left - right)
+        return left
+
+    def _term_expr(self) -> Column:
+        left = self._factor()
+        while self._peek()[0] == "arith" and self._peek()[1] in "*/":
+            _, sym = self._next()
+            right = self._factor()
+            left = (left * right) if sym == "*" else (left / right)
+        return left
+
+    def _factor(self) -> Column:
+        kind, val = self._peek()
+        if kind == "arith" and val == "-":
+            self.i += 1
+            return -self._factor()
+        if kind == "punct" and val == "(":
+            self.i += 1
+            inner = self._sum_expr()
+            self._expect("punct", ")")
+            return inner
+        if kind in ("num", "str"):
+            from sparkdl_tpu.sql.functions import lit
+
+            return lit(self._literal())
+        if kind == "ident":
+            # keywords that can follow an expression must not be eaten
+            # as column refs (defensive; callers normally stop first)
+            if val.upper() in ("AND", "OR", "NOT", "IN", "IS", "LIKE",
+                               "BETWEEN", "NULL"):
+                raise ValueError(
+                    f"Unexpected keyword {val!r} in {self.text!r}"
+                )
+            self.i += 1
+            if self._peek() == ("punct", "("):
+                return self._fn_call(val)
+            if val in self.qualifiers and self._peek() == ("punct", "."):
+                # table/alias qualifier: t.score resolves to the joined
+                # column `score` (Spark UX) — after a join the engine
+                # holds single flat columns, not per-table attributes
+                self.i += 1
+                k, name2 = self._next()
+                if k != "ident":
+                    raise ValueError(
+                        f"Expected column after {val!r}. in {self.text!r}"
+                    )
+                val = name2
+            c = col(val)
+            while self._peek() == ("punct", "."):
+                self.i += 1
+                k, field = self._next()
+                if k != "ident":
+                    raise ValueError(
+                        f"Expected field name after '.' in {self.text!r}"
+                    )
+                c = c.getField(field)
+            return c
+        raise ValueError(
+            f"Unsupported WHERE clause: expected column name, got "
+            f"{val!r} in {self.text!r}"
+        )
+
+    def _fn_call(self, name: str) -> Column:
+        if name.lower() in self._AGG_NAMES and (
+            self.udf is None or name not in self.udf
+        ):
+            raise ValueError(
+                f"aggregate {name.upper()}(...) cannot appear inside an "
+                "expression; compute it as its own projection (alias it "
+                "with AS) and reference the alias"
+            )
+        if self.udf is None or name not in self.udf:
+            raise KeyError(f"Undefined function: {name!r}")
+        self._expect("punct", "(")
+        args = []
+        if self._peek() != ("punct", ")"):
+            args.append(self._sum_expr())
+            while self._peek() == ("punct", ","):
+                self.i += 1
+                args.append(self._sum_expr())
+        self._expect("punct", ")")
+        return self.udf.get(name)(*args)
+
+    @staticmethod
+    def _unquote(val: str) -> str:
+        body = val[1:-1]
+        return body.replace("\\" + val[0], val[0]).replace("\\\\", "\\")
+
     def _literal(self):
         kind, val = self._next()
+        if kind == "arith" and val == "-":
+            v = self._literal()
+            if not isinstance(v, (int, float)):
+                raise ValueError(
+                    f"Unsupported WHERE literal -{v!r} in {self.text!r}"
+                )
+            return -v
         if kind == "num":
             return float(val) if ("." in val or "e" in val.lower()) else int(val)
         if kind == "str":
-            body = val[1:-1]
-            return body.replace("\\" + val[0], val[0]).replace("\\\\", "\\")
+            return self._unquote(val)
         raise ValueError(
             f"Unsupported WHERE literal {val!r} in {self.text!r}"
         )

@@ -397,12 +397,14 @@ class TestGroupByAggregates:
             "HAVING label > 0 ORDER BY s"
         ).collect()
         assert [r.s for r in out] == [12.0, 15.0]
-        # unaliased aggregate labels are not predicate identifiers
-        with pytest.raises(ValueError, match="HAVING.*AS"):
-            tpu_session.sql(
-                "SELECT label, COUNT(*) FROM agg_t GROUP BY label "
-                "HAVING count(*) > 1"
-            )
+        # direct aggregate calls in HAVING compute as hidden columns
+        # (they used to require an AS alias)
+        rows = tpu_session.sql(
+            "SELECT label, COUNT(*) AS n FROM agg_t GROUP BY label "
+            "HAVING count(*) > 1"
+        ).collect()
+        assert all(r.n > 1 for r in rows) and len(rows) >= 1
+        assert rows and "__having_0" not in rows[0]._fields
 
     def test_having_unknown_column_gets_hint(self, gdf, tpu_session):
         with pytest.raises(ValueError, match="HAVING.*AS"):
@@ -614,3 +616,186 @@ class TestJoins:
             "SELECT img_id FROM preds WHERE score > 0.5 ORDER BY img_id"
         )
         assert [r.img_id for r in out.collect()] == [1, 3]
+
+
+class TestSqlExpressions:
+    """Arithmetic projections/aggregate args, COUNT(DISTINCT),
+    LIKE/BETWEEN (VERDICT r3 #5 — the reference had all of Spark SQL's
+    expression surface; these are the reconstructed high-traffic parts)."""
+
+    @pytest.fixture()
+    def edf(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [("a.png", "s3", 0.2, 1), ("b.png", "s3", 0.4, 1),
+             ("c.jpg", "web", 0.6, 2), ("d.jpg", "web", 0.8, 2),
+             ("e.png", "web", None, 2), (None, "s3", 0.5, 3)],
+            ["origin", "source", "score", "label"],
+        )
+        df.createOrReplaceTempView("expr_t")
+        return df
+
+    def test_arithmetic_projection(self, edf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT origin, score * 100 AS pct, (score + 1) / 2 AS half "
+            "FROM expr_t WHERE score IS NOT NULL"
+        ).collect()
+        assert out[0].pct == pytest.approx(20.0)
+        assert out[0].half == pytest.approx(0.6)
+        # NULL propagates through arithmetic
+        all_rows = tpu_session.sql(
+            "SELECT score * 100 AS pct FROM expr_t"
+        ).collect()
+        assert any(r.pct is None for r in all_rows)
+
+    def test_default_expression_column_name(self, edf, tpu_session):
+        out = tpu_session.sql("SELECT score * 100 FROM expr_t")
+        assert out.columns == ["score * 100"]
+
+    def test_arithmetic_in_where(self, edf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT origin FROM expr_t WHERE score * 100 > 45"
+        ).collect()
+        assert {r.origin for r in out} == {"c.jpg", "d.jpg", None}
+
+    def test_unary_minus_and_precedence(self, edf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT origin FROM expr_t WHERE -score + 1 > 0.7"
+        ).collect()  # 1 - score > 0.7 => score < 0.3
+        assert {r.origin for r in out} == {"a.png"}
+        rows = tpu_session.sql(
+            "SELECT 2 + 3 * 4 AS v FROM expr_t LIMIT 1"
+        ).collect()
+        assert rows[0].v == 14  # * binds tighter than +
+
+    def test_like(self, edf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT origin FROM expr_t WHERE origin LIKE '%.png'"
+        ).collect()
+        assert {r.origin for r in out} == {"a.png", "b.png", "e.png"}
+        # NULL LIKE -> NULL -> filtered out (3VL); NOT LIKE keeps jpgs
+        out2 = tpu_session.sql(
+            "SELECT origin FROM expr_t WHERE origin NOT LIKE '%.png'"
+        ).collect()
+        assert {r.origin for r in out2} == {"c.jpg", "d.jpg"}
+        # _ matches exactly one character
+        out3 = tpu_session.sql(
+            "SELECT origin FROM expr_t WHERE origin LIKE '_.png'"
+        ).collect()
+        assert {r.origin for r in out3} == {"a.png", "b.png", "e.png"}
+
+    def test_between(self, edf, tpu_session):
+        out = tpu_session.sql(
+            "SELECT origin FROM expr_t WHERE score BETWEEN 0.4 AND 0.6"
+        ).collect()
+        assert {r.origin for r in out} == {"b.png", "c.jpg", None}
+        out2 = tpu_session.sql(
+            "SELECT origin FROM expr_t "
+            "WHERE score NOT BETWEEN 0.4 AND 0.6 AND score IS NOT NULL"
+        ).collect()
+        assert {r.origin for r in out2} == {"a.png", "d.jpg"}
+
+    def test_count_distinct(self, edf, tpu_session):
+        rows = tpu_session.sql(
+            "SELECT label, COUNT(DISTINCT source) AS ns FROM expr_t "
+            "GROUP BY label ORDER BY label"
+        ).collect()
+        assert [(r.label, r.ns) for r in rows] == [(1, 1), (2, 1), (3, 1)]
+        total = tpu_session.sql(
+            "SELECT COUNT(DISTINCT source) AS ns FROM expr_t"
+        ).collect()
+        assert total[0].ns == 2
+        with pytest.raises(ValueError, match="DISTINCT is supported"):
+            tpu_session.sql(
+                "SELECT SUM(DISTINCT score) FROM expr_t GROUP BY label"
+            )
+
+    def test_aggregate_over_expression(self, edf, tpu_session):
+        rows = tpu_session.sql(
+            "SELECT label, AVG(score * 100) AS pct FROM expr_t "
+            "WHERE score IS NOT NULL GROUP BY label ORDER BY label"
+        ).collect()
+        assert rows[0].pct == pytest.approx(30.0)  # (20+40)/2
+        assert rows[1].pct == pytest.approx(70.0)  # (60+80)/2
+        # derived argument columns never leak into the output
+        assert not any(c.startswith("__agg_arg") for c in
+                       tpu_session.sql(
+                           "SELECT AVG(score * 100) AS pct FROM expr_t "
+                           "GROUP BY label"
+                       ).columns)
+
+    def test_verdict_acceptance_query(self, edf, tpu_session):
+        # the VERDICT r3 "done" shape: expression aggregate + HAVING with
+        # a direct COUNT(DISTINCT ...) call
+        rows = tpu_session.sql(
+            "SELECT label, AVG(score * 100) AS pct FROM expr_t "
+            "WHERE score IS NOT NULL "
+            "GROUP BY label HAVING COUNT(DISTINCT origin) > 1 "
+            "ORDER BY label"
+        ).collect()
+        assert [(r.label, round(r.pct, 6)) for r in rows] == [
+            (1, 30.0), (2, 70.0)
+        ]
+
+    def test_udf_in_expression(self, edf, tpu_session):
+        tpu_session.udf.register("twice", lambda v: None if v is None
+                                 else v * 2)
+        rows = tpu_session.sql(
+            "SELECT twice(score) + 1 AS t FROM expr_t "
+            "WHERE score IS NOT NULL ORDER BY t"
+        ).collect()
+        assert rows[0].t == pytest.approx(1.4)
+
+    def test_aggregate_inside_expression_rejected(self, edf, tpu_session):
+        with pytest.raises(ValueError, match="cannot appear inside"):
+            tpu_session.sql("SELECT avg(score) + 1 FROM expr_t")
+
+
+class TestSqlResolution:
+    """Qualifier resolution, ORDER BY alias precedence, and parser
+    robustness on malformed input."""
+
+    @pytest.fixture()
+    def views(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(1, 0.9), (2, 0.4), (3, 0.7)], ["img_id", "score"]
+        ).createOrReplaceTempView("t")
+        tpu_session.createDataFrame(
+            [(1, "cat"), (2, "dog")], ["img_id", "meta"]
+        ).createOrReplaceTempView("m")
+        return tpu_session
+
+    def test_qualified_refs_after_join(self, views):
+        # the natural Spark form: qualified columns in WHERE and the
+        # projection resolve against the joined (flat) columns
+        rows = views.sql(
+            "SELECT t.score, m.meta FROM t JOIN m ON t.img_id = m.img_id "
+            "WHERE t.score > 0.5"
+        ).collect()
+        assert [(r.score, r.meta) for r in rows] == [(0.9, "cat")]
+
+    def test_qualified_refs_single_table(self, views):
+        rows = views.sql(
+            "SELECT t.img_id FROM t WHERE t.score >= 0.7 ORDER BY img_id"
+        ).collect()
+        assert [r.img_id for r in rows] == [1, 3]
+
+    def test_order_by_alias_shadows_input_column(self, views):
+        # SQL resolution: a select-list alias wins over a same-named
+        # input column — sort by the NEGATED value here
+        rows = views.sql(
+            "SELECT img_id, -score AS score FROM t ORDER BY score"
+        ).collect()
+        assert [r.img_id for r in rows] == [1, 3, 2]  # -0.9 < -0.7 < -0.4
+
+    def test_malformed_join_query_fails_fast(self, views):
+        import time
+
+        bad = (
+            "SELECT x FROM t "
+            + "JOIN m ON t.img_id = m.img_id " * 24
+            + "WHERE ??? BROKEN"
+        )
+        t0 = time.perf_counter()
+        with pytest.raises((ValueError, KeyError)):
+            views.sql(bad)
+        assert time.perf_counter() - t0 < 1.0, "regex backtracking blowup"
