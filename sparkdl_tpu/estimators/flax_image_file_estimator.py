@@ -244,7 +244,7 @@ class FlaxImageFileEstimator(
 
     # ------------------------------------------------------------------
     def _load_shard(self, dataset):
-        x, labels, _ = load_host_shard(
+        x, labels, n_global = load_host_shard(
             dataset,
             self.getInputCol(),
             self.getLabelCol(),
@@ -259,7 +259,7 @@ class FlaxImageFileEstimator(
                     f"values (dtype {raw.dtype}); this estimator trains "
                     "with integer class labels"
                 )
-        return x, raw.astype(np.int32)
+        return x, raw.astype(np.int32), n_global
 
     def _fit(self, dataset):
         for p in (self.inputCol, self.outputCol, self.labelCol,
@@ -274,7 +274,11 @@ class FlaxImageFileEstimator(
         lr = fit_params.get("learning_rate")
         seed = int(fit_params.get("seed", 0))
 
-        x, y = self._load_shard(dataset)
+        from sparkdl_tpu.parallel import runner
+
+        distributed = runner.is_distributed()
+        nprocs = jax.process_count()
+        x, y, n_global = self._load_shard(dataset)
         loss_name = self.getOrDefault(self.loss)
         tx = get_optimizer(self.getOrDefault(self.optimizer), lr)
 
@@ -343,8 +347,21 @@ class FlaxImageFileEstimator(
             mesh = Mesh(
                 devices.reshape(dp, devices.size // dp), ("data", "model")
             )
+            if distributed and dp % nprocs:
+                raise ValueError(
+                    f"multi-host DP x TP needs the data axis ({dp}) to be "
+                    f"a multiple of the process count ({nprocs}) so every "
+                    "host's batch shard lives on its own chips"
+                )
             specs = param_path_specs(variables, rules, model_axis="model")
-            state = init_tp_train_state(variables, tx, mesh, specs)
+            if distributed:
+                # every process holds identical initial variables (same
+                # init seed / same pretrained file); each materializes
+                # only its addressable shards of the global placement
+                placed = runner.place_global(variables, mesh, specs)
+                state = init_train_state(placed, tx)
+            else:
+                state = init_tp_train_state(variables, tx, mesh, specs)
             step_fn = make_tp_train_step(weighted_loss, tx, mesh, specs)
 
             def place_batch(b):
@@ -361,7 +378,7 @@ class FlaxImageFileEstimator(
                     ),
                 }
         else:
-            mesh = make_mesh()
+            mesh = runner.make_global_mesh() if distributed else make_mesh()
             state = init_train_state(variables, tx)
             step_fn = make_train_step(per_sample, tx, mesh, weighted=True)
 
@@ -375,9 +392,26 @@ class FlaxImageFileEstimator(
                     mesh,
                 )
 
+        if distributed:
+            # same placement for both arms: host-local rows assemble into
+            # global data-sharded arrays on the (global) mesh
+            def place_batch(b):  # noqa: F811 - deliberate override
+                return runner.global_batch(b, mesh)
+
         n_dev = int(mesh.devices.size)
+        # global batch splits evenly across the mesh (and hence hosts)
         batch_size = max(batch_size - batch_size % n_dev, n_dev)
-        n = x.shape[0]
+        local_bs = batch_size // nprocs if distributed else batch_size
+        n = x.shape[0]  # this host's rows
+        if distributed:
+            # identical step count on every host, derived from the global
+            # row count — hosts running different numbers of collective
+            # steps would wedge the job (same contract as
+            # KerasImageFileEstimator)
+            max_local_rows = -(-n_global // nprocs)
+            steps_per_epoch = max(1, -(-max_local_rows // local_bs))
+        else:
+            steps_per_epoch = max(1, -(-n // local_bs))
 
         ckpt_dir = self.getOrDefault(self.checkpointDir)
         start_epoch = 0
@@ -397,7 +431,17 @@ class FlaxImageFileEstimator(
                     start_epoch,
                     epochs,
                 )
-        rng = np.random.RandomState(seed % 2**32)
+        if distributed and rules is None:
+            # params start host-local (same init on every process) — lift
+            # onto the global mesh, replicated (after restore, which works
+            # on host arrays)
+            state = runner.replicate(state, mesh)
+        # per-host permutation when each host shuffles only its own shard
+        rng = np.random.RandomState(
+            (seed * 7919 + jax.process_index()) % 2**32
+            if distributed
+            else seed % 2**32
+        )
         # replay restored epochs' draws: epoch e always trains on the e-th
         # permutation, so a resumed fit is step-for-step identical to an
         # uninterrupted one (same contract as KerasImageFileEstimator)
@@ -408,16 +452,16 @@ class FlaxImageFileEstimator(
         try:
             for epoch in range(start_epoch, epochs):
                 order = rng.permutation(n)
-                for lo in range(0, n, batch_size):
-                    idx = order[lo : lo + batch_size]
+                for step_i in range(steps_per_epoch):
+                    idx = order[step_i * local_bs : (step_i + 1) * local_bs]
                     k = len(idx)
-                    if k < batch_size:
+                    if k < local_bs:
                         # pad cyclically; pad rows carry zero weight, so the
                         # update is the exact mean over the k real rows
                         idx = np.concatenate(
-                            [idx, np.resize(order, batch_size - k)]
+                            [idx, np.resize(order, local_bs - k)]
                         )
-                    w = np.zeros(batch_size, np.float32)
+                    w = np.zeros(local_bs, np.float32)
                     w[:k] = 1.0
                     state, loss = step_fn(
                         state, place_batch({"x": x[idx], "y": y[idx], "w": w})
@@ -436,7 +480,20 @@ class FlaxImageFileEstimator(
                 ckptr.wait_until_finished()
                 ckptr.close()
 
-        tuned = jax.tree_util.tree_map(np.asarray, state.params)
+        def to_host(a):
+            # multi-host TP leaves have non-addressable shards: assemble
+            # the full value via allgather; replicated/local leaves read
+            # directly
+            if (
+                getattr(a, "is_fully_addressable", True)
+                or getattr(a.sharding, "is_fully_replicated", False)
+            ):
+                return np.asarray(a)
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+
+        tuned = jax.tree_util.tree_map(to_host, state.params)
         transformer = FlaxImageFileTransformer(
             inputCol=self.getInputCol(),
             outputCol=self.getOutputCol(),
@@ -534,7 +591,27 @@ class FlaxImageFileEstimator(
         latest = epochs[-1]
 
         payload = self._ckpt_payload(state)
-        template = jax.tree_util.tree_map(np.asarray, payload)
+
+        def to_host_template(a):
+            # multi-host TP leaves span non-addressable devices; a plain
+            # np.asarray template would raise.  The full value is
+            # identical on every process (replicated math), so allgather
+            # the sharded leaves
+            if (
+                getattr(a, "is_fully_addressable", True)
+                or getattr(
+                    getattr(a, "sharding", None), "is_fully_replicated",
+                    False,
+                )
+            ):
+                return np.asarray(a)
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(a, tiled=True)
+            )
+
+        template = jax.tree_util.tree_map(to_host_template, payload)
         restored = checkpointing.restore_epoch(
             ckpt_dir, namespace, latest, template
         )
@@ -542,12 +619,21 @@ class FlaxImageFileEstimator(
         # NamedShardings; everything else goes back to HOST arrays — a
         # single-device-committed restore would be rejected against the
         # mesh-sharded batch (the same trap KerasImageFileEstimator
-        # documents), while plain numpy lets the shard_map step place it
+        # documents), while plain numpy lets the shard_map step place it.
+        # Cross-process placements go through make_array_from_callback
+        # (each process materializes only its addressable shards); local
+        # NamedShardings keep the direct device_put.
         from jax.sharding import NamedSharding as _NS
 
         def _place(tmpl, arr):
             if hasattr(tmpl, "sharding") and isinstance(tmpl.sharding, _NS):
-                return jax.device_put(jnp.asarray(arr), tmpl.sharding)
+                if getattr(tmpl, "is_fully_addressable", True):
+                    return jax.device_put(jnp.asarray(arr), tmpl.sharding)
+                arr = np.asarray(arr)
+                return jax.make_array_from_callback(
+                    arr.shape, tmpl.sharding,
+                    lambda idx, _a=arr: _a[idx],
+                )
             return np.asarray(arr)
 
         placed = jax.tree_util.tree_map(_place, payload, restored)

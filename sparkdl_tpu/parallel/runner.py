@@ -146,6 +146,28 @@ def replicate(tree: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map(build, tree)
 
 
+def place_global(tree: Any, mesh: Mesh, specs: Any) -> Any:
+    """Place host-local values onto the global mesh per their
+    PartitionSpecs (the GSPMD TP analog of :func:`replicate`).
+
+    Every process must hold the same full value per leaf (e.g. params
+    initialized from the same seed); each process materializes only its
+    addressable shards via ``make_array_from_callback``, so this works
+    for sharded *and* replicated specs without relying on cross-process
+    ``device_put`` semantics.  Single-process it degenerates to a plain
+    placement.
+    """
+
+    def build(x, spec):
+        x = np.asarray(x)
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx: x[idx]
+        )
+
+    return jax.tree_util.tree_map(build, tree, specs)
+
+
 def barrier(name: str = "sparkdl_barrier") -> None:
     """Block until every process reaches this point (Spark stage-boundary
     analog)."""

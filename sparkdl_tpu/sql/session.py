@@ -272,7 +272,9 @@ class TPUSession:
             )
         where = m.group("where")
         if where:
-            out = out.filter(self._parse_predicate(where.strip(), quals))
+            out = out.filter(
+                self._parse_predicate(where.strip(), quals, out.columns)
+            )
 
         proj_raw = [
             raw.strip() for raw in self._split_projections(m.group("proj"))
@@ -314,7 +316,10 @@ class TPUSession:
             star = m.group("proj").strip() == "*"
             exprs: List[Column] = (
                 [] if star
-                else [self._parse_projection(raw, quals) for raw in proj_raw]
+                else [
+                    self._parse_projection(raw, quals, out.columns)
+                    for raw in proj_raw
+                ]
             )
             sort_after = False
             if order_col is not None:
@@ -596,7 +601,9 @@ class TPUSession:
         parts.append("".join(cur))
         return parts
 
-    def _parse_projection(self, text: str, qualifiers=frozenset()) -> Column:
+    def _parse_projection(
+        self, text: str, qualifiers=frozenset(), columns=()
+    ) -> Column:
         alias = None
         m_as = re.match(r"^(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", text, re.IGNORECASE)
         if m_as:
@@ -604,7 +611,7 @@ class TPUSession:
         if text == "*":
             raise ValueError("'*' must be the only projection")
         m_q = re.fullmatch(r"(\w+)\.(\w+)", text)
-        if m_q and m_q.group(1) in qualifiers:
+        if m_q and m_q.group(1) in qualifiers and m_q.group(1) not in columns:
             # qualified simple column (t.score): output name is the bare
             # column, as in Spark
             expr = col(m_q.group(2))
@@ -615,14 +622,18 @@ class TPUSession:
             # literals and registered-UDF calls (`score * 100`,
             # `my_udf(image)`, `a + b / 2`)
             expr = _PredicateParser(
-                text, udf_registry=self.udf, qualifiers=qualifiers
+                text, udf_registry=self.udf, qualifiers=qualifiers,
+                columns=columns,
             ).parse_expression()
             expr = expr.alias(re.sub(r"\s+", " ", text))
         return expr.alias(alias) if alias else expr
 
-    def _parse_predicate(self, text: str, qualifiers=frozenset()) -> Column:
+    def _parse_predicate(
+        self, text: str, qualifiers=frozenset(), columns=()
+    ) -> Column:
         return _PredicateParser(
-            text, udf_registry=self.udf, qualifiers=qualifiers
+            text, udf_registry=self.udf, qualifiers=qualifiers,
+            columns=columns,
         ).parse()
 
     def stop(self):
@@ -687,10 +698,11 @@ class _PredicateParser:
     )
 
     def __init__(self, text: str, udf_registry=None,
-                 qualifiers=frozenset()):
+                 qualifiers=frozenset(), columns=()):
         self.text = text
         self.udf = udf_registry
         self.qualifiers = qualifiers
+        self.columns = frozenset(columns)
         self.tokens: List[tuple] = []
         pos = 0
         while pos < len(text):
@@ -877,7 +889,8 @@ class _PredicateParser:
             self.i += 1
             if self._peek() == ("punct", "("):
                 return self._fn_call(val)
-            if val in self.qualifiers and self._peek() == ("punct", "."):
+            if (val in self.qualifiers and val not in self.columns
+                    and self._peek() == ("punct", ".")):
                 # table/alias qualifier: t.score resolves to the joined
                 # column `score` (Spark UX) — after a join the engine
                 # holds single flat columns, not per-table attributes

@@ -787,6 +787,22 @@ class TestSqlResolution:
         ).collect()
         assert [r.img_id for r in rows] == [1, 3, 2]  # -0.9 < -0.7 < -0.4
 
+    def test_struct_column_named_like_view_keeps_field_access(
+        self, tpu_session
+    ):
+        # a view named like one of its struct columns: column resolution
+        # wins over the table qualifier, so image.height stays a
+        # struct-field access (regression guard for the qualifier
+        # feature)
+        tpu_session.createDataFrame(
+            [{"image": {"height": 120, "width": 60}, "label": 1},
+             {"image": {"height": 40, "width": 20}, "label": 0}]
+        ).createOrReplaceTempView("image")
+        rows = tpu_session.sql(
+            "SELECT label FROM image WHERE image.height > 100"
+        ).collect()
+        assert [r.label for r in rows] == [1]
+
     def test_malformed_join_query_fails_fast(self, views):
         import time
 
