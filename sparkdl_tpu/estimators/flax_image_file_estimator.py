@@ -592,26 +592,17 @@ class FlaxImageFileEstimator(
 
         payload = self._ckpt_payload(state)
 
-        def to_host_template(a):
-            # multi-host TP leaves span non-addressable devices; a plain
-            # np.asarray template would raise.  The full value is
-            # identical on every process (replicated math), so allgather
-            # the sharded leaves
-            if (
-                getattr(a, "is_fully_addressable", True)
-                or getattr(
-                    getattr(a, "sharding", None), "is_fully_replicated",
-                    False,
-                )
-            ):
-                return np.asarray(a)
-            from jax.experimental import multihost_utils
-
-            return np.asarray(
-                multihost_utils.process_allgather(a, tiled=True)
+        def shape_template(a):
+            # orbax only reads the template's structure/shape/dtype, so a
+            # zeros array suffices — and unlike np.asarray it neither
+            # copies values nor trips over multi-host TP leaves whose
+            # shards live on peer hosts
+            return np.zeros(
+                getattr(a, "shape", np.shape(a)),
+                getattr(a, "dtype", None) or np.asarray(a).dtype,
             )
 
-        template = jax.tree_util.tree_map(to_host_template, payload)
+        template = jax.tree_util.tree_map(shape_template, payload)
         restored = checkpointing.restore_epoch(
             ckpt_dir, namespace, latest, template
         )

@@ -303,7 +303,7 @@ class TPUSession:
         if is_agg:
             out = self._sql_aggregate(
                 out, proj_raw, group, having=m.group("having"),
-                qualifiers=quals,
+                qualifiers=quals, columns=out.columns,
             )
             if order_col is not None:
                 if order_col not in out.columns:
@@ -423,6 +423,7 @@ class TPUSession:
         label: str,
         tmp_idx: List[int],
         qualifiers=frozenset(),
+        columns=(),
     ):
         """Normalize one aggregate call into a ``GroupedData._aggregate``
         pair, materializing expression arguments (``AVG(score * 100)``)
@@ -442,7 +443,8 @@ class TPUSession:
             return df, ("*", fn_key, label)
         if not re.fullmatch(r"\w+", arg):
             expr = _PredicateParser(
-                arg, udf_registry=self.udf, qualifiers=qualifiers
+                arg, udf_registry=self.udf, qualifiers=qualifiers,
+                columns=columns,
             ).parse_expression()
             tmp = f"__agg_arg_{tmp_idx[0]}"
             tmp_idx[0] += 1
@@ -457,6 +459,7 @@ class TPUSession:
         group: Optional[str],
         having: Optional[str] = None,
         qualifiers=frozenset(),
+        columns=(),
     ) -> DataFrame:
         """The GROUP BY path: every projection must be a group key or an
         aggregate call (as in Spark); aliases rename the pyspark-style
@@ -488,7 +491,8 @@ class TPUSession:
                     else f"{fn_key}({arg})"
                 )
                 df, pair = self._agg_pair(
-                    df, fn_key, distinct, arg, label, tmp_idx, qualifiers
+                    df, fn_key, distinct, arg, label, tmp_idx, qualifiers,
+                    columns,
                 )
                 pairs.append(pair)
             elif expr in keys:
@@ -509,7 +513,7 @@ class TPUSession:
             # 1) compute as hidden output columns; the clause text is
             # rewritten to reference them before predicate parsing
             having_text, df, extra = self._rewrite_having_aggs(
-                having_text, df, tmp_idx, qualifiers
+                having_text, df, tmp_idx, qualifiers, columns
             )
             for pair in extra:
                 pairs.append(pair)
@@ -520,7 +524,9 @@ class TPUSession:
             # the projection drops), an aggregate BY ITS ALIAS, or a
             # direct aggregate call (rewritten above)
             try:
-                predicate = self._parse_predicate(having_text, qualifiers)
+                predicate = self._parse_predicate(
+                    having_text, qualifiers, out.columns
+                )
                 out = out.filter(predicate)
             except (ValueError, KeyError) as e:
                 raise ValueError(
@@ -541,7 +547,7 @@ class TPUSession:
 
     def _rewrite_having_aggs(
         self, text: str, df: DataFrame, tmp_idx: List[int],
-        qualifiers=frozenset(),
+        qualifiers=frozenset(), columns=(),
     ):
         """Replace direct aggregate calls in a HAVING clause with hidden
         output-column references.  Returns ``(rewritten_text, df,
@@ -578,7 +584,8 @@ class TPUSession:
             label = f"__having_{tmp_idx[0]}"
             tmp_idx[0] += 1
             df, pair = self._agg_pair(
-                df, fn_key, distinct, arg, label, tmp_idx, qualifiers
+                df, fn_key, distinct, arg, label, tmp_idx, qualifiers,
+                columns,
             )
             extra.append(pair)
             out_text.append(text[pos:m.start()])

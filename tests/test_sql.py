@@ -802,6 +802,12 @@ class TestSqlResolution:
             "SELECT label FROM image WHERE image.height > 100"
         ).collect()
         assert [r.label for r in rows] == [1]
+        # same resolution inside aggregate arguments and HAVING
+        agg = tpu_session.sql(
+            "SELECT label, MAX(image.height) AS h FROM image "
+            "GROUP BY label HAVING MAX(image.height) > 10 ORDER BY label"
+        ).collect()
+        assert [(r.label, r.h) for r in agg] == [(0, 40), (1, 120)]
 
     def test_malformed_join_query_fails_fast(self, views):
         import time
