@@ -8,9 +8,15 @@ result fetches through the PJRT relay, so it reports the honest end-to-end
 rate a SQL user sees (the reference's equivalent was TensorFrames per-block
 ``Session::Run`` — SURVEY.md §3.3).
 
+Measurement protocol: ``k`` interleaved pipelined/serial trial pairs
+(``benchlib.paired_trials``) with median + IQR — single-shot numbers
+through the relay drift 2-4x, so only interleaved medians can support (or
+honestly refuse to support) the decode/dispatch-overlap claim.
+
 Prints one JSON line; ``vs_baseline`` is null (record-only config).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -26,6 +32,11 @@ IMAGE = 299
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-k", type=int, default=5,
+                    help="interleaved pipelined/serial trial pairs")
+    args = ap.parse_args()
+
     import keras
 
     from sparkdl_tpu.image import imageIO
@@ -55,23 +66,48 @@ def main():
         "bench_udf", model, session=spark, batchSize=BATCH
     )
 
-    # warm with the real partition/batch shapes so the timed run is
+    # warm with the real partition/batch shapes so the timed runs are
     # compile-free (a LIMIT query would warm a different batch shape)
     spark.sql("SELECT bench_udf(image) AS f FROM images").collect()
 
-    t0 = time.perf_counter()
-    out = spark.sql("SELECT bench_udf(image) AS f FROM images").collect()
-    elapsed = time.perf_counter() - t0
-    assert len(out) == ROWS
+    from sparkdl_tpu.utils.benchlib import paired_trials
 
-    rate = ROWS / elapsed
+    def run_query(serial: bool) -> float:
+        os.environ["SPARKDL_SERIAL_INFERENCE"] = "1" if serial else ""
+        try:
+            t0 = time.perf_counter()
+            out = spark.sql("SELECT bench_udf(image) AS f FROM images").collect()
+            elapsed = time.perf_counter() - t0
+            assert len(out) == ROWS
+            return ROWS / elapsed
+        finally:
+            os.environ.pop("SPARKDL_SERIAL_INFERENCE", None)
+
+    trials = paired_trials(
+        {
+            "pipelined": lambda: run_query(serial=False),
+            "serial": lambda: run_query(serial=True),
+        },
+        k=args.k,
+    )
+    piped, serial = trials["pipelined"], trials["serial"]
     print(
         json.dumps(
             {
                 "metric": "registerKerasImageUDF(MobileNetV2) end-to-end "
                 "SQL inference throughput",
-                "value": round(rate, 1),
+                "value": piped["median"],
                 "unit": "images/sec (incl. decode+collect)",
+                "iqr": piped["iqr"],
+                "samples": piped["samples"],
+                "serial_median": serial["median"],
+                "serial_iqr": serial["iqr"],
+                "overlap_speedup": round(
+                    piped["median"] / serial["median"], 3
+                )
+                if serial["median"]
+                else None,
+                "k": args.k,
                 "vs_baseline": None,
             }
         )

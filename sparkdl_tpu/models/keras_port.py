@@ -135,3 +135,64 @@ def port_keras_weights(model) -> Dict[str, Any]:
                 f"No porting rule for Keras layer {layer.name} of type {cls}"
             )
     return {"params": params, "batch_stats": batch_stats}
+
+
+def pad_variables_to_module(variables, module, input_size):
+    """Zero-pad ported Keras weights up to a widened TPU-layout module.
+
+    Some registry modules widen channel trunks for MXU lane alignment
+    (e.g. Xception's 728 -> 768 = 6x128 middle flow, +20% measured
+    throughput — BASELINE.md r4).  The target shapes come from
+    ``jax.eval_shape(module.init)``; every leaf whose target is wider
+    pads at the high end of the differing axes with zeros — except BN
+    running variances, which pad with ones (identity statistics).  The
+    padded channels then stay exactly zero through depthwise convs
+    (zero kernels), pointwise convs (zero rows/columns), BN (zero
+    scale/bias on zero-mean unit-var stats) and relu, so the widened
+    model computes bit-for-bit what the Keras weights define on the
+    original channels.
+    """
+    import jax
+
+    h, w = input_size
+    target = jax.eval_shape(
+        module.init,
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, h, w, 3), jnp.float32),
+    )
+    # lookup by path rather than strict structure matching: ported
+    # variables may be a SUBSET of the module tree (a topless Keras
+    # model has no 'predictions' layer, which featurization never uses)
+    target_shapes = {
+        jax.tree_util.keystr(p): tuple(l.shape)
+        for p, l in jax.tree_util.tree_leaves_with_path(target)
+    }
+
+    def pad(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if key not in target_shapes:
+            raise ValueError(
+                f"ported weight {key} has no counterpart in the module"
+            )
+        tshape = target_shapes[key]
+        if tuple(leaf.shape) == tshape:
+            return leaf
+        if leaf.ndim != len(tshape):
+            raise ValueError(
+                f"rank mismatch at {key}: {leaf.shape} vs {tshape}"
+            )
+        pads = []
+        for have, want in zip(leaf.shape, tshape):
+            if want < have:
+                raise ValueError(
+                    f"target narrower than ported weights at "
+                    f"{key}: {leaf.shape} vs {tshape}"
+                )
+            pads.append((0, want - have))
+        is_var = getattr(path[-1], "key", None) == "var"
+        return jnp.pad(
+            jnp.asarray(leaf), pads,
+            constant_values=1.0 if is_var else 0.0,
+        )
+
+    return jax.tree_util.tree_map_with_path(pad, variables)

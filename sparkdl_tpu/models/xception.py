@@ -19,9 +19,17 @@ from sparkdl_tpu.models.layers import SeparableConv, global_avg_pool, max_pool
 
 
 class Xception(nn.Module):
+    """``middle_width`` widens the 728-channel middle-flow trunk (e.g. to
+    768 = 6x128 for MXU lane alignment — the BASELINE.md r3 open-headroom
+    experiment).  At the default 728 the module is exactly the Keras
+    architecture; widened variants hold the Keras weights zero-padded
+    (zero channels propagate as zeros through depthwise/pointwise/BN/relu
+    and the residual adds, so numerics are unchanged)."""
+
     num_classes: int = 1000
     include_top: bool = True
     dtype: Optional[Any] = None
+    middle_width: int = 728
 
     @nn.compact
     def __call__(self, x, train: bool = False, features_only: bool = False):
@@ -46,7 +54,10 @@ class Xception(nn.Module):
         x = nn.relu(bn(x, "block1_conv2_bn"))
 
         # ---- entry flow: 3 downsampling residual blocks ----
-        for i, (filters, block) in enumerate(((128, 2), (256, 3), (728, 4))):
+        width = self.middle_width
+        for i, (filters, block) in enumerate(
+            ((128, 2), (256, 3), (width, 4))
+        ):
             res_conv = "conv2d" if i == 0 else f"conv2d_{i}"
             res_bn = ("batch_normalization" if i == 0
                       else f"batch_normalization_{i}")
@@ -67,7 +78,7 @@ class Xception(nn.Module):
             residual = x
             for j in (1, 2, 3):
                 x = nn.relu(x)
-                x = sep(x, 728, f"block{block}_sepconv{j}")
+                x = sep(x, width, f"block{block}_sepconv{j}")
             x = x + residual
 
         # ---- exit flow ----
@@ -75,7 +86,7 @@ class Xception(nn.Module):
                            use_bias=False, dtype=self.dtype, name="conv2d_3")(x)
         residual = bn(residual, "batch_normalization_3")
         x = nn.relu(x)
-        x = sep(x, 728, "block13_sepconv1")
+        x = sep(x, width, "block13_sepconv1")
         x = nn.relu(x)
         x = sep(x, 1024, "block13_sepconv2")
         x = max_pool(x, 3, 2, "SAME")

@@ -120,6 +120,17 @@ def _resolve_variables(model_name: str, spec) -> Any:
         _VARIABLES_CACHE[key] = variables
         return variables
     if isinstance(spec, dict):  # Flax variables pytree
+        if entry.module_kwargs:
+            # TPU-layout module variants (Xception's 768-wide middle
+            # flow): a pytree saved at the original Keras width pads up
+            # transparently; already-widened pytrees pass through
+            from sparkdl_tpu.models.keras_port import (
+                pad_variables_to_module,
+            )
+
+            return pad_variables_to_module(
+                spec, entry.make_module(), entry.input_size
+            )
         return spec
     # A built Keras model: port once per model object so repeated
     # _build_forward calls (fit -> transform, CV folds) reuse the same

@@ -153,3 +153,25 @@ class TestMFU:
             device_kind = "cpu"
 
         assert metrics_mod.mfu(1e12, 1.0, Unknown()) is None
+
+
+def test_paired_trials_interleaves_and_summarizes():
+    """benchlib.paired_trials: A/B interleaving within rounds (drift
+    robustness), median + IQR per label."""
+    from sparkdl_tpu.utils.benchlib import paired_trials
+
+    calls = []
+    trials = paired_trials(
+        {
+            "a": lambda: calls.append("a") or float(len(calls)),
+            "b": lambda: calls.append("b") or float(len(calls)),
+        },
+        k=3,
+    )
+    # strict interleaving: a,b,a,b,a,b — each round runs every label once
+    assert calls == ["a", "b", "a", "b", "a", "b"]
+    assert trials["a"]["samples"] == [1.0, 3.0, 5.0]
+    assert trials["b"]["samples"] == [2.0, 4.0, 6.0]
+    assert trials["a"]["median"] == 3.0 and trials["b"]["median"] == 4.0
+    lo, hi = trials["a"]["iqr"]
+    assert lo <= trials["a"]["median"] <= hi

@@ -173,3 +173,66 @@ def test_decode_predictions_real_labels_offline():
     small = np.zeros((1, 10), dtype=np.float32)
     small[0, 4] = 1.0
     assert decode_predictions(small, top=1)[0][0][1] == "class_4"
+
+
+def test_xception_lane_aligned_padding(oracle_cache):
+    """The registry's Xception is the 768-wide (6x128 lane-aligned)
+    variant holding zero-padded Keras weights — shapes widened, pad
+    regions exactly zero (variance: one), so the Keras-oracle logits
+    test above doubles as the numerics proof."""
+    entry, km, variables = _oracle("Xception", oracle_cache)
+    assert entry.make_module().middle_width == 768
+    pk = np.asarray(
+        variables["params"]["block5_sepconv1"]["pointwise_kernel"]
+    )
+    assert pk.shape == (1, 1, 768, 768)
+    assert np.all(pk[:, :, 728:, :] == 0) and np.all(pk[:, :, :, 728:] == 0)
+    dw = np.asarray(
+        variables["params"]["block5_sepconv1"]["depthwise_kernel"]
+    )
+    assert dw.shape[-1] == 768 and np.all(dw[..., 728:] == 0)
+    bn_var = np.asarray(
+        variables["batch_stats"]["block5_sepconv1_bn"]["var"]
+    )
+    assert np.all(bn_var[728:] == 1.0)
+    # the exit-flow 1024-channel side is untouched
+    assert variables["params"]["block13_sepconv2"][
+        "pointwise_kernel"
+    ].shape == (1, 1, 768, 1024)
+
+
+def test_xception_width_migration_paths():
+    """Pre-widening artifacts keep working: a 728-wide variables pytree
+    passed as modelWeights pads up transparently, and a topless Keras
+    model (no 'predictions' layer) ports without a structure error."""
+    from sparkdl_tpu.models.xception import Xception
+    from sparkdl_tpu.transformers.named_image import _resolve_variables
+
+    narrow_shapes = jax.eval_shape(
+        Xception(middle_width=728).init,
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 299, 299, 3), jnp.float32),
+    )
+    narrow = jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, l.dtype), narrow_shapes
+    )
+    resolved = _resolve_variables("Xception", narrow)
+    assert resolved["params"]["block5_sepconv1"][
+        "pointwise_kernel"
+    ].shape == (1, 1, 768, 768)
+    # idempotent for already-widened pytrees
+    again = _resolve_variables("Xception", resolved)
+    assert again["params"]["block5_sepconv1"][
+        "pointwise_kernel"
+    ].shape == (1, 1, 768, 768)
+
+    km_topless = keras.applications.Xception(
+        weights=None, include_top=False
+    )
+    variables = get_keras_application_model("Xception").load_variables(
+        km_topless
+    )
+    assert "predictions" not in variables["params"]
+    assert variables["params"]["block5_sepconv1"][
+        "pointwise_kernel"
+    ].shape == (1, 1, 768, 768)
