@@ -28,8 +28,6 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("KERAS_BACKEND", "jax")
 
-N_FEW = 3
-N_MANY = 9
 BATCH = 64
 
 
@@ -37,7 +35,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("-k", type=int, default=5)
     ap.add_argument("--model", default="InceptionV3")
+    ap.add_argument("--few", type=int, default=2)
+    ap.add_argument("--many", type=int, default=20,
+                    help="wider few/many delta -> more signal vs the "
+                         "~27s per-invocation setup variance")
     args = ap.parse_args()
+    N_FEW, N_MANY = args.few, args.many
 
     from sparkdl_tpu.models.registry import get_keras_application_model
     from sparkdl_tpu.native.featurizer import (
@@ -49,7 +52,12 @@ def main():
     entry = get_keras_application_model(args.model)
     h, w = entry.input_size
     prog_dir = tempfile.mkdtemp(prefix="native_marginal_")
-    export_featurizer(args.model, batch_size=BATCH, out_dir=prog_dir)
+    # random weights: the FLOP rate is weight-independent and the rig is
+    # offline (no imagenet cache)
+    export_featurizer(
+        args.model, batch_size=BATCH, out_dir=prog_dir,
+        model_weights="random",
+    )
 
     rng = np.random.RandomState(0)
     stack = (rng.rand(N_MANY, BATCH, h, w, 3) * 255).astype(np.uint8)

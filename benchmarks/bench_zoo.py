@@ -36,17 +36,16 @@ def main():
     args = ap.parse_args()
     names = args.models or sorted(SUPPORTED_MODELS)
     for name in names:
-        outs = [
-            measure_featurizer(name, args.batch, args.scan)
-            for _ in range(args.k)
-        ]
-        summary = summarize_samples([o["images_per_sec"] for o in outs])
-        # mfu/input from the trial closest to the median, so the two
-        # headline numbers come from the same measurement
-        out = min(
-            outs,
-            key=lambda o: abs(o["images_per_sec"] - summary["median"]),
+        # one compile per model; k timed trial groups share the program
+        out = measure_featurizer(name, args.batch, args.scan, trials=args.k)
+        summary = summarize_samples(out["samples"])
+        # mfu from the trial closest to the median, so the two headline
+        # numbers come from the same measurement
+        med_i = min(
+            range(len(out["samples"])),
+            key=lambda i: abs(out["samples"][i] - summary["median"]),
         )
+        mfu_val = out["mfu_samples"][med_i]
         h, w = out["input_hw"]
         print(
             json.dumps(
@@ -57,9 +56,7 @@ def main():
                     "iqr": summary["iqr"],
                     "k": args.k,
                     "input": f"{h}x{w}",
-                    "mfu": round(out["mfu"], 4)
-                    if out["mfu"] is not None
-                    else None,
+                    "mfu": round(mfu_val, 4) if mfu_val is not None else None,
                 }
             ),
             flush=True,

@@ -15,6 +15,12 @@ timing that survives the loopback relay — BASELINE.md measurement notes):
   effect is undiluted and the achieved TF/s is the direct receipt.
 
 Usage (real TPU):  python benchmarks/xception_pad_experiment.py
+
+Note: ``full_model`` here keeps the BGR flip in-program (production folds
+it into the stem for 'tf'-mode models), so its absolute img/s sits ~2-3%
+under the production ``bench_zoo`` figure; the W=728 vs W=768 *delta* is
+what this script is for — the authoritative production number is
+``bench_zoo.py Xception``.
 """
 
 from __future__ import annotations

@@ -123,14 +123,23 @@ def _resolve_variables(model_name: str, spec) -> Any:
         if entry.module_kwargs:
             # TPU-layout module variants (Xception's 768-wide middle
             # flow): a pytree saved at the original Keras width pads up
-            # transparently; already-widened pytrees pass through
-            from sparkdl_tpu.models.keras_port import (
-                pad_variables_to_module,
-            )
+            # transparently; already-widened pytrees pass through.
+            # Memoized per input object — a fresh padded pytree every
+            # call would change id(resolved) and defeat the
+            # _FORWARD_CACHE, recompiling the XLA program per transform
+            key = id(spec)
+            if key not in _PORTED_CACHE or _PORTED_CACHE[key][0] is not spec:
+                from sparkdl_tpu.models.keras_port import (
+                    pad_variables_to_module,
+                )
 
-            return pad_variables_to_module(
-                spec, entry.make_module(), entry.input_size
-            )
+                _PORTED_CACHE[key] = (
+                    spec,
+                    pad_variables_to_module(
+                        spec, entry.make_module(), entry.input_size
+                    ),
+                )
+            return _PORTED_CACHE[key][1]
         return spec
     # A built Keras model: port once per model object so repeated
     # _build_forward calls (fit -> transform, CV folds) reuse the same

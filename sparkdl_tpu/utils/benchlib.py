@@ -71,7 +71,10 @@ def summarize_samples(vals) -> dict:
     else:
         q1 = q3 = vals[0]
     return {
-        "samples": [round(v, 3) for v in vals],
+        # samples stay unrounded: downstream math (e.g. the marginal-cost
+        # differences in bench_native_marginal) must not compound display
+        # quantization
+        "samples": vals,
         "median": round(statistics.median(vals), 3),
         "iqr": [round(q1, 3), round(q3, 3)],
     }
@@ -101,10 +104,18 @@ def paired_trials(measurers, k: int = 5) -> dict:
 
 
 def measure_featurizer(
-    model_name: str, batch: int, scan: int, repeats: int = 3
+    model_name: str, batch: int, scan: int, repeats: int = 3,
+    trials: int = 1,
 ) -> dict:
     """Sustained on-chip throughput + MFU of ``model_name``'s fused
-    featurize program.  Returns ``{images_per_sec, mfu, input_hw}``."""
+    featurize program.
+
+    ``trials`` independent samples share ONE compile (each trial is
+    min-of-``repeats`` timed runs — re-compiling per sample would buy no
+    statistical independence since compile time is excluded anyway).
+    Returns ``{images_per_sec, mfu, input_hw, samples, mfu_samples}``;
+    ``images_per_sec``/``mfu`` are the first trial (back-compatible for
+    ``trials=1`` callers like bench.py)."""
     from sparkdl_tpu.models import get_keras_application_model
     from sparkdl_tpu.models.registry import fold_bgr_flip_into_stem
 
@@ -152,23 +163,29 @@ def measure_featurizer(
 
     compiled = jax.jit(run_many).lower(variables, stack).compile()
     np.asarray(compiled(variables, stack))  # compile + warm
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        np.asarray(compiled(variables, stack))  # host fetch forces the chain
-        times.append(time.perf_counter() - t0)
-
-    images_per_sec = scan * batch / min(times)
 
     flops = compiled_flops(compiled)
-    mfu_frac = None
+    per_call = None
     if flops:
         once = scan_body_counted_once()
         if once is not None:
             per_call = flops * scan if once else flops
-            mfu_frac = mfu(per_call, min(times), device)
+
+    rates, mfus = [], []
+    for _ in range(max(1, trials)):
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            np.asarray(compiled(variables, stack))  # fetch forces the chain
+            times.append(time.perf_counter() - t0)
+        t = min(times)
+        rates.append(scan * batch / t)
+        mfus.append(mfu(per_call, t, device) if per_call else None)
+
     return {
-        "images_per_sec": images_per_sec,
-        "mfu": mfu_frac,
+        "images_per_sec": rates[0],
+        "mfu": mfus[0],
         "input_hw": (h, w),
+        "samples": rates,
+        "mfu_samples": mfus,
     }
