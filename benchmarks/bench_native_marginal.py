@@ -60,7 +60,11 @@ def main():
     )
 
     rng = np.random.RandomState(0)
-    stack = (rng.rand(N_MANY, BATCH, h, w, 3) * 255).astype(np.uint8)
+    # randint(dtype=uint8) — rand() would allocate a ~2.7 GB float64
+    # intermediate at the default 20-batch stack
+    stack = rng.randint(
+        0, 256, size=(N_MANY, BATCH, h, w, 3), dtype=np.uint8
+    )
 
     def run(n_batches: int) -> float:
         t0 = time.perf_counter()

@@ -28,19 +28,23 @@ if [[ $rc -ne 0 ]]; then
   exit "$rc"
 fi
 
-# Honesty gate (VERDICT r3 #7): this rig ships every optional dependency
-# (torch, transformers, keras — the cross-framework oracle deps), so a
-# clean run must have ZERO skipped tests.  The suite's 241-passed-0-skipped
-# signal is real; if oracle tests start silently skipping (a dep import
-# regression, a guard typo), fail loudly instead of shrinking coverage.
-if python -c '
-import importlib.util as u, sys
-sys.exit(0 if all(u.find_spec(m) for m in ("torch", "transformers", "keras"))
-         else 1)
+# Honesty gate (VERDICT r3 #7): a rig that ships every optional
+# dependency (torch/transformers/keras/tensorflow/orbax, a C++ toolchain
+# for the native targets) must report ZERO skipped tests — the suite's
+# 241-passed-0-skipped signal is real; if oracle tests start silently
+# skipping (a dep import regression, a guard typo), fail loudly instead
+# of shrinking coverage.  Environment-INVERSE skips (tests that only run
+# when a local imagenet cache is absent) are allowlisted; set
+# SPARKDL_ALLOW_SKIPS=1 to disable the gate on partial rigs.
+if [[ "${SPARKDL_ALLOW_SKIPS:-}" != "1" ]] && python -c '
+import importlib.util as u, shutil, sys
+deps = ("torch", "transformers", "keras", "tensorflow", "orbax.checkpoint")
+ok = all(u.find_spec(m) for m in deps) and shutil.which("g++")
+sys.exit(0 if ok else 1)
 '; then
-  if grep -qE '[0-9]+ skipped' "$log"; then
+  if grep -E '^SKIPPED' "$log" | grep -vq 'imagenet cache exists'; then
     echo "run-tests: SKIPPED TESTS on a rig with all optional deps:" >&2
-    grep -E 'SKIPPED|[0-9]+ skipped' "$log" | tail -20 >&2
+    grep -E '^SKIPPED|[0-9]+ skipped' "$log" | tail -20 >&2
     rm -f "$log"
     exit 1
   fi

@@ -34,6 +34,29 @@ _JOIN_HOW: Dict[str, str] = {
 }
 
 
+def _dedupe_key(v):
+    """A hashable full-content fingerprint of one cell for
+    dropDuplicates.  repr() would truncate large numpy arrays (numpy
+    elides the middle with '...'), silently collapsing distinct feature
+    vectors — arrays fingerprint by (shape, dtype, bytes) instead."""
+    import numpy as np
+
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        pass
+    if isinstance(v, np.ndarray):
+        return (v.shape, v.dtype.str, v.tobytes())
+    if isinstance(v, (list, tuple)):
+        return tuple(_dedupe_key(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(
+            sorted((k, _dedupe_key(x)) for k, x in v.items())
+        )
+    return repr(v)
+
+
 def _partition_nrows(part: Partition) -> int:
     if not part:
         return 0
@@ -491,15 +514,88 @@ class DataFrame:
                 )
         return [self._with_partitions(b) for b in buckets]
 
-    def orderBy(self, *cols: str, ascending: bool = True) -> "DataFrame":
+    def orderBy(
+        self, *cols: "Column | str", ascending: "bool | Sequence[bool]" = True
+    ) -> "DataFrame":
+        """Sort by one or more columns.  ``ascending`` is a bool for all
+        keys or a per-key list (pyspark form); Spark null ordering:
+        NULLS FIRST ascending, NULLS LAST descending."""
         names = self.columns
-        rows = self.collect()
         keys = [c if isinstance(c, str) else c._name for c in cols]
-        rows.sort(key=lambda r: tuple(r[k] for k in keys), reverse=not ascending)
+        for k in keys:
+            if k not in names:
+                raise KeyError(f"No such column: {k!r}")
+        if isinstance(ascending, (list, tuple)):
+            if len(ascending) != len(keys):
+                raise ValueError(
+                    f"ascending list length {len(ascending)} != "
+                    f"{len(keys)} sort columns"
+                )
+            asc = [bool(a) for a in ascending]
+        else:
+            asc = [bool(ascending)] * len(keys)
+        rows = self.collect()
+        # stable multi-key sort: apply keys right-to-left; the (is-null
+        # rank, value) key gives Spark's null ordering under reverse=
+        for k, a in reversed(list(zip(keys, asc))):
+            rows.sort(
+                key=lambda r: (
+                    (0 if r[k] is None else 1),
+                    0 if r[k] is None else r[k],
+                ),
+                reverse=not a,
+            )
         part = {c: [r[c] for r in rows] for c in names}
         return self._with_partitions([part])
 
     sort = orderBy
+
+    def dropDuplicates(
+        self, subset: Optional[Sequence[str]] = None
+    ) -> "DataFrame":
+        """Keep the first occurrence of each distinct row (optionally
+        judged on ``subset`` columns only) — pyspark semantics; NULLs
+        compare equal to NULLs here, as in Spark's dropDuplicates."""
+        cols = list(subset) if subset else self.columns
+        for c in cols:
+            if c not in self.columns:
+                raise KeyError(f"No such column: {c!r}")
+        seen: set = set()
+        out_parts: List[Partition] = []
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            mask = []
+            for i in range(n):
+                key = tuple(_dedupe_key(part[c][i]) for c in cols)
+                if key in seen:
+                    mask.append(False)
+                else:
+                    seen.add(key)
+                    mask.append(True)
+            out_parts.append(
+                {
+                    c: [v for v, m in zip(vals, mask) if m]
+                    for c, vals in part.items()
+                }
+            )
+        return self._with_partitions(out_parts)
+
+    drop_duplicates = dropDuplicates
+
+    def distinct(self) -> "DataFrame":
+        return self.dropDuplicates()
+
+    @property
+    def na(self) -> "DataFrameNaFunctions":
+        return DataFrameNaFunctions(self)
+
+    def dropna(self, how: str = "any", thresh: Optional[int] = None,
+               subset: Optional[Sequence[str]] = None) -> "DataFrame":
+        return self.na.drop(how=how, thresh=thresh, subset=subset)
+
+    def fillna(self, value, subset: Optional[Sequence[str]] = None
+               ) -> "DataFrame":
+        return self.na.fill(value, subset=subset)
 
     def groupBy(self, *cols: "Column | str") -> "GroupedData":
         """Group by one or more columns (pyspark ``GroupedData`` subset:
@@ -577,6 +673,105 @@ class DataFrame:
             f"{f.name}: {f.dataType.simpleString()}" for f in self._schema
         )
         return f"DataFrame[{cols}]"
+
+
+class DataFrameNaFunctions:
+    """``df.na`` — the pyspark null-handling surface (drop/fill)."""
+
+    def __init__(self, df: DataFrame):
+        self._df = df
+
+    def drop(self, how: str = "any", thresh: Optional[int] = None,
+             subset: Optional[Sequence[str]] = None) -> DataFrame:
+        """Drop rows with nulls.  ``how="any"`` drops a row when any of
+        the judged columns is null, ``"all"`` only when every one is;
+        ``thresh=k`` (overrides ``how``, as in Spark) keeps rows with at
+        least k non-null judged values."""
+        df = self._df
+        cols = list(subset) if subset else df.columns
+        for c in cols:
+            if c not in df.columns:
+                raise KeyError(f"No such column: {c!r}")
+        if how not in ("any", "all"):
+            raise ValueError(f"how must be 'any' or 'all', got {how!r}")
+        need = (
+            thresh if thresh is not None
+            else (len(cols) if how == "any" else 1)
+        )
+
+        def keep(r) -> bool:
+            return sum(r[c] is not None for c in cols) >= need
+
+        return df.filter(keep)
+
+    def fill(self, value, subset: Optional[Sequence[str]] = None
+             ) -> DataFrame:
+        """Replace nulls.  ``value`` is a scalar (applied to ``subset``
+        or, Spark-style, to every column whose type matches the value's)
+        or a ``{column: value}`` dict."""
+        df = self._df
+        if isinstance(value, dict):
+            if subset is not None:
+                raise ValueError("pass either a value dict or subset")
+            fills = dict(value)
+        else:
+            if subset is None:
+                # Spark fills only type-compatible columns; numeric
+                # values fill numeric columns, strings fill strings,
+                # bools fill bools
+                from sparkdl_tpu.sql.types import (
+                    BooleanType,
+                    DoubleType,
+                    FloatType,
+                    IntegerType,
+                    LongType,
+                    StringType,
+                )
+
+                if isinstance(value, bool):
+                    ok = (BooleanType,)
+                elif isinstance(value, (int, float)):
+                    ok = (IntegerType, LongType, FloatType, DoubleType)
+                elif isinstance(value, str):
+                    ok = (StringType,)
+                else:
+                    raise TypeError(
+                        f"unsupported fill value type {type(value).__name__}"
+                    )
+                subset = [
+                    f.name for f in df.schema
+                    if isinstance(f.dataType, ok)
+                ]
+            fills = {c: value for c in subset}
+        for c in fills:
+            if c not in df.columns:
+                raise KeyError(f"No such column: {c!r}")
+        # Spark casts the fill value to the column's declared type (fill
+        # 0.5 into an int column stores 0) — keep the schema honest for
+        # typed consumers (to_arrow etc.)
+        from sparkdl_tpu.sql.types import (
+            DoubleType,
+            FloatType,
+            IntegerType,
+            LongType,
+        )
+
+        def cast_for(c, v):
+            t = df._field_type(c)
+            if isinstance(t, (IntegerType, LongType)):
+                return int(v)
+            if isinstance(t, (FloatType, DoubleType)):
+                return float(v)
+            return v
+
+        fills = {c: cast_for(c, v) for c, v in fills.items()}
+        out_parts = []
+        for part in df._partitions:
+            p = dict(part)
+            for c, v in fills.items():
+                p[c] = [v if cell is None else cell for cell in p[c]]
+            out_parts.append(p)
+        return df._with_partitions(out_parts)
 
 
 #: SQL/GroupedData aggregate functions: name -> (fn(values) -> scalar).

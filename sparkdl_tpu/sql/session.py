@@ -230,7 +230,7 @@ class TPUSession:
         rf"(?:\s*(?!(?:{_KEYWORDS})\b)[\w.=]+)+"
     )
     _SQL_RE = re.compile(
-        r"^\s*SELECT\s+(?P<proj>.+?)\s+FROM\s+(?P<table>\w+)"
+        r"^\s*SELECT\s+(?P<distinct>DISTINCT\s+)?(?P<proj>.+?)\s+FROM\s+(?P<table>\w+)"
         rf"(?:\s+(?:AS\s+)?(?!(?:{_KEYWORDS})\b)(?P<talias>\w+))?"
         r"(?P<joins>(?:\s+(?:INNER\s+|LEFT\s+(?:OUTER\s+)?|RIGHT\s+"
         r"(?:OUTER\s+)?|FULL\s+(?:OUTER\s+)?)?JOIN\s+\w+"
@@ -238,7 +238,8 @@ class TPUSession:
         r"(?:\s+WHERE\s+(?P<where>.+?))?"
         r"(?:\s+GROUP\s+BY\s+(?P<group>[\w\s,\.]+?))?"
         r"(?:\s+HAVING\s+(?P<having>.+?))?"
-        r"(?:\s+ORDER\s+BY\s+(?P<order>\w+(?:\s+(?:ASC|DESC))?))?"
+        r"(?:\s+ORDER\s+BY\s+(?P<order>\w+(?:\s+(?:ASC|DESC))?"
+        r"(?:\s*,\s*\w+(?:\s+(?:ASC|DESC))?)*))?"
         r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
         re.IGNORECASE | re.DOTALL,
     )
@@ -294,24 +295,39 @@ class TPUSession:
         if m.group("having") and not is_agg:
             raise ValueError("HAVING requires a GROUP BY / aggregate query")
         order = m.group("order")
-        order_col, ascending = None, True
+        order_keys: List[tuple] = []  # (column, ascending) per sort key
         if order:
-            parts = order.split()
-            order_col = parts[0]
-            ascending = len(parts) == 1 or parts[1].upper() != "DESC"
+            for item in order.split(","):
+                parts = item.split()
+                order_keys.append(
+                    (parts[0], len(parts) == 1 or parts[1].upper() != "DESC")
+                )
 
+        def apply_order(df: DataFrame) -> DataFrame:
+            return df.orderBy(
+                *[n for n, _ in order_keys],
+                ascending=[a for _, a in order_keys],
+            )
+
+        distinct = bool(m.group("distinct"))
         if is_agg:
+            if distinct:
+                raise ValueError(
+                    "SELECT DISTINCT with aggregates is not supported; "
+                    "GROUP BY output is already one row per group"
+                )
             out = self._sql_aggregate(
                 out, proj_raw, group, having=m.group("having"),
                 qualifiers=quals, columns=out.columns,
             )
-            if order_col is not None:
-                if order_col not in out.columns:
+            for name, _ in order_keys:
+                if name not in out.columns:
                     raise ValueError(
-                        f"ORDER BY {order_col!r}: not an output column of "
+                        f"ORDER BY {name!r}: not an output column of "
                         f"the aggregation ({out.columns})"
                     )
-                out = out.orderBy(order_col, ascending=ascending)
+            if order_keys:
+                out = apply_order(out)
         else:
             star = m.group("proj").strip() == "*"
             exprs: List[Column] = (
@@ -321,25 +337,49 @@ class TPUSession:
                     for raw in proj_raw
                 ]
             )
+            post_names = out.columns if star else [e._name for e in exprs]
             sort_after = False
-            if order_col is not None:
-                # SQL resolution order: a select-list alias wins over an
-                # input column of the same name (sort AFTER projecting);
-                # otherwise the sort column need not be selected (sort
-                # before — select preserves row order)
-                if any(e._name == order_col for e in exprs):
-                    sort_after = True
-                elif order_col not in out.columns:
+            hidden_sort: List[str] = []
+            if order_keys:
+                # SQL resolution: each key resolves against the select
+                # list first (aliases win over same-named input columns),
+                # else against the input.  Any select-list hit forces the
+                # sort AFTER projection; input-only keys ride along as
+                # hidden projected columns and are dropped afterwards
+                # (the sort column need not be selected).
+                missing = [
+                    n for n, _ in order_keys
+                    if n not in post_names and n not in out.columns
+                ]
+                if missing:
                     raise ValueError(
-                        f"ORDER BY {order_col!r}: no such column "
+                        f"ORDER BY {missing}: no such column "
                         f"({out.columns}) or projection alias"
                     )
-            if order_col is not None and not sort_after:
-                out = out.orderBy(order_col, ascending=ascending)
+                if any(n in post_names for n, _ in order_keys):
+                    sort_after = True
+                    for n, _ in order_keys:
+                        if n not in post_names and n not in hidden_sort:
+                            exprs.append(col(n))
+                            hidden_sort.append(n)
+                if distinct and hidden_sort:
+                    # Spark's rule: DISTINCT dedupes the projected rows,
+                    # so a sort column outside the select list has no
+                    # well-defined value per deduped row
+                    raise ValueError(
+                        "SELECT DISTINCT: ORDER BY columns must appear "
+                        "in the select list"
+                    )
+            if order_keys and not sort_after:
+                out = apply_order(out)
             if not star:
                 out = out.select(*exprs)
+            if distinct:
+                out = out.distinct()
             if sort_after:
-                out = out.orderBy(order_col, ascending=ascending)
+                out = apply_order(out)
+                for h in hidden_sort:
+                    out = out.drop(h)
         if m.group("limit"):
             out = out.limit(int(m.group("limit")))
         return out

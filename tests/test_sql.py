@@ -821,3 +821,124 @@ class TestSqlResolution:
         with pytest.raises((ValueError, KeyError)):
             views.sql(bad)
         assert time.perf_counter() - t0 < 1.0, "regex backtracking blowup"
+
+
+class TestDistinctNaOrder:
+    """distinct/dropDuplicates, df.na drop/fill, multi-key ORDER BY —
+    the high-traffic pyspark surface around the serving-analytics flow."""
+
+    @pytest.fixture()
+    def ddf(self, tpu_session):
+        return tpu_session.createDataFrame(
+            [(1, "a", 0.5), (1, "a", 0.5), (2, "a", None),
+             (2, "b", 0.7), (None, "b", 0.7)],
+            ["k", "tag", "score"],
+        )
+
+    def test_distinct_and_drop_duplicates(self, ddf, tpu_session):
+        assert ddf.distinct().count() == 4  # exact dup row collapses
+        # subset form keeps the FIRST row per key
+        firsts = ddf.dropDuplicates(["tag"]).collect()
+        assert [(r.k, r.tag) for r in firsts] == [(1, "a"), (2, "b")]
+        with pytest.raises(KeyError):
+            ddf.dropDuplicates(["nope"])
+        ddf.createOrReplaceTempView("ddup")
+        rows = tpu_session.sql("SELECT DISTINCT tag FROM ddup").collect()
+        assert sorted(r.tag for r in rows) == ["a", "b"]
+        rows2 = tpu_session.sql(
+            "SELECT DISTINCT k, tag FROM ddup WHERE k IS NOT NULL"
+        ).collect()
+        assert len(rows2) == 3
+
+    def test_na_drop(self, ddf):
+        assert ddf.na.drop().count() == 3  # rows with any null dropped
+        assert ddf.dropna(how="all").count() == 5
+        assert ddf.na.drop(subset=["score"]).count() == 4
+        assert ddf.na.drop(thresh=3).count() == 3
+        with pytest.raises(ValueError, match="how"):
+            ddf.na.drop(how="some")
+
+    def test_na_fill(self, ddf):
+        # scalar fill touches only type-compatible columns (Spark rule)
+        filled = ddf.na.fill(0.0)
+        rows = filled.collect()
+        assert all(r.score is not None for r in rows)
+        assert any(r.k is None for r in rows) is False  # int col filled too
+        # strings untouched by numeric fill
+        strs = ddf.na.fill("x").collect()
+        assert any(r.score is None for r in strs)  # floats untouched
+        # dict form
+        d = ddf.fillna({"score": -1.0}).collect()
+        assert sorted(r.score for r in d)[0] == -1.0
+
+    def test_multi_key_order_by(self, ddf, tpu_session):
+        out = ddf.orderBy("tag", "score", ascending=[True, False])
+        rows = out.collect()
+        assert [(r.tag, r.score) for r in rows] == [
+            ("a", 0.5), ("a", 0.5), ("a", None),  # desc: nulls last
+            ("b", 0.7), ("b", 0.7),
+        ]
+        # SQL form with per-key direction
+        ddf.createOrReplaceTempView("ord_t")
+        got = tpu_session.sql(
+            "SELECT k, tag, score FROM ord_t "
+            "ORDER BY tag ASC, score DESC"
+        ).collect()
+        assert [(r.tag, r.score) for r in got] == [
+            ("a", 0.5), ("a", 0.5), ("a", None),
+            ("b", 0.7), ("b", 0.7),
+        ]
+
+    def test_order_by_null_ordering(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [(3,), (None,), (1,)], ["v"]
+        )
+        asc = [r.v for r in df.orderBy("v").collect()]
+        assert asc == [None, 1, 3]  # Spark: NULLS FIRST ascending
+        desc = [r.v for r in df.orderBy("v", ascending=False).collect()]
+        assert desc == [3, 1, None]  # NULLS LAST descending
+
+    def test_order_by_mixed_alias_and_hidden_input(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(1, 0.5, "b"), (2, 0.5, "a"), (3, 0.9, "c")],
+            ["k", "score", "tag"],
+        ).createOrReplaceTempView("mix_t")
+        # 'score' is an alias shadowing an input column (negated), 'tag'
+        # is an unprojected input column — per-key resolution: alias
+        # value sorts, tag rides along hidden and is dropped after
+        rows = tpu_session.sql(
+            "SELECT k, -score AS score FROM mix_t ORDER BY score, tag"
+        ).collect()
+        assert [r.k for r in rows] == [3, 2, 1]  # -0.9 < -0.5(a) < -0.5(b)
+        assert rows and rows[0]._fields == ("k", "score")
+        # alias-only multi-key still valid
+        rows2 = tpu_session.sql(
+            "SELECT score AS s, k FROM mix_t ORDER BY s, k"
+        ).collect()
+        assert [r.k for r in rows2] == [1, 2, 3]
+        with pytest.raises(ValueError, match="select list"):
+            tpu_session.sql(
+                "SELECT DISTINCT k FROM mix_t ORDER BY k, tag"
+            )
+
+    def test_drop_duplicates_array_cells_full_content(self, tpu_session):
+        # large arrays must fingerprint by content, not truncated repr
+        a = np.zeros(2048, np.float32)
+        b = np.zeros(2048, np.float32)
+        b[500] = 1.0  # differs only in the repr-elided middle
+        df = tpu_session.createDataFrame(
+            [(1, a), (2, b), (3, a.copy())], ["k", "feat"]
+        )
+        out = df.distinct().collect()
+        assert len(out) == 3  # k differs everywhere
+        out2 = df.dropDuplicates(["feat"]).collect()
+        assert [r.k for r in out2] == [1, 2]  # a == a.copy(), b distinct
+
+    def test_na_fill_casts_to_column_type(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [(1, 1.5), (None, None)], ["i", "f"]
+        )
+        rows = df.na.fill(0.5).collect()
+        filled_i = [r.i for r in rows if r.i is not None]
+        assert 0 in filled_i and all(isinstance(v, int) for v in filled_i)
+        assert any(r.f == 0.5 for r in rows)
