@@ -39,13 +39,13 @@ def _dedupe_key(v):
     dropDuplicates.  repr() would truncate large numpy arrays (numpy
     elides the middle with '...'), silently collapsing distinct feature
     vectors — arrays fingerprint by (shape, dtype, bytes) instead."""
-    import numpy as np
-
     try:
         hash(v)
         return v
     except TypeError:
         pass
+    import numpy as np  # after the fast path: hot per-cell loop
+
     if isinstance(v, np.ndarray):
         return (v.shape, v.dtype.str, v.tobytes())
     if isinstance(v, (list, tuple)):
@@ -746,25 +746,40 @@ class DataFrameNaFunctions:
         for c in fills:
             if c not in df.columns:
                 raise KeyError(f"No such column: {c!r}")
-        # Spark casts the fill value to the column's declared type (fill
-        # 0.5 into an int column stores 0) — keep the schema honest for
-        # typed consumers (to_arrow etc.)
+        # pyspark semantics: type-incompatible columns are silently
+        # IGNORED (fill("x") never touches an int column), and numeric
+        # fills cast to the column's declared type (0.5 into an int
+        # column stores 0) — keeping the schema honest for typed
+        # consumers (to_arrow etc.)
         from sparkdl_tpu.sql.types import (
+            BooleanType,
             DoubleType,
             FloatType,
             IntegerType,
             LongType,
+            StringType,
         )
 
         def cast_for(c, v):
+            """Casted value, or None to skip the column."""
             t = df._field_type(c)
-            if isinstance(t, (IntegerType, LongType)):
-                return int(v)
-            if isinstance(t, (FloatType, DoubleType)):
-                return float(v)
-            return v
+            if isinstance(v, bool):
+                return v if isinstance(t, BooleanType) else None
+            if isinstance(v, (int, float)):
+                if isinstance(t, (IntegerType, LongType)):
+                    return int(v)
+                if isinstance(t, (FloatType, DoubleType)):
+                    return float(v)
+                return None
+            if isinstance(v, str):
+                return v if isinstance(t, StringType) else None
+            return None
 
-        fills = {c: cast_for(c, v) for c, v in fills.items()}
+        fills = {
+            c: cv
+            for c, v in fills.items()
+            if (cv := cast_for(c, v)) is not None
+        }
         out_parts = []
         for part in df._partitions:
             p = dict(part)

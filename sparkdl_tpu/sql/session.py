@@ -188,7 +188,13 @@ class TPUSession:
             if isinstance(schema, StructType):
                 st.add(c, schema[c].dataType)
             else:
-                st.add(c, infer_type(values[0][j]) if n else None or infer_type(None))
+                # first NON-NULL value anywhere in the column (same probe
+                # discipline as DataFrame._infer_column_type — a leading
+                # None must not leave the column untyped)
+                probe = next(
+                    (row[j] for row in values if row[j] is not None), None
+                )
+                st.add(c, infer_type(probe))
         return DataFrame(parts, st, self)
 
     @property
@@ -356,20 +362,23 @@ class TPUSession:
                         f"ORDER BY {missing}: no such column "
                         f"({out.columns}) or projection alias"
                     )
+                if distinct and any(
+                    n not in post_names for n, _ in order_keys
+                ):
+                    # Spark's rule: DISTINCT dedupes the projected rows,
+                    # so a sort column outside the select list has no
+                    # well-defined value per deduped row (applies whether
+                    # or not other keys hit the select list)
+                    raise ValueError(
+                        "SELECT DISTINCT: ORDER BY columns must appear "
+                        "in the select list"
+                    )
                 if any(n in post_names for n, _ in order_keys):
                     sort_after = True
                     for n, _ in order_keys:
                         if n not in post_names and n not in hidden_sort:
                             exprs.append(col(n))
                             hidden_sort.append(n)
-                if distinct and hidden_sort:
-                    # Spark's rule: DISTINCT dedupes the projected rows,
-                    # so a sort column outside the select list has no
-                    # well-defined value per deduped row
-                    raise ValueError(
-                        "SELECT DISTINCT: ORDER BY columns must appear "
-                        "in the select list"
-                    )
             if order_keys and not sort_after:
                 out = apply_order(out)
             if not star:

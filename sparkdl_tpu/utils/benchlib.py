@@ -140,11 +140,26 @@ def measure_featurizer(
     device = jax.devices()[0]
     variables = jax.device_put(variables, device)
 
-    rng = np.random.RandomState(0)
-    stack = jax.device_put(
-        jnp.asarray((rng.rand(scan, batch, h, w, 3) * 255).astype(np.uint8)),
-        device,
-    )
+    # the input stack is GENERATED on device (jitted PRNG, one scan slot
+    # at a time to bound the f32 intermediate) rather than staged from
+    # host — shipping the 2.2 GB SCAN=12 stack through the loopback
+    # relay was the staging stall that previously capped the scan depth.
+    # Batches stay distinct across slots (the anti-caching requirement).
+    def gen_stack(key):
+        keys = jax.random.split(key, scan)
+
+        def body(_, k):
+            xb = (
+                jax.random.uniform(k, (batch, h, w, 3)) * 255
+            ).astype(jnp.uint8)
+            return None, xb
+
+        _, out = jax.lax.scan(body, None, keys)
+        return out
+
+    with jax.default_device(device):
+        stack = jax.jit(gen_stack)(jax.random.PRNGKey(0))
+        stack.block_until_ready()
 
     def forward(v, x):
         if flip_in_program:

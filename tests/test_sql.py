@@ -942,3 +942,23 @@ class TestDistinctNaOrder:
         filled_i = [r.i for r in rows if r.i is not None]
         assert 0 in filled_i and all(isinstance(v, int) for v in filled_i)
         assert any(r.f == 0.5 for r in rows)
+
+    def test_distinct_order_by_unselected_always_rejected(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(1, "a")], ["k", "tag"]
+        ).createOrReplaceTempView("dgd_t")
+        with pytest.raises(ValueError, match="select list"):
+            tpu_session.sql("SELECT DISTINCT k FROM dgd_t ORDER BY tag")
+
+    def test_na_fill_ignores_incompatible_columns(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [(1, None, None), (None, "y", 0.5)], ["i", "s", "f"]
+        )
+        # string fill into an int column via subset: ignored, not a crash
+        rows = df.na.fill("unknown", subset=["i", "s"]).collect()
+        assert any(r.i is None for r in rows)  # int column untouched
+        assert all(r.s is not None for r in rows)
+        # dict form likewise ignores the type mismatch
+        rows2 = df.fillna({"i": "x", "f": 1}).collect()
+        assert any(r.i is None for r in rows2)
+        assert all(isinstance(r.f, float) for r in rows2 if r.f is not None)
