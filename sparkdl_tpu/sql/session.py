@@ -56,8 +56,20 @@ class UDFRegistry:
         except KeyError:
             raise KeyError(f"Undefined function: {name!r}") from None
 
+    def resolve(self, name: str) -> Optional[UserDefinedFunction]:
+        """The UDF for ``name`` — exact match first, else
+        case-insensitive (Spark's function resolution is
+        case-insensitive); None when unregistered."""
+        if name in self._udfs:
+            return self._udfs[name]
+        lowered = name.lower()
+        for k, f in self._udfs.items():
+            if k.lower() == lowered:
+                return f
+        return None
+
     def __contains__(self, name: str):
-        return name in self._udfs
+        return self.resolve(name) is not None
 
 
 class DataFrameReader:
@@ -935,14 +947,25 @@ class _PredicateParser:
 
             return lit(self._literal())
         if kind == "ident":
+            if val.upper() == "CASE":
+                self.i += 1
+                return self._case_expr()
+            if val.upper() == "NULL":
+                from sparkdl_tpu.sql.functions import lit
+
+                self.i += 1
+                return lit(None)
             # keywords that can follow an expression must not be eaten
             # as column refs (defensive; callers normally stop first)
             if val.upper() in ("AND", "OR", "NOT", "IN", "IS", "LIKE",
-                               "BETWEEN", "NULL"):
+                               "BETWEEN", "WHEN", "THEN", "ELSE", "END",
+                               "AS"):
                 raise ValueError(
                     f"Unexpected keyword {val!r} in {self.text!r}"
                 )
             self.i += 1
+            if val.upper() == "CAST" and self._peek() == ("punct", "("):
+                return self._cast_expr()
             if self._peek() == ("punct", "("):
                 return self._fn_call(val)
             if (val in self.qualifiers and val not in self.columns
@@ -972,6 +995,139 @@ class _PredicateParser:
             f"{val!r} in {self.text!r}"
         )
 
+    def _case_expr(self) -> Column:
+        """``CASE WHEN pred THEN expr [WHEN ...]* [ELSE expr] END`` —
+        branches evaluate under SQL 3VL (a NULL condition falls through,
+        as in Spark); no ELSE yields NULL."""
+        branches = []
+        while self._accept_kw("WHEN"):
+            cond = self._or_expr()
+            if not self._accept_kw("THEN"):
+                raise ValueError(
+                    f"Expected THEN after WHEN in {self.text!r}"
+                )
+            branches.append((cond, self._sum_expr()))
+        if not branches:
+            raise ValueError(
+                f"CASE requires at least one WHEN in {self.text!r}"
+            )
+        default = self._sum_expr() if self._accept_kw("ELSE") else None
+        if not self._accept_kw("END"):
+            raise ValueError(f"Expected END closing CASE in {self.text!r}")
+
+        def ev(cols, n):
+            # SQL conditional-evaluation guarantee (as Spark): branch
+            # conditions run in order only on still-unmatched rows, and
+            # branch VALUES run only on the rows their condition
+            # selected — `CASE WHEN n != 0 THEN 100 / n ELSE 0 END`
+            # must never divide by the guarded zero
+            out = [None] * n
+            remaining = list(range(n))
+
+            def sub_eval(expr, idx):
+                sub = {c: [vals[i] for i in idx] for c, vals in cols.items()}
+                return expr._eval(sub, len(idx))
+
+            for cexpr, vexpr in branches:
+                if not remaining:
+                    break
+                cvals = sub_eval(cexpr, remaining)
+                matched = [
+                    i for i, cv in zip(remaining, cvals) if cv
+                ]  # None and False both fall through
+                if matched:
+                    for i, v in zip(matched, sub_eval(vexpr, matched)):
+                        out[i] = v
+                remaining = [
+                    i for i, cv in zip(remaining, cvals) if not cv
+                ]
+            if default is not None and remaining:
+                for i, v in zip(remaining, sub_eval(default, remaining)):
+                    out[i] = v
+            return out
+
+        return Column(ev, "CASE")
+
+    _CAST_TYPES = {
+        "int": "int", "integer": "int", "bigint": "long", "long": "long",
+        "float": "float", "double": "double", "string": "string",
+        "boolean": "boolean", "bool": "boolean",
+    }
+
+    def _cast_expr(self) -> Column:
+        """``CAST(expr AS type)`` lowering to :meth:`Column.cast`."""
+        self._expect("punct", "(")
+        inner = self._sum_expr()
+        if not self._accept_kw("AS"):
+            raise ValueError(f"Expected AS inside CAST in {self.text!r}")
+        k, tname = self._next()
+        if k != "ident" or tname.lower() not in self._CAST_TYPES:
+            raise ValueError(
+                f"Unsupported CAST target {tname!r}; supported: "
+                f"{sorted(set(self._CAST_TYPES))}"
+            )
+        self._expect("punct", ")")
+        target = self._CAST_TYPES[tname.lower()]
+
+        def safe_cast(v):
+            # Spark CAST semantics: invalid conversions yield NULL (not
+            # a mid-query crash); numeric->int truncates toward zero;
+            # string->boolean accepts t/true/y/yes/1 and f/false/n/no/0
+            if v is None:
+                return None
+            try:
+                if target in ("int", "long"):
+                    return int(float(v)) if isinstance(v, str) else int(v)
+                if target in ("float", "double"):
+                    return float(v)
+                if target == "string":
+                    return str(v)
+                if target == "boolean":
+                    if isinstance(v, str):
+                        s = v.strip().lower()
+                        if s in ("t", "true", "y", "yes", "1"):
+                            return True
+                        if s in ("f", "false", "n", "no", "0"):
+                            return False
+                        return None
+                    return bool(v)
+            except (ValueError, TypeError, OverflowError):
+                return None
+            return None
+
+        return Column(
+            lambda cols, n: [safe_cast(v) for v in inner._eval(cols, n)],
+            f"CAST({inner._name} AS {target})",
+        )
+
+    #: built-in scalar functions (NULL-propagating except COALESCE,
+    #: whose whole point is the NULLs) — the high-traffic Spark SQL
+    #: builtins serving analytics use; a registered UDF of the same
+    #: name takes precedence.
+    _BUILTIN_FNS = {
+        "abs": (1, 1, lambda a: None if a is None else abs(a)),
+        "round": (1, 2, "_round_half_up"),
+        "upper": (1, 1, lambda a: None if a is None else a.upper()),
+        "lower": (1, 1, lambda a: None if a is None else a.lower()),
+        "length": (1, 1, lambda a: None if a is None else len(a)),
+        "coalesce": (
+            1, None,
+            lambda *vs: next((v for v in vs if v is not None), None),
+        ),
+    }
+
+    @staticmethod
+    def _round_half_up(a, d=0):
+        # Spark SQL ROUND is HALF_UP; Python round() is banker's
+        # (ROUND(2.5) must be 3, not 2).  NULL in either arg -> NULL.
+        if a is None or d is None:
+            return None
+        from decimal import ROUND_HALF_UP, Decimal
+
+        q = Decimal(1).scaleb(-int(d))
+        out = Decimal(str(a)).quantize(q, rounding=ROUND_HALF_UP)
+        return float(out) if isinstance(a, float) or int(d) > 0 else int(out)
+
     def _fn_call(self, name: str) -> Column:
         if name.lower() in self._AGG_NAMES and (
             self.udf is None or name not in self.udf
@@ -981,7 +1137,9 @@ class _PredicateParser:
                 "expression; compute it as its own projection (alias it "
                 "with AS) and reference the alias"
             )
-        if self.udf is None or name not in self.udf:
+        registered = self.udf is not None and self.udf.resolve(name)
+        builtin = self._BUILTIN_FNS.get(name.lower())
+        if not registered and builtin is None:
             raise KeyError(f"Undefined function: {name!r}")
         self._expect("punct", "(")
         args = []
@@ -991,7 +1149,23 @@ class _PredicateParser:
                 self.i += 1
                 args.append(self._sum_expr())
         self._expect("punct", ")")
-        return self.udf.get(name)(*args)
+        if registered:
+            return registered(*args)
+        lo, hi, fn = builtin
+        if isinstance(fn, str):
+            fn = getattr(self, fn)
+        if len(args) < lo or (hi is not None and len(args) > hi):
+            raise ValueError(
+                f"{name.upper()} takes "
+                + (f"{lo}" if hi == lo else f"{lo}..{hi or 'n'}")
+                + f" arguments, got {len(args)}"
+            )
+
+        def ev(cols, n, _args=args, _fn=fn):
+            evaluated = [a._eval(cols, n) for a in _args]
+            return [_fn(*vals) for vals in zip(*evaluated)] if n else []
+
+        return Column(ev, f"{name.lower()}(...)")
 
     @staticmethod
     def _unquote(val: str) -> str:

@@ -962,3 +962,129 @@ class TestDistinctNaOrder:
         rows2 = df.fillna({"i": "x", "f": 1}).collect()
         assert any(r.i is None for r in rows2)
         assert all(isinstance(r.f, float) for r in rows2 if r.f is not None)
+
+
+class TestCaseCastBuiltins:
+    """CASE WHEN / CAST / builtin scalar functions — the Spark SQL
+    expression idioms serving analytics lean on (AVG(CASE WHEN ...) is
+    the canonical accuracy query)."""
+
+    @pytest.fixture()
+    def cdf(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("a.png", "cat", "cat", 0.91), ("b.png", "dog", "cat", 0.44),
+             ("c.png", "cat", "cat", 0.67), ("d.png", None, "dog", None)],
+            ["origin", "pred", "truth", "score"],
+        ).createOrReplaceTempView("case_t")
+        return tpu_session
+
+    def test_case_when_projection(self, cdf):
+        rows = cdf.sql(
+            "SELECT origin, CASE WHEN pred = truth THEN 'hit' "
+            "WHEN pred IS NULL THEN 'missing' ELSE 'miss' END AS outcome "
+            "FROM case_t ORDER BY origin"
+        ).collect()
+        assert [r.outcome for r in rows] == [
+            "hit", "miss", "hit", "missing"
+        ]
+
+    def test_accuracy_idiom(self, cdf):
+        # the classic: per-class accuracy via AVG(CASE WHEN ...)
+        rows = cdf.sql(
+            "SELECT truth, AVG(CASE WHEN pred = truth THEN 1.0 "
+            "ELSE 0.0 END) AS acc FROM case_t GROUP BY truth "
+            "ORDER BY truth"
+        ).collect()
+        assert [(r.truth, round(r.acc, 4)) for r in rows] == [
+            ("cat", round(2 / 3, 4)), ("dog", 0.0)
+        ]
+
+    def test_case_without_else_yields_null(self, cdf):
+        rows = cdf.sql(
+            "SELECT CASE WHEN score > 0.9 THEN 'high' END AS band "
+            "FROM case_t"
+        ).collect()
+        assert sorted(str(r.band) for r in rows) == [
+            "None", "None", "None", "high"
+        ]
+
+    def test_cast(self, cdf):
+        rows = cdf.sql(
+            "SELECT origin, CAST(score * 100 AS int) AS pct FROM case_t "
+            "WHERE score IS NOT NULL ORDER BY origin"
+        ).collect()
+        assert [r.pct for r in rows] == [91, 44, 67]
+        assert all(isinstance(r.pct, int) for r in rows)
+        with pytest.raises(ValueError, match="CAST target"):
+            cdf.sql("SELECT CAST(score AS blob) FROM case_t")
+
+    def test_builtins(self, cdf):
+        rows = cdf.sql(
+            "SELECT UPPER(pred) AS up, LENGTH(origin) AS n, "
+            "ROUND(score * 100) AS r, COALESCE(score, -1.0) AS s, "
+            "ABS(-2) AS a FROM case_t ORDER BY origin"
+        ).collect()
+        assert rows[0].up == "CAT" and rows[0].n == 5
+        assert rows[0].r == 91 and rows[0].a == 2
+        # NULL propagation vs COALESCE
+        assert rows[3].up is None and rows[3].s == -1.0
+        with pytest.raises(KeyError, match="Undefined function"):
+            cdf.sql("SELECT frobnicate(score) FROM case_t")
+        # a registered UDF shadows a builtin of the same name
+        cdf.udf.register("upper", lambda v: "udf!")
+        got = cdf.sql("SELECT upper(pred) AS u FROM case_t LIMIT 1").collect()
+        assert got[0].u == "udf!"
+
+    def test_null_literal(self, cdf):
+        rows = cdf.sql(
+            "SELECT COALESCE(NULL, pred) AS p FROM case_t ORDER BY origin"
+        ).collect()
+        assert rows[0].p == "cat"
+
+    def test_case_conditional_evaluation(self, tpu_session):
+        # the SQL guarantee: guarded branches never evaluate on rows
+        # their condition excludes (guard-then-divide must not crash)
+        tpu_session.createDataFrame(
+            [(100, 4), (50, 0), (30, 3)], ["total", "n"]
+        ).createOrReplaceTempView("guard_t")
+        rows = tpu_session.sql(
+            "SELECT CASE WHEN n != 0 THEN total / n ELSE -1 END AS avg_v "
+            "FROM guard_t"
+        ).collect()
+        assert [r.avg_v for r in rows] == [25.0, -1, 10.0]
+
+    def test_cast_invalid_yields_null(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("12",), ("x",), (None,), ("3.7",)], ["s"]
+        ).createOrReplaceTempView("cast_t")
+        rows = tpu_session.sql(
+            "SELECT CAST(s AS int) AS i FROM cast_t"
+        ).collect()
+        assert [r.i for r in rows] == [12, None, None, 3]
+        bools = tpu_session.sql(
+            "SELECT CAST(s AS boolean) AS b FROM cast_t"
+        ).collect()
+        assert [b.b for b in bools] == [None, None, None, None]
+
+    def test_round_half_up_and_null_digits(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(2.5, 0), (3.5, 0), (2.345, 2), (1.0, None)],
+            ["v", "d"],
+        ).createOrReplaceTempView("round_t")
+        rows = tpu_session.sql(
+            "SELECT ROUND(v, d) AS r FROM round_t"
+        ).collect()
+        assert rows[0].r == 3 and rows[1].r == 4  # HALF_UP, not banker's
+        assert rows[2].r == pytest.approx(2.35)
+        assert rows[3].r is None  # NULL digits propagate
+
+    def test_udf_precedence_case_insensitive(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("a",)], ["k"]
+        ).createOrReplaceTempView("ci_t")
+        tpu_session.udf.register("upper", lambda v: "udf!")
+        for spelling in ("upper", "UPPER", "Upper"):
+            got = tpu_session.sql(
+                f"SELECT {spelling}(k) AS u FROM ci_t"
+            ).collect()
+            assert got[0].u == "udf!", spelling
