@@ -326,7 +326,17 @@ class KerasImageFileEstimator(
         """Deterministic subdirectory per training configuration, so fits
         with different param maps (fitMultiple / CrossValidator grids) or
         unrelated runs sharing one checkpointDir never restore each other's
-        state — while re-runs of the same configuration still resume."""
+        state — while re-runs of the same configuration still resume.
+
+        .. note:: the round-4 switch from ``repr()`` to
+           ``stable_description`` changed this fingerprint for EVERY
+           configuration, so checkpoints written by earlier builds sit in
+           orphaned namespace dirs and a re-fit under this build restarts
+           from epoch 0 (the old dirs are left behind, unreferenced).
+           Operators mid-training across the upgrade should finish on the
+           old build or accept the restart; the new fingerprint is
+           process-stable, so this is a one-time break, not a recurring
+           one."""
         import hashlib
         import json
 

@@ -51,8 +51,19 @@ def _dedupe_key(v):
     if isinstance(v, (list, tuple)):
         return tuple(_dedupe_key(x) for x in v)
     if isinstance(v, dict):
+        # mixed-type dict keys (int and str) would make a bare sorted()
+        # raise TypeError mid-dropDuplicates; but numeric keys must stay
+        # mutually ordered by VALUE (equal dicts may spell a key 2 vs
+        # 2.0 — a type-name tag alone would order them differently and
+        # split one fingerprint into two)
+        def rank(kv):
+            k = kv[0]
+            if isinstance(k, (int, float)):
+                return (0, float(k), "")
+            return (1, type(k).__name__, repr(k))
+
         return tuple(
-            sorted((k, _dedupe_key(x)) for k, x in v.items())
+            sorted(((k, _dedupe_key(x)) for k, x in v.items()), key=rank)
         )
     return repr(v)
 

@@ -85,7 +85,22 @@ class Column:
         return self._binop(other, operator.mul, "*")
 
     def __truediv__(self, other):
-        return self._binop(other, operator.truediv, "/")
+        # Spark SQL divide semantics: x / 0 is NULL, not an error — an
+        # unguarded ZeroDivisionError would abort the whole query for
+        # one bad row.  The explicit b == 0 probe matters for numpy
+        # scalar cells, whose truediv returns inf/nan without raising.
+        def safe_div(a, b):
+            try:
+                if b == 0:
+                    return None
+            except (TypeError, ValueError):
+                pass  # non-scalar operand (e.g. ndarray): let truediv act
+            try:
+                return operator.truediv(a, b)
+            except ZeroDivisionError:
+                return None
+
+        return self._binop(other, safe_div, "/")
 
     def __neg__(self):
         return Column(
@@ -175,17 +190,23 @@ class Column:
 
     def like(self, pattern: str) -> "Column":
         """SQL ``LIKE``: ``%`` matches any run, ``_`` any one character,
-        anchored to the whole string; NULL input yields NULL (pyspark
-        ``Column.like`` analog)."""
+        ``\\%``/``\\_``/``\\\\`` escape to literals (Spark's backslash
+        escapes), anchored to the whole string; NULL input yields NULL
+        (pyspark ``Column.like`` analog)."""
         import re as _re
 
-        rx = _re.compile(
-            "".join(
+        frags, i = [], 0
+        while i < len(pattern):
+            ch = pattern[i]
+            if ch == "\\" and i + 1 < len(pattern) and pattern[i + 1] in "%_\\":
+                frags.append(_re.escape(pattern[i + 1]))
+                i += 2
+                continue
+            frags.append(
                 ".*" if ch == "%" else "." if ch == "_" else _re.escape(ch)
-                for ch in pattern
-            ),
-            _re.DOTALL,
-        )
+            )
+            i += 1
+        rx = _re.compile("".join(frags), _re.DOTALL)
 
         def match(v):
             if v is None:

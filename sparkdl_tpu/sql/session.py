@@ -59,17 +59,29 @@ class UDFRegistry:
     def resolve(self, name: str) -> Optional[UserDefinedFunction]:
         """The UDF for ``name`` — exact match first, else
         case-insensitive (Spark's function resolution is
-        case-insensitive); None when unregistered."""
+        case-insensitive); None when unregistered.  Two registrations
+        differing only by case make any THIRD casing ambiguous — that
+        raises rather than silently resolving by registration order."""
         if name in self._udfs:
             return self._udfs[name]
         lowered = name.lower()
-        for k, f in self._udfs.items():
-            if k.lower() == lowered:
-                return f
-        return None
+        hits = [k for k in self._udfs if k.lower() == lowered]
+        if len(hits) > 1:
+            raise KeyError(
+                f"Ambiguous function name {name!r}: case-insensitively "
+                f"matches {sorted(hits)}; use one of those exact spellings"
+            )
+        return self._udfs[hits[0]] if hits else None
 
     def __contains__(self, name: str):
-        return self.resolve(name) is not None
+        # `in` keeps its bool contract even when resolution is ambiguous:
+        # a case-ambiguous name IS registered (twice), so membership is
+        # True — the informative error surfaces later when the call path
+        # actually resolves it
+        try:
+            return self.resolve(name) is not None
+        except KeyError:
+            return True
 
 
 class DataFrameReader:

@@ -1088,3 +1088,75 @@ class TestCaseCastBuiltins:
                 f"SELECT {spelling}(k) AS u FROM ci_t"
             ).collect()
             assert got[0].u == "udf!", spelling
+
+
+class TestAdviceR4Fixes:
+    """Regression tests for the round-4 advisor findings (ADVICE.md)."""
+
+    def test_divide_by_zero_yields_null(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(10.0, 2.0), (5.0, 0.0), (None, 3.0)], ["a", "b"]
+        ).createOrReplaceTempView("dz_t")
+        rows = tpu_session.sql("SELECT a / b AS q FROM dz_t").collect()
+        assert rows[0].q == 5.0
+        assert rows[1].q is None  # Spark: x / 0 is NULL, not a crash
+        assert rows[2].q is None
+
+    def test_like_backslash_escapes(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("100%",), ("100x",), ("a_b",), ("axb",)], ["s"]
+        ).createOrReplaceTempView("lk_t")
+        rows = tpu_session.sql(
+            r"SELECT s FROM lk_t WHERE s LIKE '100\%'"
+        ).collect()
+        assert [r.s for r in rows] == ["100%"]
+        rows = tpu_session.sql(
+            r"SELECT s FROM lk_t WHERE s LIKE 'a\_b'"
+        ).collect()
+        assert [r.s for r in rows] == ["a_b"]
+        # unescaped wildcards still behave
+        assert tpu_session.sql(
+            "SELECT s FROM lk_t WHERE s LIKE '100_'"
+        ).count() == 2
+
+    def test_udf_case_ambiguity_raises(self, tpu_session):
+        tpu_session.udf.register("myFn", lambda v: 1)
+        tpu_session.udf.register("MYFN", lambda v: 2)
+        # exact spellings still resolve
+        assert tpu_session.udf.resolve("myFn") is not None
+        assert tpu_session.udf.resolve("MYFN") is not None
+        with pytest.raises(KeyError, match="[Aa]mbiguous"):
+            tpu_session.udf.resolve("myfn")
+
+    def test_drop_duplicates_mixed_type_dict_keys(self, tpu_session):
+        d1 = {1: "a", "x": "b"}  # int and str keys: bare sorted() raises
+        d2 = {"x": "b", 1: "a"}  # same content, different insertion order
+        d3 = {1: "a", "x": "c"}
+        df = tpu_session.createDataFrame(
+            [(1, d1), (2, d2), (3, d3)], ["id", "meta"]
+        )
+        out = df.dropDuplicates(["meta"])
+        assert sorted(r.id for r in out.collect()) == [1, 3]
+
+    def test_divide_by_zero_numpy_scalar_yields_null(self, tpu_session):
+        a = np.float64(5.0)
+        z = np.float64(0.0)
+        tpu_session.createDataFrame(
+            [(a, z), (a, np.float64(2.0))], ["x", "y"]
+        ).createOrReplaceTempView("npz_t")
+        rows = tpu_session.sql("SELECT x / y AS q FROM npz_t").collect()
+        assert rows[0].q is None  # numpy would give inf, not raise
+        assert rows[1].q == 2.5
+
+    def test_udf_ambiguous_membership_keeps_bool_contract(self, tpu_session):
+        tpu_session.udf.register("ambFn", lambda v: 1)
+        tpu_session.udf.register("AMBFN", lambda v: 2)
+        assert "ambfn" in tpu_session.udf  # no KeyError out of `in`
+
+    def test_drop_duplicates_numeric_key_spellings(self, tpu_session):
+        # {1: 'a', 2.0: 'b'} == {1: 'a', 2: 'b'} as Python dicts — one
+        # fingerprint, one surviving row
+        df = tpu_session.createDataFrame(
+            [(1, {1: "a", 2.0: "b"}), (2, {1: "a", 2: "b"})], ["id", "meta"]
+        )
+        assert [r.id for r in df.dropDuplicates(["meta"]).collect()] == [1]
