@@ -102,6 +102,21 @@ def main():
         "ON scored.label = sparse_categories.label WHERE category IS NULL"
     ).collect()
     assert {r.label for r in uncat} == {1, 2}
+
+    # top-K scored images per label — the canonical serving-analytics
+    # idiom, a ranking window inside a derived table filtered on rank
+    topk = spark.sql(
+        "SELECT label, score, rn FROM ("
+        "  SELECT label, score, ROW_NUMBER() OVER "
+        "    (PARTITION BY label ORDER BY score DESC) AS rn FROM scored"
+        ") t WHERE t.rn <= 2 ORDER BY label, rn"
+    ).collect()
+    assert len(topk) == 6  # 3 labels x top-2
+    for r in topk:
+        print(f"label={r.label}  rank={r.rn}  score={r.score:.4f}")
+    # the window's #1 must agree with the aggregate MAX per label
+    best_by_window = {r.label: r.score for r in topk if r.rn == 1}
+    assert best_by_window == {r.label: r.best for r in out}
     print("sql analytics OK")
 
 

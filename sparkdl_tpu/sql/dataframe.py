@@ -545,21 +545,140 @@ class DataFrame:
             asc = [bool(a) for a in ascending]
         else:
             asc = [bool(ascending)] * len(keys)
-        rows = self.collect()
+        # Sort a row-index permutation using ONLY the key columns (no Row
+        # materialization), then apply it to each column and re-split at
+        # the original partition sizes: downstream mapPartitions keeps
+        # its parallel grain instead of collapsing to one partition.
+        sizes = [_partition_nrows(p) for p in self._partitions]
+        col_cache: Dict[str, List[Any]] = {}
+        for c in names:
+            flat: List[Any] = []
+            for part in self._partitions:
+                flat.extend(part[c])
+            col_cache[c] = flat
+        idx = list(range(sum(sizes)))
         # stable multi-key sort: apply keys right-to-left; the (is-null
         # rank, value) key gives Spark's null ordering under reverse=
         for k, a in reversed(list(zip(keys, asc))):
-            rows.sort(
-                key=lambda r: (
-                    (0 if r[k] is None else 1),
-                    0 if r[k] is None else r[k],
+            vals = col_cache[k]
+            idx.sort(
+                key=lambda i: (
+                    (0 if vals[i] is None else 1),
+                    0 if vals[i] is None else vals[i],
                 ),
                 reverse=not a,
             )
-        part = {c: [r[c] for r in rows] for c in names}
-        return self._with_partitions([part])
+        out_parts: List[Partition] = []
+        pos = 0
+        for size in sizes:
+            chunk = idx[pos:pos + size]
+            out_parts.append(
+                {c: [col_cache[c][i] for i in chunk] for c in names}
+            )
+            pos += size
+        if not out_parts:
+            out_parts = [{c: [] for c in names}]
+        return self._with_partitions(out_parts)
 
     sort = orderBy
+
+    def _with_rank_column(
+        self,
+        name: str,
+        fn_key: str,
+        partition_cols: Sequence[str],
+        order_cols: Sequence[str],
+        ascending: Sequence[bool],
+    ) -> "DataFrame":
+        """Append an integer ranking column — the window-function
+        evaluator behind SQL ``ROW_NUMBER()/RANK()/DENSE_RANK() OVER
+        (PARTITION BY ... ORDER BY ...)`` (the Spark-SQL window idiom the
+        reference's serving analytics leaned on, SURVEY.md §1 L0 / §3.3).
+
+        Reads ONLY the partition/order key columns; rank values scatter
+        back into the existing partition layout, so the frame's
+        partitioning (and every other column's storage) is untouched.
+        Ties: ``rank`` repeats with gaps, ``dense_rank`` repeats without
+        gaps, ``row_number`` breaks ties by input order (deterministic —
+        the engine has no shuffle nondeterminism to hide)."""
+        if fn_key not in ("row_number", "rank", "dense_rank"):
+            raise ValueError(f"Unsupported window function {fn_key!r}")
+        for c in list(partition_cols) + list(order_cols):
+            if c not in self.columns:
+                raise KeyError(f"No such column: {c!r}")
+        if name in self.columns:
+            raise ValueError(
+                f"window output column {name!r} already exists"
+            )
+        sizes = [_partition_nrows(p) for p in self._partitions]
+        needed = dict.fromkeys(list(partition_cols) + list(order_cols))
+        flat: Dict[str, List[Any]] = {}
+        for c in needed:
+            vals: List[Any] = []
+            for part in self._partitions:
+                vals.extend(part[c])
+            flat[c] = vals
+        total = sum(sizes)
+
+        groups: Dict[tuple, List[int]] = {}
+        gorder: List[tuple] = []
+        for i in range(total):
+            key = tuple(flat[c][i] for c in partition_cols)
+            try:
+                bucket = groups[key]
+            except KeyError:
+                bucket = groups[key] = []
+                gorder.append(key)
+            except TypeError:
+                raise TypeError(
+                    f"unhashable PARTITION BY key value in "
+                    f"{list(partition_cols)}; keys must be hashable "
+                    "scalars"
+                ) from None
+            bucket.append(i)
+
+        ranks = [0] * total
+        for key in gorder:
+            idx = groups[key]
+            # same stable right-to-left multi-key sort + null ordering
+            # as orderBy (NULLS FIRST asc, NULLS LAST desc)
+            for c, a in reversed(list(zip(order_cols, ascending))):
+                vals = flat[c]
+                idx.sort(
+                    key=lambda i: (
+                        (0 if vals[i] is None else 1),
+                        0 if vals[i] is None else vals[i],
+                    ),
+                    reverse=not a,
+                )
+            prev: "Any" = object()  # never equal to a real key tuple
+            rank = dense = 0
+            for pos, i in enumerate(idx, start=1):
+                cur = tuple(flat[c][i] for c in order_cols)
+                if cur != prev:
+                    dense += 1
+                    rank = pos
+                    prev = cur
+                ranks[i] = (
+                    pos if fn_key == "row_number"
+                    else rank if fn_key == "rank"
+                    else dense
+                )
+
+        from sparkdl_tpu.sql.types import LongType
+
+        out_parts: List[Partition] = []
+        pos = 0
+        for part, size in zip(self._partitions, sizes):
+            p = dict(part)
+            p[name] = ranks[pos:pos + size]
+            pos += size
+            out_parts.append(p)
+        schema = StructType(
+            [StructField(f.name, f.dataType) for f in self._schema]
+        )
+        schema.add(name, LongType())
+        return self._with_partitions(out_parts, schema)
 
     def dropDuplicates(
         self, subset: Optional[Sequence[str]] = None
@@ -800,19 +919,146 @@ class DataFrameNaFunctions:
         return df._with_partitions(out_parts)
 
 
-#: SQL/GroupedData aggregate functions: name -> (fn(values) -> scalar).
-#: NULLs are excluded before aggregation (SQL semantics); COUNT(*) counts
-#: rows, COUNT(col) counts non-null values.
-_AGG_FNS: Dict[str, Callable[[List[Any]], Any]] = {
-    "count": len,
-    "sum": lambda vs: sum(vs) if vs else None,
-    "avg": lambda vs: (sum(vs) / len(vs)) if vs else None,
-    "min": lambda vs: min(vs) if vs else None,
-    "max": lambda vs: max(vs) if vs else None,
+class _AggSpec:
+    """One aggregate function as a mergeable accumulator triple —
+    ``init() -> acc``, ``update(acc, v) -> acc`` over one partition's
+    non-null values, ``merge(a, b) -> acc`` across partition partials,
+    ``final(acc) -> scalar``.
+
+    This factored (partial-aggregate, then merge) shape is what lets
+    :meth:`GroupedData._aggregate` stream partition-by-partition without
+    materializing rows on the driver — the same combiner discipline
+    Spark's partial aggregation used (the reference delegated GROUP BY to
+    it, SURVEY.md §1 L0); NULLs are excluded before ``update`` (SQL
+    semantics); ``COUNT(*)`` counts rows, ``COUNT(col)`` non-null values.
+    """
+
+    __slots__ = ("init", "update", "merge", "final")
+
+    def __init__(self, init, update, merge, final):
+        self.init = init
+        self.update = update
+        self.merge = merge
+        self.final = final
+
+
+def _moments_update(acc, v):
+    # Welford accumulation: (n, mean, M2) — numerically stable where the
+    # naive sum/sumsq form cancels catastrophically for large means
+    n, mean, m2 = acc
+    n += 1
+    d = v - mean
+    mean += d / n
+    m2 += d * (v - mean)
+    return (n, mean, m2)
+
+
+def _moments_merge(a, b):
+    # Chan's parallel-merge of two Welford partials
+    na, ma, m2a = a
+    nb, mb, m2b = b
+    if na == 0:
+        return b
+    if nb == 0:
+        return a
+    n = na + nb
+    d = mb - ma
+    return (n, ma + d * nb / n, m2a + m2b + d * d * na * nb / n)
+
+
+def _var_final(acc, ddof: int):
+    # Spark semantics: no rows -> NULL; one row with ddof=1 -> NaN
+    # (0/0 in the sample estimator), population variance of one row -> 0
+    n, _, m2 = acc
+    if n == 0:
+        return None
+    if n - ddof <= 0:
+        return float("nan")
+    return m2 / (n - ddof)
+
+
+def _make_var_spec(ddof: int, sqrt: bool) -> _AggSpec:
+    import math
+
+    def final(acc):
+        v = _var_final(acc, ddof)
+        if v is None:
+            return None
+        return math.sqrt(v) if sqrt else v
+
+    return _AggSpec(
+        lambda: (0, 0.0, 0.0), _moments_update, _moments_merge, final
+    )
+
+
+def _collect_set_update(acc, v):
+    acc.setdefault(_dedupe_key(v), v)
+    return acc
+
+
+_AGG_SPECS: Dict[str, _AggSpec] = {
+    "count": _AggSpec(
+        lambda: 0, lambda a, v: a + 1, lambda a, b: a + b, lambda a: a
+    ),
+    "sum": _AggSpec(
+        # (total, seen-any): SUM of zero non-null values is NULL, not 0
+        lambda: (0, False),
+        lambda a, v: (a[0] + v, True),
+        lambda a, b: (a[0] + b[0], a[1] or b[1]),
+        lambda a: a[0] if a[1] else None,
+    ),
+    "avg": _AggSpec(
+        lambda: (0, 0),
+        lambda a, v: (a[0] + v, a[1] + 1),
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        lambda a: (a[0] / a[1]) if a[1] else None,
+    ),
+    "min": _AggSpec(
+        lambda: (None, False),
+        lambda a, v: (v if not a[1] or v < a[0] else a[0], True),
+        lambda a, b: (
+            a if not b[1] else b if not a[1]
+            else ((a[0], True) if a[0] <= b[0] else (b[0], True))
+        ),
+        lambda a: a[0],
+    ),
+    "max": _AggSpec(
+        lambda: (None, False),
+        lambda a, v: (v if not a[1] or v > a[0] else a[0], True),
+        lambda a, b: (
+            a if not b[1] else b if not a[1]
+            else ((a[0], True) if a[0] >= b[0] else (b[0], True))
+        ),
+        lambda a: a[0],
+    ),
+    # COUNT(DISTINCT c): nulls were already excluded, so set-size;
+    # _dedupe_key keeps unhashable cells (arrays) countable
+    "count_distinct": _AggSpec(
+        lambda: set(),
+        lambda a, v: (a.add(_dedupe_key(v)), a)[1],
+        lambda a, b: a | b,
+        len,
+    ),
+    "stddev": _make_var_spec(1, sqrt=True),
+    "stddev_samp": _make_var_spec(1, sqrt=True),
+    "stddev_pop": _make_var_spec(0, sqrt=True),
+    "variance": _make_var_spec(1, sqrt=False),
+    "var_samp": _make_var_spec(1, sqrt=False),
+    "var_pop": _make_var_spec(0, sqrt=False),
+    # collect_*: non-null values in first-appearance order (Spark drops
+    # nulls in both; its ordering is unspecified — ours is deterministic)
+    "collect_list": _AggSpec(
+        lambda: [], lambda a, v: (a.append(v), a)[1], lambda a, b: a + b,
+        lambda a: a,
+    ),
+    "collect_set": _AggSpec(
+        lambda: {},
+        _collect_set_update,
+        lambda a, b: {**a, **{k: v for k, v in b.items() if k not in a}},
+        lambda a: list(a.values()),
+    ),
 }
-_AGG_FNS["mean"] = _AGG_FNS["avg"]
-# COUNT(DISTINCT c): nulls were already excluded, so this is set-size
-_AGG_FNS["count_distinct"] = lambda vs: len(set(vs))
+_AGG_SPECS["mean"] = _AGG_SPECS["avg"]
 
 
 class GroupedData:
@@ -845,12 +1091,20 @@ class GroupedData:
         """``pairs``: (column-or-*, fn key, OUTPUT column name).  All
         validation lives here (every caller path gets the same errors):
         fn must be known, columns must exist, ``*`` only pairs with
-        count, and output names must be unique."""
+        count, and output names must be unique.
+
+        Execution is partial aggregation with projection pushdown: each
+        partition folds ONLY the key + referenced columns into per-group
+        :class:`_AggSpec` accumulators, and the driver merges the
+        per-partition partials — an unreferenced column (e.g. the image
+        struct of a scored view during ``GROUP BY label``) is never read,
+        let alone materialized into driver rows.  Group order is
+        first-appearance, as before."""
         for col_name, fn_key, _ in pairs:
-            if fn_key not in _AGG_FNS:
+            if fn_key not in _AGG_SPECS:
                 raise ValueError(
                     f"Unsupported aggregate {fn_key!r}; supported: "
-                    f"{sorted(_AGG_FNS)}"
+                    f"{sorted(_AGG_SPECS)}"
                 )
             if col_name == "*":
                 if fn_key != "count":
@@ -866,41 +1120,118 @@ class GroupedData:
                 "alias repeated aggregates distinctly"
             )
 
-        rows = self._df.collect()
-        groups: "Dict[tuple, List[Row]]" = {}
+        specs = [_AGG_SPECS[fn_key] for _, fn_key, _ in pairs]
+
+        def partial(part: Partition):
+            """One partition's ``{key: [acc, ...]}`` + key order."""
+            n = _partition_nrows(part)
+            key_cols = [part[k] for k in self._keys]
+            val_cols = [
+                part[c] if c != "*" else None for c, _, _ in pairs
+            ]
+            accs: Dict[tuple, list] = {}
+            order: List[tuple] = []
+            for i in range(n):
+                key = tuple(kc[i] for kc in key_cols)
+                try:
+                    group = accs[key]
+                except KeyError:
+                    group = accs[key] = [s.init() for s in specs]
+                    order.append(key)
+                except TypeError:
+                    raise TypeError(
+                        f"unhashable GROUP BY key value in {self._keys}; "
+                        "group keys must be hashable scalars"
+                    ) from None
+                for j, vc in enumerate(val_cols):
+                    if vc is None:  # COUNT(*): every row counts
+                        group[j] = specs[j].update(group[j], True)
+                    else:
+                        v = vc[i]
+                        if v is not None:
+                            group[j] = specs[j].update(group[j], v)
+            return accs, order
+
+        merged: Dict[tuple, list] = {}
         order: List[tuple] = []
-        for r in rows:
-            key = tuple(r[k] for k in self._keys)
-            if key not in groups:
-                groups[key] = []
-                order.append(key)
-            groups[key].append(r)
+        for part in self._df._partitions:
+            p_accs, p_order = partial(part)
+            for key in p_order:
+                if key in merged:
+                    merged[key] = [
+                        s.merge(a, b)
+                        for s, a, b in zip(specs, merged[key], p_accs[key])
+                    ]
+                else:
+                    merged[key] = p_accs[key]
+                    order.append(key)
         if not self._keys and not order:
             # SQL semantics: an ungrouped aggregate over zero rows yields
             # exactly one row (COUNT(*) = 0, SUM/AVG/... = NULL)
-            groups[()] = []
+            merged[()] = [s.init() for s in specs]
             order.append(())
 
-        part: Partition = {name: [] for name in out_names}
+        part_out: Partition = {name: [] for name in out_names}
         for key in order:
             for k, v in zip(self._keys, key):
-                part[k].append(v)
-            for col_name, fn_key, label in pairs:
-                grp = groups[key]
-                if col_name == "*":
-                    result = len(grp)
-                else:
-                    values = [
-                        r[col_name] for r in grp if r[col_name] is not None
-                    ]
-                    result = _AGG_FNS[fn_key](values)
-                part[label].append(result)
+                part_out[k].append(v)
+            for (_, _, label), spec, acc in zip(pairs, specs, merged[key]):
+                part_out[label].append(spec.final(acc))
+
+        return DataFrame(
+            [part_out], self._output_schema(pairs, part_out),
+            self._df.sparkSession,
+        )
+
+    def _output_schema(self, pairs: List[tuple], part_out: Partition
+                       ) -> StructType:
+        """Aggregation output types from the SOURCE frame's declared
+        schema, not value probes — an all-NULL output column (outer-join
+        side that never matched) must keep its declared type so
+        ``df.na.fill``'s type-matched semantics still reach it."""
+        from sparkdl_tpu.sql.types import (
+            ArrayType,
+            DoubleType,
+            FloatType,
+            IntegerType,
+            LongType,
+            ObjectType,
+        )
 
         st = StructType()
-        for name in out_names:
-            probe = next((v for v in part[name] if v is not None), None)
-            st.add(name, infer_type(probe))
-        return DataFrame([part], st, self._df.sparkSession)
+        for k in self._keys:
+            st.add(k, self._df._field_type(k))
+        for col_name, fn_key, label in pairs:
+            src = (
+                self._df._field_type(col_name) if col_name != "*" else None
+            )
+            if fn_key in ("count", "count_distinct"):
+                t: DataType = LongType()
+            elif fn_key in ("avg", "mean", "stddev", "stddev_samp",
+                            "stddev_pop", "variance", "var_samp",
+                            "var_pop"):
+                t = DoubleType()
+            elif fn_key == "sum":
+                # Spark widens: integral sums to long, fractional to double
+                if isinstance(src, (IntegerType, LongType)):
+                    t = LongType()
+                elif isinstance(src, (FloatType, DoubleType)):
+                    t = DoubleType()
+                else:
+                    t = src if src is not None else ObjectType()
+            elif fn_key in ("min", "max"):
+                t = src if src is not None else ObjectType()
+            elif fn_key in ("collect_list", "collect_set"):
+                t = ArrayType(src if src is not None else ObjectType())
+            else:  # pragma: no cover - every fn above is enumerated
+                t = ObjectType()
+            if isinstance(t, ObjectType):
+                probe = next(
+                    (v for v in part_out[label] if v is not None), None
+                )
+                t = infer_type(probe)
+            st.add(label, t)
+        return st
 
     # -- named helpers (pyspark surface) --------------------------------
     def count(self) -> DataFrame:

@@ -177,14 +177,43 @@ class Column:
     def isin(self, *values):
         """Membership test (``col.isin(0, 1)`` or ``col.isin([0, 1])``) —
         the pyspark ``Column.isin`` analog, and what SQL ``IN (...)``
-        lowers to."""
+        (including ``IN (SELECT ...)``) lowers to.
+
+        Spark's three-valued IN: NULL input yields NULL; a non-matching
+        input yields NULL (not False) when the value set itself contains
+        NULL — which is also why ``NOT IN`` against a set with a NULL
+        matches nothing, the classic SQL trap, preserved faithfully."""
         if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
             values = tuple(values[0])
-        vals = set(values)
+        return self._isin_values(values)
+
+    def _isin_values(self, values: Sequence) -> "Column":
+        """Membership against ``values`` EXACTLY as given — no
+        single-container convenience unpack (the ``IN (SELECT ...)``
+        path must not flatten a one-row array-valued result into
+        element-wise membership)."""
+        has_null = any(v is None for v in values)
+        try:
+            vals = {v for v in values if v is not None}
+        except TypeError:
+            raise ValueError(
+                "IN requires hashable scalar values; got array-valued "
+                "entries"
+            ) from None
+
+        def ev(cols, n):
+            out = []
+            for v in self._eval(cols, n):
+                if v is None:
+                    out.append(None)
+                elif v in vals:
+                    out.append(True)
+                else:
+                    out.append(None if has_null else False)
+            return out
+
         return Column(
-            lambda cols, n: [
-                None if v is None else v in vals for v in self._eval(cols, n)
-            ],
+            ev,
             "(%s IN (%s))" % (self._name, ", ".join(map(repr, values))),
         )
 

@@ -266,10 +266,9 @@ class TPUSession:
         r"(?:OUTER\s+)?|FULL\s+(?:OUTER\s+)?)?JOIN\s+\w+"
         rf"(?:\s+(?:AS\s+)?(?!ON\b)\w+)?\s+ON\b{_ON_COND})*)"
         r"(?:\s+WHERE\s+(?P<where>.+?))?"
-        r"(?:\s+GROUP\s+BY\s+(?P<group>[\w\s,\.]+?))?"
+        r"(?:\s+GROUP\s+BY\s+(?P<group>.+?))?"
         r"(?:\s+HAVING\s+(?P<having>.+?))?"
-        r"(?:\s+ORDER\s+BY\s+(?P<order>\w+(?:\s+(?:ASC|DESC))?"
-        r"(?:\s*,\s*\w+(?:\s+(?:ASC|DESC))?)*))?"
+        r"(?:\s+ORDER\s+BY\s+(?P<order>.+?))?"
         r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
         re.IGNORECASE | re.DOTALL,
     )
@@ -280,16 +279,239 @@ class TPUSession:
         rf"(?P<cond>{_ON_COND})",
         re.IGNORECASE,
     )
+    _AGG_FN_ALT = (
+        r"count|sum|avg|mean|min|max|stddev_samp|stddev_pop|stddev"
+        r"|var_samp|var_pop|variance|collect_list|collect_set"
+    )
     _AGG_RE = re.compile(
-        r"^(?P<fn>count|sum|avg|mean|min|max)\s*\(\s*"
+        rf"^(?P<fn>{_AGG_FN_ALT})\s*\(\s*"
         r"(?P<distinct>DISTINCT\s+)?(?P<arg>\*|.+?)\s*\)$",
         re.IGNORECASE | re.DOTALL,
     )
     _AGG_CALL_RE = re.compile(
-        r"\b(?P<fn>count|sum|avg|mean|min|max)\s*\(", re.IGNORECASE
+        rf"\b(?P<fn>{_AGG_FN_ALT})\s*\(", re.IGNORECASE
     )
 
+    #: ranking window functions — the OVER () clause the reference's
+    #: serving analytics used through Spark SQL (top-K per group)
+    _WINDOW_RE = re.compile(
+        r"^(?P<fn>ROW_NUMBER|RANK|DENSE_RANK)\s*\(\s*\)\s+OVER\s*\(\s*"
+        r"(?:PARTITION\s+BY\s+(?P<part>.+?)\s+)?"
+        r"ORDER\s+BY\s+(?P<ord>.+?)\s*\)\s*$",
+        re.IGNORECASE | re.DOTALL,
+    )
+
+    _subq_counter = 0  # class-wide: unique derived-table view names
+
+    # -- text-level helpers (string-literal- and paren-aware) -----------
+    @staticmethod
+    def _literal_spans(text: str) -> List[tuple]:
+        return [
+            m.span()
+            for m in re.finditer(
+                r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"", text
+            )
+        ]
+
+    @staticmethod
+    def _depth_profile(text: str, spans: List[tuple]) -> List[int]:
+        """Paren nesting depth at each character (string literals
+        ignored) — what makes keyword scans respect subqueries."""
+        def in_str(i: int) -> bool:
+            return any(lo <= i < hi for lo, hi in spans)
+
+        depth, out = 0, []
+        for i, ch in enumerate(text):
+            if not in_str(i):
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+            out.append(depth)
+        return out
+
+    @staticmethod
+    def _matching_paren(text: str, open_i: int, spans: List[tuple]) -> int:
+        def in_str(i: int) -> bool:
+            return any(lo <= i < hi for lo, hi in spans)
+
+        depth = 0
+        for i in range(open_i, len(text)):
+            if in_str(i):
+                continue
+            if text[i] == "(":
+                depth += 1
+            elif text[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    return i
+        raise ValueError(f"Unbalanced parentheses in {text!r}")
+
+    @classmethod
+    def _split_union(cls, query: str):
+        """Split at top-level ``UNION [ALL]`` joints.  Returns
+        ``(segments, ops)`` where ``ops[i]`` ('all'/'distinct') joins
+        segment i and i+1."""
+        spans = cls._literal_spans(query)
+        depth_at = cls._depth_profile(query, spans)
+
+        def in_str(i: int) -> bool:
+            return any(lo <= i < hi for lo, hi in spans)
+
+        parts, ops, last = [], [], 0
+        for m in re.finditer(r"\bUNION(?:\s+(ALL))?\b", query,
+                             re.IGNORECASE):
+            if in_str(m.start()) or depth_at[m.start()] != 0:
+                continue
+            parts.append(query[last:m.start()])
+            ops.append("all" if m.group(1) else "distinct")
+            last = m.end()
+        parts.append(query[last:])
+        return parts, ops
+
+    @classmethod
+    def _parse_order_items(cls, text: str) -> List[tuple]:
+        """``(expression_text, ascending)`` per top-level comma item."""
+        items = []
+        for raw in cls._split_projections(text):
+            raw = raw.strip()
+            om = re.match(
+                r"^(?P<e>.+?)(?:\s+(?P<dir>ASC|DESC))?\s*$", raw,
+                re.IGNORECASE | re.DOTALL,
+            )
+            d = om.group("dir")
+            items.append(
+                (om.group("e").strip(), d is None or d.upper() != "DESC")
+            )
+        return items
+
+    def _lift_derived_tables(self, query: str, created: List[str]) -> str:
+        """Replace every ``FROM ( SELECT ... )`` / ``JOIN ( SELECT ... )``
+        derived table with a temp view of its (recursively) evaluated
+        result.  View names go on ``created`` for the caller to drop."""
+        while True:
+            spans = self._literal_spans(query)
+            m = next(
+                (
+                    c
+                    for c in re.finditer(
+                        r"\b(FROM|JOIN)\s*\(", query, re.IGNORECASE
+                    )
+                    if not any(lo <= c.start() < hi for lo, hi in spans)
+                ),
+                None,
+            )
+            if m is None:
+                return query
+            open_i = m.end() - 1
+            close_i = self._matching_paren(query, open_i, spans)
+            inner = query[open_i + 1:close_i].strip()
+            if not re.match(r"^SELECT\b", inner, re.IGNORECASE):
+                raise ValueError(
+                    f"Expected a SELECT subquery after "
+                    f"{m.group(1).upper()} ( in {query!r}"
+                )
+            TPUSession._subq_counter += 1
+            name = f"__subq_{TPUSession._subq_counter}"
+            self.sql(inner).createOrReplaceTempView(name)
+            created.append(name)
+            query = (
+                f"{query[:m.start()]}{m.group(1)} {name}"
+                f"{query[close_i + 1:]}"
+            )
+
+    # -- the dialect ----------------------------------------------------
     def sql(self, query: str) -> DataFrame:
+        """Evaluate a query in the minimal dialect (see the grammar note
+        above :data:`_SQL_RE`, plus: ``UNION [ALL]`` between SELECTs,
+        derived tables ``FROM (SELECT ...) t``, uncorrelated
+        ``IN (SELECT ...)``, ranking window functions, and expression
+        ORDER BY / GROUP BY)."""
+        created: List[str] = []
+        try:
+            return self._sql_query(query, created)
+        finally:
+            for n in created:
+                self.catalog.dropTempView(n)
+
+    def _sql_query(self, query: str, created: List[str]) -> DataFrame:
+        parts, ops = self._split_union(query)
+        if not ops:
+            return self._sql_select(query, created)
+        # standard SQL: a trailing ORDER BY / LIMIT closes the whole
+        # union, not the last branch
+        tail, order_text, limit_n = self._strip_tail_order_limit(parts[-1])
+        parts = parts[:-1] + [tail]
+        dfs = [self._sql_select(p, created) for p in parts]
+        names = dfs[0].columns
+        out = dfs[0]
+        for op, nxt in zip(ops, dfs[1:]):
+            if len(nxt.columns) != len(names):
+                raise ValueError(
+                    f"UNION requires the same column count: {names} "
+                    f"vs {nxt.columns}"
+                )
+            if nxt.columns != names:
+                # positional resolution, first branch's names win (as
+                # Spark); two-phase rename avoids transient collisions
+                tmp = [f"__union_{i}" for i in range(len(names))]
+                for old, t in zip(list(nxt.columns), tmp):
+                    nxt = nxt.withColumnRenamed(old, t)
+                for t, new in zip(tmp, names):
+                    nxt = nxt.withColumnRenamed(t, new)
+            out = out.union(nxt)
+            if op == "distinct":  # left-associative, as SQL
+                out = out.dropDuplicates()
+        if order_text:
+            keys, ascs = [], []
+            for text, asc in self._parse_order_items(order_text):
+                if not re.fullmatch(r"\w+", text) or text not in out.columns:
+                    raise ValueError(
+                        f"ORDER BY after UNION supports output column "
+                        f"names only; {text!r} is not one of {out.columns}"
+                    )
+                keys.append(text)
+                ascs.append(asc)
+            out = out.orderBy(*keys, ascending=ascs)
+        if limit_n is not None:
+            out = out.limit(limit_n)
+        return out
+
+    def _strip_tail_order_limit(self, text: str):
+        """Split a union's final branch into (select_text, order_text,
+        limit) — the trailing clauses at paren depth 0 belong to the
+        union."""
+        spans = self._literal_spans(text)
+        depth_at = self._depth_profile(text, spans)
+
+        def ok(i: int) -> bool:
+            return depth_at[i] == 0 and not any(
+                lo <= i < hi for lo, hi in spans
+            )
+
+        for m in re.finditer(r"\bORDER\s+BY\b", text, re.IGNORECASE):
+            if not ok(m.start()):
+                continue
+            tail = text[m.end():]
+            lm = re.search(r"\s+LIMIT\s+(\d+)\s*;?\s*$", tail,
+                           re.IGNORECASE)
+            if lm:
+                return text[:m.start()], tail[:lm.start()].strip(), int(
+                    lm.group(1)
+                )
+            return (
+                text[:m.start()],
+                re.sub(r";\s*$", "", tail).strip(),
+                None,
+            )
+        for m in re.finditer(r"\bLIMIT\s+(\d+)\s*;?\s*$", text,
+                             re.IGNORECASE):
+            if ok(m.start()):
+                return text[:m.start()], None, int(m.group(1))
+        return text, None, None
+
+    def _sql_select(self, query: str, created: List[str]) -> DataFrame:
+        query = self._lift_derived_tables(query, created)
         m = self._SQL_RE.match(query)
         if not m:
             raise ValueError(f"Unsupported SQL (minimal dialect): {query!r}")
@@ -312,7 +534,21 @@ class TPUSession:
         ]
         group = m.group("group")
 
+        def _window_match(p: str):
+            text, _ = self._strip_alias(p)
+            wm = self._WINDOW_RE.match(text)
+            if wm is None and re.search(r"\bOVER\s*\(", text,
+                                        re.IGNORECASE):
+                raise ValueError(
+                    f"Unsupported window expression {text!r}; supported: "
+                    "ROW_NUMBER()/RANK()/DENSE_RANK() OVER "
+                    "([PARTITION BY ...] ORDER BY ...)"
+                )
+            return wm
+
         def _is_agg_call(p: str) -> bool:
+            if _window_match(p):
+                return False
             am = self._AGG_RE.match(self._strip_alias(p)[0])
             if not am:
                 return False
@@ -325,97 +561,218 @@ class TPUSession:
         if m.group("having") and not is_agg:
             raise ValueError("HAVING requires a GROUP BY / aggregate query")
         order = m.group("order")
-        order_keys: List[tuple] = []  # (column, ascending) per sort key
-        if order:
-            for item in order.split(","):
-                parts = item.split()
-                order_keys.append(
-                    (parts[0], len(parts) == 1 or parts[1].upper() != "DESC")
-                )
-
-        def apply_order(df: DataFrame) -> DataFrame:
-            return df.orderBy(
-                *[n for n, _ in order_keys],
-                ascending=[a for _, a in order_keys],
-            )
-
+        order_items = self._parse_order_items(order) if order else []
         distinct = bool(m.group("distinct"))
+
         if is_agg:
             if distinct:
                 raise ValueError(
                     "SELECT DISTINCT with aggregates is not supported; "
                     "GROUP BY output is already one row per group"
                 )
+            if any(_window_match(p) for p in proj_raw):
+                raise ValueError(
+                    "window functions over GROUP BY output are not "
+                    "supported; aggregate in a derived table first "
+                    "(FROM (SELECT ... GROUP BY ...) t)"
+                )
             out = self._sql_aggregate(
                 out, proj_raw, group, having=m.group("having"),
                 qualifiers=quals, columns=out.columns,
             )
-            for name, _ in order_keys:
-                if name not in out.columns:
-                    raise ValueError(
-                        f"ORDER BY {name!r}: not an output column of "
-                        f"the aggregation ({out.columns})"
-                    )
-            if order_keys:
-                out = apply_order(out)
+            if order_items:
+                out = self._order_aggregated(out, order_items, quals)
         else:
-            star = m.group("proj").strip() == "*"
-            exprs: List[Column] = (
-                [] if star
-                else [
-                    self._parse_projection(raw, quals, out.columns)
-                    for raw in proj_raw
-                ]
+            out = self._project_and_order(
+                out, m.group("proj").strip(), proj_raw, order_items,
+                distinct, quals,
             )
-            post_names = out.columns if star else [e._name for e in exprs]
-            sort_after = False
-            hidden_sort: List[str] = []
-            if order_keys:
-                # SQL resolution: each key resolves against the select
-                # list first (aliases win over same-named input columns),
-                # else against the input.  Any select-list hit forces the
-                # sort AFTER projection; input-only keys ride along as
-                # hidden projected columns and are dropped afterwards
-                # (the sort column need not be selected).
-                missing = [
-                    n for n, _ in order_keys
-                    if n not in post_names and n not in out.columns
-                ]
-                if missing:
+        if m.group("limit"):
+            out = out.limit(int(m.group("limit")))
+        return out
+
+    def _order_aggregated(
+        self, out: DataFrame, order_items: List[tuple], quals
+    ) -> DataFrame:
+        """ORDER BY over an aggregation's output: plain output columns,
+        or expressions over them (``ORDER BY cnt / total``); direct
+        aggregate calls must be aliased in the select list instead.
+
+        The non-aggregate analog lives in :meth:`_project_and_order`;
+        the two attach hidden sort columns at different pipeline stages
+        (post-aggregation ``withColumn`` here vs select-list append over
+        the pre-projection input there), which is why they stay
+        separate implementations."""
+        keys: List[str] = []
+        ascs: List[bool] = []
+        hidden: List[str] = []
+        for text, asc in order_items:
+            if re.fullmatch(r"\w+", text):
+                if text not in out.columns:
                     raise ValueError(
-                        f"ORDER BY {missing}: no such column "
+                        f"ORDER BY {text!r}: not an output column of "
+                        f"the aggregation ({out.columns}); alias the "
+                        "aggregate (AS) and order by the alias"
+                    )
+                keys.append(text)
+            else:
+                expr = _PredicateParser(
+                    text, udf_registry=self.udf, qualifiers=quals,
+                    columns=out.columns, session=self,
+                ).parse_expression()
+                h = f"__sort_{len(hidden)}"
+                out = out.withColumn(h, expr)
+                hidden.append(h)
+                keys.append(h)
+            ascs.append(asc)
+        out = out.orderBy(*keys, ascending=ascs)
+        for h in hidden:
+            out = out.drop(h)
+        return out
+
+    def _project_and_order(
+        self,
+        out: DataFrame,
+        proj_text: str,
+        proj_raw: List[str],
+        order_items: List[tuple],
+        distinct: bool,
+        quals,
+    ) -> DataFrame:
+        """The non-aggregate SELECT path: window columns, star
+        expansion, projection, DISTINCT, and select-list-first ORDER BY
+        resolution (hidden projected sort columns for input-side keys,
+        dropped after the sort)."""
+        input_cols = out.columns
+        # SELECT *, expr — stars expand positionally against the
+        # PRE-window input columns (a window alias must not duplicate)
+        expanded: List[str] = []
+        for raw in proj_raw:
+            if raw == "*":
+                expanded.extend(input_cols)
+            else:
+                expanded.append(raw)
+        star_only = proj_text == "*"
+
+        proj_items: List[str] = []
+        for raw in expanded:
+            text, alias = self._strip_alias(raw)
+            wm = self._WINDOW_RE.match(text)
+            if wm:
+                name = alias or re.sub(r"\s+", " ", text)
+                out = self._apply_window(out, name, wm, quals)
+                proj_items.append(name)  # now an ordinary column
+                star_only = False
+            else:
+                proj_items.append(raw)
+
+        if star_only:
+            # DISTINCT * dedupes full rows (every column is "in the
+            # select list", so any column is a legal sort key)
+            if not order_items:
+                return out.distinct() if distinct else out
+            simple = all(
+                re.fullmatch(r"\w+", t) and t in out.columns
+                for t, _ in order_items
+            )
+            if simple:
+                if distinct:
+                    out = out.distinct()
+                return out.orderBy(
+                    *[t for t, _ in order_items],
+                    ascending=[a for _, a in order_items],
+                )
+            proj_items = list(out.columns)  # need hidden sort columns
+
+        exprs = [
+            self._parse_projection(raw, quals, out.columns)
+            for raw in proj_items
+        ]
+        post_names = [e._name for e in exprs]
+        keys: List[str] = []
+        ascs: List[bool] = []
+        hidden: List[str] = []
+        for text, asc in order_items:
+            # SQL resolution: select list first (aliases win over
+            # same-named input columns), else an expression over the
+            # input — a plain column, t.col, score + 1, ABS(score) —
+            # projected as a hidden column and dropped after the sort
+            if text in post_names:
+                keys.append(text)
+            else:
+                if re.fullmatch(r"\w+", text) and text not in out.columns:
+                    raise ValueError(
+                        f"ORDER BY [{text!r}]: no such column "
                         f"({out.columns}) or projection alias"
                     )
-                if distinct and any(
-                    n not in post_names for n, _ in order_keys
-                ):
+                if distinct:
                     # Spark's rule: DISTINCT dedupes the projected rows,
-                    # so a sort column outside the select list has no
-                    # well-defined value per deduped row (applies whether
-                    # or not other keys hit the select list)
+                    # so a sort key outside the select list has no
+                    # well-defined value per deduped row
                     raise ValueError(
                         "SELECT DISTINCT: ORDER BY columns must appear "
                         "in the select list"
                     )
-                if any(n in post_names for n, _ in order_keys):
-                    sort_after = True
-                    for n, _ in order_keys:
-                        if n not in post_names and n not in hidden_sort:
-                            exprs.append(col(n))
-                            hidden_sort.append(n)
-            if order_keys and not sort_after:
-                out = apply_order(out)
-            if not star:
-                out = out.select(*exprs)
-            if distinct:
-                out = out.distinct()
-            if sort_after:
-                out = apply_order(out)
-                for h in hidden_sort:
-                    out = out.drop(h)
-        if m.group("limit"):
-            out = out.limit(int(m.group("limit")))
+                expr = _PredicateParser(
+                    text, udf_registry=self.udf, qualifiers=quals,
+                    columns=out.columns, session=self,
+                ).parse_expression()
+                h = f"__sort_{len(hidden)}"
+                exprs.append(expr.alias(h))
+                hidden.append(h)
+                keys.append(h)
+            ascs.append(asc)
+        out = out.select(*exprs)
+        if distinct:
+            out = out.distinct()
+        if keys:
+            out = out.orderBy(*keys, ascending=ascs)
+        for h in hidden:
+            out = out.drop(h)
         return out
+
+    def _apply_window(
+        self, df: DataFrame, out_name: str, wm, quals
+    ) -> DataFrame:
+        """Materialize one ranking window as a column named
+        ``out_name``.  PARTITION BY / ORDER BY items may be plain
+        columns, qualified names, or expressions (computed as helper
+        columns, dropped after ranking)."""
+        fn_key = wm.group("fn").lower()
+        helpers: List[str] = []
+
+        def resolve(text: str, tag: str) -> str:
+            nonlocal df
+            t = text.strip()
+            if re.fullmatch(r"\w+", t) and t in df.columns:
+                return t
+            mq = re.fullmatch(r"(\w+)\.(\w+)", t)
+            if mq and mq.group(1) in quals and mq.group(1) not in df.columns:
+                return mq.group(2)
+            expr = _PredicateParser(
+                t, udf_registry=self.udf, qualifiers=quals,
+                columns=df.columns, session=self,
+            ).parse_expression()
+            h = f"__win_{tag}_{len(helpers)}"
+            helpers.append(h)
+            df = df.withColumn(h, expr)
+            return h
+
+        part_cols = (
+            [
+                resolve(p, "p")
+                for p in self._split_projections(wm.group("part"))
+            ]
+            if wm.group("part")
+            else []
+        )
+        ords = self._parse_order_items(wm.group("ord"))
+        ord_cols = [resolve(t, "o") for t, _ in ords]
+        ascs = [a for _, a in ords]
+        df = df._with_rank_column(out_name, fn_key, part_cols, ord_cols, ascs)
+        for h in helpers:
+            df = df.drop(h)
+        return df
 
     def _apply_joins(
         self,
@@ -487,6 +844,23 @@ class TPUSession:
             return m.group("expr").strip(), m.group("alias")
         return text, None
 
+    def _group_key(self, text: str, qualifiers, columns):
+        """Resolve one GROUP BY key to ``(column_name, expr_or_None)``:
+        a bare column stays itself, ``t.col`` de-qualifies, anything
+        else parses as an expression whose derived column is named by
+        the normalized text (so the select list can match it)."""
+        k = re.sub(r"\s+", " ", text.strip())
+        if re.fullmatch(r"\w+", k):
+            return k, None
+        mq = re.fullmatch(r"(\w+)\.(\w+)", k)
+        if mq and mq.group(1) in qualifiers and mq.group(1) not in columns:
+            return mq.group(2), None
+        expr = _PredicateParser(
+            k, udf_registry=self.udf, qualifiers=qualifiers,
+            columns=columns, session=self,
+        ).parse_expression()
+        return k, expr
+
     def _agg_pair(
         self,
         df: DataFrame,
@@ -517,7 +891,7 @@ class TPUSession:
         if not re.fullmatch(r"\w+", arg):
             expr = _PredicateParser(
                 arg, udf_registry=self.udf, qualifiers=qualifiers,
-                columns=columns,
+                columns=columns, session=self,
             ).parse_expression()
             tmp = f"__agg_arg_{tmp_idx[0]}"
             tmp_idx[0] += 1
@@ -538,13 +912,22 @@ class TPUSession:
         aggregate call (as in Spark); aliases rename the pyspark-style
         ``fn(col)`` output columns.  Aggregate arguments may be
         arithmetic expressions (``AVG(score * 100)``) or
-        ``COUNT(DISTINCT col)``; HAVING may use direct aggregate calls
-        (computed as hidden columns and dropped after the filter)."""
-        keys = (
-            [k.strip() for k in group.split(",") if k.strip()]
-            if group
-            else []
-        )
+        ``COUNT(DISTINCT col)``; group keys may be qualified names
+        (``t.label``) or expressions (``CAST(score AS int)``, computed
+        as derived columns named by their normalized text); HAVING may
+        use direct aggregate calls (computed as hidden columns and
+        dropped after the filter)."""
+        keys: List[str] = []
+        if group:
+            for raw_key in self._split_projections(group):
+                if not raw_key.strip():
+                    continue
+                kname, kexpr = self._group_key(
+                    raw_key, qualifiers, columns
+                )
+                if kexpr is not None:
+                    df = df.withColumn(kname, kexpr)
+                keys.append(kname)
         pairs = []  # (col, fn, OUTPUT name) for GroupedData._aggregate
         renames = []  # (key, alias) — keys only; aggregates alias directly
         passthrough = []
@@ -568,15 +951,38 @@ class TPUSession:
                     columns,
                 )
                 pairs.append(pair)
-            elif expr in keys:
-                if alias:
-                    renames.append((expr, alias))
-                passthrough.append(expr)
             else:
-                raise ValueError(
-                    f"Projection {raw!r} must be a GROUP BY key or an "
-                    "aggregate (COUNT/SUM/AVG/MIN/MAX)"
-                )
+                # a projection matches a group key by its RESOLVED name
+                # (bare column, de-qualified t.col, or normalized
+                # expression text), so SELECT CAST(score AS int), ...
+                # GROUP BY CAST(score AS int) lines up.  Expression
+                # spellings compare case-insensitively (cast vs CAST —
+                # SQL keywords are caseless); bare column identifiers
+                # stay exact, as everywhere in the engine.
+                pname, _ = self._group_key(expr, qualifiers, columns)
+                if re.fullmatch(r"\w+", pname):
+                    match = pname if pname in keys else None
+                else:
+                    match = next(
+                        (
+                            k for k in keys
+                            if k.casefold() == pname.casefold()
+                        ),
+                        None,
+                    )
+                if match is not None:
+                    if alias:
+                        renames.append((match, alias))
+                    elif match != pname:
+                        # output column named by the SELECT spelling
+                        renames.append((match, pname))
+                    passthrough.append(match)
+                else:
+                    raise ValueError(
+                        f"Projection {raw!r} must be a GROUP BY key or "
+                        "an aggregate (COUNT/SUM/AVG/MIN/MAX/STDDEV/"
+                        "VARIANCE/COLLECT_LIST/COLLECT_SET)"
+                    )
         if not pairs:
             raise ValueError("GROUP BY query needs at least one aggregate")
         hidden: List[str] = []
@@ -690,6 +1096,11 @@ class TPUSession:
             text, alias = m_as.group("expr").strip(), m_as.group("alias")
         if text == "*":
             raise ValueError("'*' must be the only projection")
+        if text in columns:
+            # engine-materialized columns may carry expression-shaped
+            # names (an unaliased window projection's normalized text);
+            # an existing column always wins over re-parsing its name
+            return col(text).alias(alias) if alias else col(text)
         m_q = re.fullmatch(r"(\w+)\.(\w+)", text)
         if m_q and m_q.group(1) in qualifiers and m_q.group(1) not in columns:
             # qualified simple column (t.score): output name is the bare
@@ -703,7 +1114,7 @@ class TPUSession:
             # `my_udf(image)`, `a + b / 2`)
             expr = _PredicateParser(
                 text, udf_registry=self.udf, qualifiers=qualifiers,
-                columns=columns,
+                columns=columns, session=self,
             ).parse_expression()
             expr = expr.alias(re.sub(r"\s+", " ", text))
         return expr.alias(alias) if alias else expr
@@ -713,7 +1124,7 @@ class TPUSession:
     ) -> Column:
         return _PredicateParser(
             text, udf_registry=self.udf, qualifiers=qualifiers,
-            columns=columns,
+            columns=columns, session=self,
         ).parse()
 
     def stop(self):
@@ -774,16 +1185,23 @@ class _PredicateParser:
     )
 
     _AGG_NAMES = frozenset(
-        ("count", "sum", "avg", "mean", "min", "max")
+        (
+            "count", "sum", "avg", "mean", "min", "max",
+            "stddev", "stddev_samp", "stddev_pop",
+            "variance", "var_samp", "var_pop",
+            "collect_list", "collect_set",
+        )
     )
 
     def __init__(self, text: str, udf_registry=None,
-                 qualifiers=frozenset(), columns=()):
+                 qualifiers=frozenset(), columns=(), session=None):
         self.text = text
         self.udf = udf_registry
         self.qualifiers = qualifiers
         self.columns = frozenset(columns)
+        self.session = session  # for IN (SELECT ...) subqueries
         self.tokens: List[tuple] = []
+        self._spans: List[tuple] = []  # source span per token
         pos = 0
         while pos < len(text):
             m = self._TOKEN_RE.match(text, pos)
@@ -796,6 +1214,7 @@ class _PredicateParser:
             pos = m.end()
             kind = m.lastgroup
             self.tokens.append((kind, m.group(kind)))
+            self._spans.append((m.start(kind), m.end(kind)))
         self.i = 0
 
     # -- token helpers --------------------------------------------------
@@ -886,12 +1305,16 @@ class _PredicateParser:
         negate = self._accept_kw("NOT")
         if self._accept_kw("IN"):
             self._expect("punct", "(")
-            values = [self._literal()]
-            while self._peek() == ("punct", ","):
-                self.i += 1
-                values.append(self._literal())
-            self._expect("punct", ")")
-            membership = c.isin(*values)
+            k, v = self._peek()
+            if k == "ident" and v.upper() == "SELECT":
+                membership = c._isin_values(self._in_subquery_values())
+            else:
+                values = [self._literal()]
+                while self._peek() == ("punct", ","):
+                    self.i += 1
+                    values.append(self._literal())
+                self._expect("punct", ")")
+                membership = c.isin(*values)
             return ~membership if negate else membership
         if self._accept_kw("LIKE"):
             kind, val = self._next()
@@ -926,6 +1349,44 @@ class _PredicateParser:
         if op in ("!=", "<>"):
             return c != value
         return {"<": c < value, "<=": c <= value, ">": c > value, ">=": c >= value}[op]
+
+    def _in_subquery_values(self) -> list:
+        """Evaluate an uncorrelated ``IN (SELECT ...)`` subquery to its
+        value list (single output column required; NULLs kept — the
+        three-valued IN semantics live in :meth:`Column.isin`).  The
+        opening paren has been consumed; consumes through the close."""
+        if self.session is None:
+            raise ValueError(
+                f"IN (SELECT ...) requires a session: {self.text!r}"
+            )
+        depth, j = 1, self.i
+        while j < len(self.tokens):
+            k, v = self.tokens[j]
+            if k == "punct" and v == "(":
+                depth += 1
+            elif k == "punct" and v == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            j += 1
+        if depth:
+            raise ValueError(
+                f"Unbalanced parentheses in IN (SELECT ...): {self.text!r}"
+            )
+        start = self._spans[self.i][0]
+        end = self._spans[j][0]
+        df = self.session.sql(self.text[start:end])
+        if len(df.columns) != 1:
+            raise ValueError(
+                f"IN subquery must select exactly one column, got "
+                f"{df.columns}"
+            )
+        name = df.columns[0]
+        self.i = j + 1
+        vals: list = []
+        for part in df._partitions:
+            vals.extend(part[name])
+        return vals
 
     # -- arithmetic expressions -----------------------------------------
     def _sum_expr(self) -> Column:

@@ -1160,3 +1160,525 @@ class TestAdviceR4Fixes:
             [(1, {1: "a", 2.0: "b"}), (2, {1: "a", 2: "b"})], ["id", "meta"]
         )
         assert [r.id for r in df.dropDuplicates(["meta"]).collect()] == [1]
+
+
+class _PoisonColumn(list):
+    """A column whose DATA cannot be touched: any element access or
+    iteration raises.  len() stays legal (partition row counts are
+    metadata, not data)."""
+
+    def __getitem__(self, i):
+        raise AssertionError("poisoned column was materialized")
+
+    def __iter__(self):
+        raise AssertionError("poisoned column was iterated")
+
+
+class TestAggregationPushdown:
+    """Partial aggregation + projection pushdown (VERDICT r4 item 2)."""
+
+    def test_group_by_never_touches_unreferenced_columns(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [(i % 3, float(i), b"imgbytes") for i in range(12)],
+            ["label", "score", "image"],
+            numPartitions=3,
+        )
+        for part in df._partitions:
+            part["image"] = _PoisonColumn(part["image"])
+        out = df.groupBy("label").agg({"score": "avg", "*": "count"})
+        got = {r.label: (r["avg(score)"], r["count(*)"]) for r in out.collect()}
+        assert got == {0: (4.5, 4), 1: (5.5, 4), 2: (6.5, 4)}
+
+    def test_sql_group_by_never_touches_unreferenced_columns(
+        self, tpu_session
+    ):
+        df = tpu_session.createDataFrame(
+            [(i % 2, float(i), b"imgbytes") for i in range(8)],
+            ["label", "score", "image"],
+            numPartitions=2,
+        )
+        for part in df._partitions:
+            part["image"] = _PoisonColumn(part["image"])
+        df.createOrReplaceTempView("poisoned")
+        rows = tpu_session.sql(
+            "SELECT label, SUM(score) AS s FROM poisoned GROUP BY label"
+        ).collect()
+        assert {r.label: r.s for r in rows} == {0: 12.0, 1: 16.0}
+
+    def test_partials_merge_across_partitions(self, tpu_session):
+        # values deliberately split so no single partition sees the full
+        # group; the merged result must equal the global aggregate
+        vals = [float(v) for v in (5, 1, 9, 2, 8, 3, 7, 4, 6, 0)]
+        df = tpu_session.createDataFrame(
+            [(v,) for v in vals], ["x"], numPartitions=5
+        )
+        row = df.groupBy().agg(
+            {"x": "avg"}
+        ).collect()[0]
+        assert row["avg(x)"] == pytest.approx(np.mean(vals))
+        row = df.groupBy().agg({"x": "stddev"}).collect()[0]
+        assert row["stddev(x)"] == pytest.approx(np.std(vals, ddof=1))
+
+    def test_order_by_preserves_partitioning(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [(i * 7 % 10, i) for i in range(10)], ["k", "v"],
+            numPartitions=4,
+        )
+        out = df.orderBy("k")
+        assert out.getNumPartitions() == 4
+        assert [r.k for r in out.collect()] == sorted(r.k for r in df.collect())
+        # a downstream mapPartitions still sees 4 partitions of data
+        seen = []
+        out.foreachPartition(lambda p: seen.append(len(p["k"])))
+        assert len(seen) == 4 and sum(seen) == 10
+
+
+class TestNewAggregates:
+    """stddev/variance/collect_* (VERDICT r4 item 6) + output typing
+    (item 8)."""
+
+    @pytest.fixture()
+    def adf(self, tpu_session):
+        data = [
+            ("a", 1.0), ("a", 2.0), ("a", 4.0),
+            ("b", 10.0), ("b", None),
+        ]
+        df = tpu_session.createDataFrame(data, ["k", "x"], numPartitions=3)
+        df.createOrReplaceTempView("agg_t")
+        return df
+
+    def test_stddev_variance_vs_numpy(self, tpu_session, adf):
+        a = np.array([1.0, 2.0, 4.0])
+        rows = tpu_session.sql(
+            "SELECT k, STDDEV(x) AS sd, VARIANCE(x) AS vr, "
+            "STDDEV_POP(x) AS sdp, VAR_POP(x) AS vrp "
+            "FROM agg_t GROUP BY k ORDER BY k"
+        ).collect()
+        ra = rows[0]
+        assert ra.sd == pytest.approx(np.std(a, ddof=1))
+        assert ra.vr == pytest.approx(np.var(a, ddof=1))
+        assert ra.sdp == pytest.approx(np.std(a))
+        assert ra.vrp == pytest.approx(np.var(a))
+        rb = rows[1]  # single non-null value: sample estimator is NaN
+        assert np.isnan(rb.sd) and np.isnan(rb.vr)
+        assert rb.sdp == 0.0 and rb.vrp == 0.0
+
+    def test_stddev_of_no_rows_is_null(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(1.0,)], ["x"]
+        ).createOrReplaceTempView("empty_src")
+        row = tpu_session.sql(
+            "SELECT STDDEV(x) AS sd FROM empty_src WHERE x > 99"
+        ).collect()[0]
+        assert row.sd is None
+
+    def test_collect_list_and_set(self, tpu_session, adf):
+        rows = tpu_session.sql(
+            "SELECT k, COLLECT_LIST(x) AS xs FROM agg_t GROUP BY k "
+            "ORDER BY k"
+        ).collect()
+        assert rows[0].xs == [1.0, 2.0, 4.0]
+        assert rows[1].xs == [10.0]  # NULL excluded, as Spark
+        df2 = tpu_session.createDataFrame(
+            [("a", 1), ("a", 1), ("a", 2)], ["k", "v"]
+        )
+        out = df2.groupBy("k").agg({"v": "collect_set"})
+        assert sorted(out.collect()[0]["collect_set(v)"]) == [1, 2]
+
+    def test_collect_list_schema_is_array(self, tpu_session, adf):
+        from sparkdl_tpu.sql.types import ArrayType, DoubleType
+
+        out = adf.groupBy("k").agg({"x": "collect_list"})
+        assert out.schema["collect_list(x)"].dataType == ArrayType(DoubleType())
+
+    def test_aggregate_schema_from_declared_types(self, tpu_session):
+        from sparkdl_tpu.sql.types import (
+            DoubleType, LongType, StringType,
+        )
+
+        df = tpu_session.createDataFrame(
+            [("a", 2, 1.5, "s")], ["k", "i", "f", "s"]
+        )
+        out = df.groupBy("k").agg(
+            {"i": "sum", "f": "avg", "s": "min", "*": "count"}
+        )
+        assert out.schema["k"].dataType == StringType()
+        assert out.schema["sum(i)"].dataType == LongType()
+        assert out.schema["avg(f)"].dataType == DoubleType()
+        assert out.schema["min(s)"].dataType == StringType()
+        assert out.schema["count(*)"].dataType == LongType()
+
+    def test_all_null_aggregate_column_keeps_type_and_fills(
+        self, tpu_session
+    ):
+        from sparkdl_tpu.sql.types import DoubleType
+
+        # a full-outer join whose right side never matches: every
+        # right-origin value is NULL, but the declared type must survive
+        # aggregation so fillna(0) still applies (VERDICT r4 weak #4)
+        left = tpu_session.createDataFrame(
+            [("a", 1.0), ("b", 2.0)], ["k", "x"]
+        )
+        right = tpu_session.createDataFrame(
+            [("z", 9.5)], ["k", "y"]
+        )
+        joined = left.join(right, "k", how="full")
+        agg = joined.groupBy("k").agg({"y": "max"})
+        f = agg.schema["max(y)"]
+        assert f.dataType == DoubleType()
+        filled = agg.na.fill(0.0)
+        vals = {r.k: r["max(y)"] for r in filled.collect()}
+        assert vals["a"] == 0.0 and vals["b"] == 0.0 and vals["z"] == 9.5
+
+
+class TestWindowFunctions:
+    """ROW_NUMBER/RANK/DENSE_RANK OVER (VERDICT r4 item 1)."""
+
+    @pytest.fixture()
+    def scored(self, tpu_session):
+        tpu_session.createDataFrame(
+            [
+                ("cat", "a.png", 0.9), ("cat", "b.png", 0.7),
+                ("cat", "c.png", 0.9), ("dog", "d.png", 0.6),
+                ("dog", "e.png", 0.95), ("dog", "f.png", 0.6),
+            ],
+            ["label", "origin", "score"], numPartitions=3,
+        ).createOrReplaceTempView("win_t")
+
+    def test_row_number_partitioned_desc(self, tpu_session, scored):
+        rows = tpu_session.sql(
+            "SELECT origin, ROW_NUMBER() OVER "
+            "(PARTITION BY label ORDER BY score DESC) AS rn FROM win_t"
+        ).collect()
+        got = {r.origin: r.rn for r in rows}
+        # ties broken by input order (deterministic): a before c
+        assert got == {
+            "a.png": 1, "c.png": 2, "b.png": 3,
+            "e.png": 1, "d.png": 2, "f.png": 3,
+        }
+
+    def test_rank_vs_dense_rank_ties(self, tpu_session, scored):
+        rows = tpu_session.sql(
+            "SELECT origin, RANK() OVER (PARTITION BY label ORDER BY "
+            "score DESC) AS rk, DENSE_RANK() OVER (PARTITION BY label "
+            "ORDER BY score DESC) AS dr FROM win_t"
+        ).collect()
+        got = {r.origin: (r.rk, r.dr) for r in rows}
+        assert got["a.png"] == (1, 1) and got["c.png"] == (1, 1)
+        assert got["b.png"] == (3, 2)  # RANK gaps, DENSE_RANK doesn't
+        assert got["d.png"] == (2, 2) and got["f.png"] == (2, 2)
+        assert got["e.png"] == (1, 1)
+
+    def test_window_no_partition(self, tpu_session, scored):
+        rows = tpu_session.sql(
+            "SELECT origin, ROW_NUMBER() OVER (ORDER BY score) AS rn "
+            "FROM win_t WHERE label = 'dog'"
+        ).collect()
+        assert {r.origin: r.rn for r in rows} == {
+            "d.png": 1, "f.png": 2, "e.png": 3,
+        }
+
+    def test_window_with_where_and_limit(self, tpu_session, scored):
+        rows = tpu_session.sql(
+            "SELECT origin, ROW_NUMBER() OVER (ORDER BY score DESC) AS rn "
+            "FROM win_t WHERE label = 'cat' ORDER BY rn LIMIT 2"
+        ).collect()
+        # WHERE narrows BEFORE the window numbers rows (SQL order)
+        assert [(r.origin, r.rn) for r in rows] == [
+            ("a.png", 1), ("c.png", 2),
+        ]
+
+    def test_star_plus_window(self, tpu_session, scored):
+        out = tpu_session.sql(
+            "SELECT *, RANK() OVER (ORDER BY score DESC) AS rk FROM win_t"
+        )
+        assert out.columns == ["label", "origin", "score", "rk"]
+        assert out.count() == 6
+
+    def test_window_preserves_partitioning(self, tpu_session, scored):
+        out = tpu_session.sql(
+            "SELECT *, ROW_NUMBER() OVER (PARTITION BY label ORDER BY "
+            "score) AS rn FROM win_t"
+        )
+        assert out.getNumPartitions() == 3
+
+    def test_windowed_subquery_topk_per_label(self, tpu_session, scored):
+        rows = tpu_session.sql(
+            "SELECT label, origin FROM (SELECT label, origin, "
+            "ROW_NUMBER() OVER (PARTITION BY label ORDER BY score DESC) "
+            "AS rn FROM win_t) t WHERE t.rn <= 2 ORDER BY label, origin"
+        ).collect()
+        assert [(r.label, r.origin) for r in rows] == [
+            ("cat", "a.png"), ("cat", "c.png"),
+            ("dog", "d.png"), ("dog", "e.png"),
+        ]
+
+    def test_unsupported_window_fn_errors(self, tpu_session, scored):
+        with pytest.raises(ValueError, match="window"):
+            tpu_session.sql(
+                "SELECT SUM(score) OVER (PARTITION BY label ORDER BY "
+                "score) FROM win_t"
+            )
+
+    def test_window_with_group_by_errors(self, tpu_session, scored):
+        with pytest.raises(ValueError, match="derived table"):
+            tpu_session.sql(
+                "SELECT label, ROW_NUMBER() OVER (ORDER BY label) "
+                "FROM win_t GROUP BY label"
+            )
+
+
+class TestSubqueries:
+    """Derived tables + uncorrelated IN (VERDICT r4 item 3)."""
+
+    @pytest.fixture()
+    def views(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("a.png", "cat", 0.9), ("b.png", "dog", 0.4),
+             ("c.png", "cat", 0.7), ("d.png", "owl", 0.5)],
+            ["origin", "label", "score"],
+        ).createOrReplaceTempView("sq_scored")
+        tpu_session.createDataFrame(
+            [("cat",), ("dog",)], ["label"]
+        ).createOrReplaceTempView("sq_known")
+
+    def test_derived_table(self, tpu_session, views):
+        rows = tpu_session.sql(
+            "SELECT origin FROM (SELECT origin, score FROM sq_scored "
+            "WHERE score > 0.5) t ORDER BY origin"
+        ).collect()
+        assert [r.origin for r in rows] == ["a.png", "c.png"]
+
+    def test_derived_table_aliased_and_qualified(self, tpu_session, views):
+        rows = tpu_session.sql(
+            "SELECT t.origin FROM (SELECT * FROM sq_scored) t "
+            "WHERE t.label = 'cat' ORDER BY t.origin"
+        ).collect()
+        assert [r.origin for r in rows] == ["a.png", "c.png"]
+
+    def test_join_against_derived_table(self, tpu_session, views):
+        rows = tpu_session.sql(
+            "SELECT s.origin, m.cnt FROM sq_scored s JOIN "
+            "(SELECT label AS lbl, COUNT(*) AS cnt FROM sq_scored "
+            "GROUP BY label) m ON s.label = m.lbl ORDER BY s.origin"
+        ).collect()
+        assert [(r.origin, r.cnt) for r in rows] == [
+            ("a.png", 2), ("b.png", 1), ("c.png", 2), ("d.png", 1),
+        ]
+
+    def test_nested_derived_tables(self, tpu_session, views):
+        assert tpu_session.sql(
+            "SELECT origin FROM (SELECT origin FROM (SELECT * FROM "
+            "sq_scored WHERE score > 0.4) a WHERE a.label = 'cat') b"
+        ).count() == 2
+
+    def test_in_subquery(self, tpu_session, views):
+        rows = tpu_session.sql(
+            "SELECT origin FROM sq_scored WHERE label IN "
+            "(SELECT label FROM sq_known) ORDER BY origin"
+        ).collect()
+        assert [r.origin for r in rows] == ["a.png", "b.png", "c.png"]
+
+    def test_not_in_subquery(self, tpu_session, views):
+        rows = tpu_session.sql(
+            "SELECT origin FROM sq_scored WHERE label NOT IN "
+            "(SELECT label FROM sq_known)"
+        ).collect()
+        assert [r.origin for r in rows] == ["d.png"]
+
+    def test_not_in_subquery_with_null_matches_nothing(
+        self, tpu_session, views
+    ):
+        # the classic SQL trap: NOT IN against a set containing NULL is
+        # never TRUE (x != NULL is unknown) — Spark returns zero rows
+        tpu_session.createDataFrame(
+            [("cat",), (None,)], ["label"]
+        ).createOrReplaceTempView("sq_nullset")
+        assert tpu_session.sql(
+            "SELECT origin FROM sq_scored WHERE label NOT IN "
+            "(SELECT label FROM sq_nullset)"
+        ).count() == 0
+
+    def test_in_subquery_with_null_keeps_matches(self, tpu_session, views):
+        tpu_session.createDataFrame(
+            [("cat",), (None,)], ["label"]
+        ).createOrReplaceTempView("sq_nullset2")
+        rows = tpu_session.sql(
+            "SELECT origin FROM sq_scored WHERE label IN "
+            "(SELECT label FROM sq_nullset2) ORDER BY origin"
+        ).collect()
+        assert [r.origin for r in rows] == ["a.png", "c.png"]
+
+    def test_in_subquery_requires_single_column(self, tpu_session, views):
+        with pytest.raises(ValueError, match="one column"):
+            tpu_session.sql(
+                "SELECT origin FROM sq_scored WHERE label IN "
+                "(SELECT origin, label FROM sq_scored)"
+            )
+
+    def test_temp_subquery_views_are_cleaned_up(self, tpu_session, views):
+        before = set(tpu_session.catalog.listTables())
+        tpu_session.sql(
+            "SELECT * FROM (SELECT * FROM sq_scored) t LIMIT 1"
+        ).collect()
+        assert set(tpu_session.catalog.listTables()) == before
+
+
+class TestUnion:
+    """UNION [ALL] in the dialect (VERDICT r4 item 6)."""
+
+    @pytest.fixture()
+    def views(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("cat", 1), ("dog", 2)], ["label", "n"]
+        ).createOrReplaceTempView("u_a")
+        tpu_session.createDataFrame(
+            [("cat", 1), ("owl", 3)], ["label", "n"]
+        ).createOrReplaceTempView("u_b")
+
+    def test_union_dedupes_union_all_keeps(self, tpu_session, views):
+        assert tpu_session.sql(
+            "SELECT label, n FROM u_a UNION SELECT label, n FROM u_b"
+        ).count() == 3
+        assert tpu_session.sql(
+            "SELECT label, n FROM u_a UNION ALL SELECT label, n FROM u_b"
+        ).count() == 4
+
+    def test_union_positional_names_from_first_branch(
+        self, tpu_session, views
+    ):
+        out = tpu_session.sql(
+            "SELECT label AS l, n AS k FROM u_a UNION ALL "
+            "SELECT n, label FROM u_b"
+        )
+        assert out.columns == ["l", "k"]
+        assert out.count() == 4
+
+    def test_union_tail_order_and_limit_close_the_union(
+        self, tpu_session, views
+    ):
+        rows = tpu_session.sql(
+            "SELECT label FROM u_a UNION ALL SELECT label FROM u_b "
+            "ORDER BY label DESC LIMIT 2"
+        ).collect()
+        assert [r.label for r in rows] == ["owl", "dog"]
+
+    def test_union_count_mismatch_errors(self, tpu_session, views):
+        with pytest.raises(ValueError, match="column count"):
+            tpu_session.sql(
+                "SELECT label, n FROM u_a UNION SELECT label FROM u_b"
+            )
+
+    def test_three_way_mixed_union(self, tpu_session, views):
+        # left-associative: (a UNION a) has 2 rows, then UNION ALL b
+        assert tpu_session.sql(
+            "SELECT label FROM u_a UNION SELECT label FROM u_a "
+            "UNION ALL SELECT label FROM u_b"
+        ).count() == 4
+
+    def test_union_inside_derived_table(self, tpu_session, views):
+        rows = tpu_session.sql(
+            "SELECT COUNT(*) AS c FROM (SELECT label FROM u_a UNION "
+            "SELECT label FROM u_b) t"
+        ).collect()
+        assert rows[0].c == 3
+
+
+class TestOrderGroupExpressions:
+    """ORDER BY / GROUP BY expressions + qualified names (VERDICT r4
+    item 5) — all three probes the verdict verified failing."""
+
+    @pytest.fixture()
+    def view(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("a", 2.6), ("b", 1.2), ("c", 2.4), ("d", 0.6)],
+            ["k", "score"],
+        ).createOrReplaceTempView("oge_t")
+
+    def test_order_by_qualified(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT k FROM oge_t t ORDER BY t.score"
+        ).collect()
+        assert [r.k for r in rows] == ["d", "b", "c", "a"]
+
+    def test_order_by_expression(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT k FROM oge_t ORDER BY score + 1 DESC"
+        ).collect()
+        assert [r.k for r in rows] == ["a", "c", "b", "d"]
+
+    def test_order_by_builtin_call(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT k FROM oge_t ORDER BY ABS(score - 2)"
+        ).collect()
+        # |score-2|: c=0.4 < a=0.6 < b=0.8 < d=1.4
+        assert [r.k for r in rows] == ["c", "a", "b", "d"]
+
+    def test_group_by_cast_expression(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT CAST(score AS int) AS b, COUNT(*) AS c FROM oge_t "
+            "GROUP BY CAST(score AS int) ORDER BY b"
+        ).collect()
+        assert [(r.b, r.c) for r in rows] == [(0, 1), (1, 1), (2, 2)]
+
+    def test_group_by_qualified(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT t.k, COUNT(*) AS c FROM oge_t t GROUP BY t.k "
+            "ORDER BY t.k LIMIT 2"
+        ).collect()
+        assert [(r.k, r.c) for r in rows] == [("a", 1), ("b", 1)]
+
+    def test_agg_order_by_expression_over_outputs(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT k, SUM(score) AS s FROM oge_t GROUP BY k "
+            "ORDER BY s * -1"
+        ).collect()
+        assert [r.k for r in rows] == ["a", "c", "b", "d"]
+
+
+class TestDialectReviewFixes:
+    """Regression tests for the round-5 review findings on the new
+    dialect features."""
+
+    @pytest.fixture()
+    def dup_view(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("a", 1), ("a", 1), ("b", 2)], ["k", "n"]
+        ).createOrReplaceTempView("dup_t")
+
+    def test_select_distinct_star(self, tpu_session, dup_view):
+        assert tpu_session.sql("SELECT DISTINCT * FROM dup_t").count() == 2
+
+    def test_select_distinct_star_with_order(self, tpu_session, dup_view):
+        rows = tpu_session.sql(
+            "SELECT DISTINCT * FROM dup_t ORDER BY n DESC"
+        ).collect()
+        assert [(r.k, r.n) for r in rows] == [("b", 2), ("a", 1)]
+
+    def test_unaliased_window_projection(self, tpu_session, dup_view):
+        out = tpu_session.sql(
+            "SELECT k, ROW_NUMBER() OVER (ORDER BY n) FROM dup_t"
+        )
+        win_col = [c for c in out.columns if c != "k"][0]
+        assert "ROW_NUMBER() OVER" in win_col
+        assert sorted(r[win_col] for r in out.collect()) == [1, 2, 3]
+
+    def test_in_subquery_array_values_error_not_flatten(
+        self, tpu_session, dup_view
+    ):
+        # one row holding an array must NOT be unpacked into element
+        # membership — it errors (arrays are not comparable to scalars)
+        with pytest.raises(ValueError, match="hashable"):
+            tpu_session.sql(
+                "SELECT k FROM dup_t WHERE n IN "
+                "(SELECT COLLECT_LIST(n) FROM dup_t)"
+            )
+
+    def test_group_by_expression_case_insensitive_spelling(
+        self, tpu_session, dup_view
+    ):
+        rows = tpu_session.sql(
+            "SELECT cast(n AS int) AS b, COUNT(*) AS c FROM dup_t "
+            "GROUP BY CAST(n AS int) ORDER BY b"
+        ).collect()
+        assert [(r.b, r.c) for r in rows] == [(1, 2), (2, 1)]
