@@ -837,8 +837,11 @@ class TPUSession:
 
     @staticmethod
     def _strip_alias(text: str):
+        # DOTALL: a multi-line projection (windows in triple-quoted SQL
+        # wrap naturally) must still find its trailing AS alias
         m = re.match(
-            r"^(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", text, re.IGNORECASE
+            r"^(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)\s*$", text,
+            re.IGNORECASE | re.DOTALL,
         )
         if m:
             return m.group("expr").strip(), m.group("alias")
@@ -1090,10 +1093,7 @@ class TPUSession:
     def _parse_projection(
         self, text: str, qualifiers=frozenset(), columns=()
     ) -> Column:
-        alias = None
-        m_as = re.match(r"^(?P<expr>.+?)\s+AS\s+(?P<alias>\w+)$", text, re.IGNORECASE)
-        if m_as:
-            text, alias = m_as.group("expr").strip(), m_as.group("alias")
+        text, alias = self._strip_alias(text)
         if text == "*":
             raise ValueError("'*' must be the only projection")
         if text in columns:

@@ -58,6 +58,53 @@ def scan_body_counted_once() -> Optional[bool]:
     return _SCAN_COUNTS_BODY_ONCE
 
 
+def time_compiled(compiled, args, repeats: int = 3) -> float:
+    """Min-of-``repeats`` wall time of one compiled call, fetch-forced —
+    the scan-amortized methodology's timing primitive (shared by the
+    experiment scripts so a methodology change cannot drift between
+    them and the headline harness)."""
+    np.asarray(compiled(*args))  # warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(compiled(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def fill_variables(module, example, value: float = 0.01):
+    """Deterministic nonzero variables for throughput probes (values do
+    not change the FLOP rate) via ``eval_shape`` — no real init pass."""
+    shapes = jax.eval_shape(module.init, jax.random.PRNGKey(0), example)
+    return jax.tree_util.tree_map(
+        lambda l: jnp.full(l.shape, value, l.dtype), shapes
+    )
+
+
+def device_random_stack(shape, dtype, scan: int, *, as_uint8=False, seed=0):
+    """A ``(scan, *shape)`` stack of DISTINCT random batches generated
+    ON DEVICE by jitted PRNG (the anti-caching requirement; host
+    staging through the relay was the old scan-depth cap)."""
+    device = jax.devices()[0]
+
+    def gen(key):
+        keys = jax.random.split(key, scan)
+
+        def body(_, k):
+            x = jax.random.uniform(k, shape)
+            if as_uint8:
+                return None, (x * 255).astype(jnp.uint8)
+            return None, x.astype(dtype)
+
+        _, out = jax.lax.scan(body, None, keys)
+        return out
+
+    with jax.default_device(device):
+        stack = jax.jit(gen)(jax.random.PRNGKey(seed))
+        stack.block_until_ready()
+    return stack
+
+
 def summarize_samples(vals) -> dict:
     """``{"samples": [...], "median": m, "iqr": [q1, q3]}`` — the one
     summary shape every benchmark reports (single definition so the

@@ -1682,3 +1682,17 @@ class TestDialectReviewFixes:
             "GROUP BY CAST(n AS int) ORDER BY b"
         ).collect()
         assert [(r.b, r.c) for r in rows] == [(1, 2), (2, 1)]
+
+    def test_multiline_window_projection_alias(self, tpu_session, dup_view):
+        # triple-quoted SQL wraps window projections across lines; the
+        # alias must still strip (README's own example shape)
+        rows = tpu_session.sql(
+            """
+            SELECT k, rn FROM (
+                SELECT k, ROW_NUMBER() OVER
+                    (PARTITION BY k ORDER BY n DESC) AS rn
+                FROM dup_t
+            ) t WHERE t.rn = 1 ORDER BY k
+            """
+        ).collect()
+        assert [(r.k, r.rn) for r in rows] == [("a", 1), ("b", 1)]
