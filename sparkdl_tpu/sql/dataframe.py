@@ -582,36 +582,27 @@ class DataFrame:
 
     sort = orderBy
 
-    def _with_rank_column(
+    def _window_groups(
         self,
-        name: str,
-        fn_key: str,
         partition_cols: Sequence[str],
         order_cols: Sequence[str],
         ascending: Sequence[bool],
-    ) -> "DataFrame":
-        """Append an integer ranking column — the window-function
-        evaluator behind SQL ``ROW_NUMBER()/RANK()/DENSE_RANK() OVER
-        (PARTITION BY ... ORDER BY ...)`` (the Spark-SQL window idiom the
-        reference's serving analytics leaned on, SURVEY.md §1 L0 / §3.3).
-
-        Reads ONLY the partition/order key columns; rank values scatter
-        back into the existing partition layout, so the frame's
-        partitioning (and every other column's storage) is untouched.
-        Ties: ``rank`` repeats with gaps, ``dense_rank`` repeats without
-        gaps, ``row_number`` breaks ties by input order (deterministic —
-        the engine has no shuffle nondeterminism to hide)."""
-        if fn_key not in ("row_number", "rank", "dense_rank"):
-            raise ValueError(f"Unsupported window function {fn_key!r}")
-        for c in list(partition_cols) + list(order_cols):
+        extra_cols: Sequence[str] = (),
+    ):
+        """Shared window-evaluator plumbing: flatten ONLY the referenced
+        columns, bucket row indices by partition key (first-appearance
+        order), and sort each bucket by the order keys with the same
+        stable multi-key + null-ordering discipline as :meth:`orderBy`.
+        Returns ``(flat, ordered_groups, sizes)``."""
+        for c in (
+            list(partition_cols) + list(order_cols) + list(extra_cols)
+        ):
             if c not in self.columns:
                 raise KeyError(f"No such column: {c!r}")
-        if name in self.columns:
-            raise ValueError(
-                f"window output column {name!r} already exists"
-            )
         sizes = [_partition_nrows(p) for p in self._partitions]
-        needed = dict.fromkeys(list(partition_cols) + list(order_cols))
+        needed = dict.fromkeys(
+            list(partition_cols) + list(order_cols) + list(extra_cols)
+        )
         flat: Dict[str, List[Any]] = {}
         for c in needed:
             vals: List[Any] = []
@@ -636,12 +627,8 @@ class DataFrame:
                     "scalars"
                 ) from None
             bucket.append(i)
-
-        ranks = [0] * total
         for key in gorder:
             idx = groups[key]
-            # same stable right-to-left multi-key sort + null ordering
-            # as orderBy (NULLS FIRST asc, NULLS LAST desc)
             for c, a in reversed(list(zip(order_cols, ascending))):
                 vals = flat[c]
                 idx.sort(
@@ -651,6 +638,56 @@ class DataFrame:
                     ),
                     reverse=not a,
                 )
+        return flat, [groups[k] for k in gorder], sizes
+
+    def _scatter_window_column(
+        self, name: str, values: List[Any], sizes: List[int], dtype
+    ) -> "DataFrame":
+        """Attach a computed per-row column back into the existing
+        partition layout (partitioning and every other column's storage
+        untouched)."""
+        if name in self.columns:
+            raise ValueError(
+                f"window output column {name!r} already exists"
+            )
+        out_parts: List[Partition] = []
+        pos = 0
+        for part, size in zip(self._partitions, sizes):
+            p = dict(part)
+            p[name] = values[pos:pos + size]
+            pos += size
+            out_parts.append(p)
+        schema = StructType(
+            [StructField(f.name, f.dataType) for f in self._schema]
+        )
+        schema.add(name, dtype)
+        return self._with_partitions(out_parts, schema)
+
+    def _with_rank_column(
+        self,
+        name: str,
+        fn_key: str,
+        partition_cols: Sequence[str],
+        order_cols: Sequence[str],
+        ascending: Sequence[bool],
+    ) -> "DataFrame":
+        """Append an integer ranking column — the window-function
+        evaluator behind SQL ``ROW_NUMBER()/RANK()/DENSE_RANK() OVER
+        (PARTITION BY ... ORDER BY ...)`` (the Spark-SQL window idiom the
+        reference's serving analytics leaned on, SURVEY.md §1 L0 / §3.3).
+
+        Reads ONLY the partition/order key columns; rank values scatter
+        back into the existing partition layout.  Ties: ``rank`` repeats
+        with gaps, ``dense_rank`` repeats without gaps, ``row_number``
+        breaks ties by input order (deterministic — the engine has no
+        shuffle nondeterminism to hide)."""
+        if fn_key not in ("row_number", "rank", "dense_rank"):
+            raise ValueError(f"Unsupported window function {fn_key!r}")
+        flat, ordered_groups, sizes = self._window_groups(
+            partition_cols, order_cols, ascending
+        )
+        ranks = [0] * sum(sizes)
+        for idx in ordered_groups:
             prev: "Any" = object()  # never equal to a real key tuple
             rank = dense = 0
             for pos, i in enumerate(idx, start=1):
@@ -667,18 +704,116 @@ class DataFrame:
 
         from sparkdl_tpu.sql.types import LongType
 
-        out_parts: List[Partition] = []
-        pos = 0
-        for part, size in zip(self._partitions, sizes):
-            p = dict(part)
-            p[name] = ranks[pos:pos + size]
-            pos += size
-            out_parts.append(p)
-        schema = StructType(
-            [StructField(f.name, f.dataType) for f in self._schema]
+        return self._scatter_window_column(name, ranks, sizes, LongType())
+
+    def _with_window_agg_column(
+        self,
+        name: str,
+        fn_key: str,
+        value_col: Optional[str],  # None = COUNT(*)
+        partition_cols: Sequence[str],
+        order_cols: Sequence[str],
+        ascending: Sequence[bool],
+    ) -> "DataFrame":
+        """Aggregate-over-window column: ``SUM(x) OVER (PARTITION BY k)``
+        broadcasts the partition aggregate to every row; with ORDER BY it
+        is the RUNNING aggregate under Spark's default frame (RANGE
+        UNBOUNDED PRECEDING .. CURRENT ROW — tied rows are peers and
+        share one value).  NULLs are excluded, as in GROUP BY."""
+        if fn_key == "mean":
+            fn_key = "avg"
+        if fn_key not in _AGG_SPECS:
+            raise ValueError(
+                f"Unsupported window aggregate {fn_key!r}; supported: "
+                f"{sorted(_AGG_SPECS)}"
+            )
+        spec = _AGG_SPECS[fn_key]
+        extra = [value_col] if value_col is not None else []
+        flat, ordered_groups, sizes = self._window_groups(
+            partition_cols, order_cols, ascending, extra_cols=extra
         )
-        schema.add(name, LongType())
-        return self._with_partitions(out_parts, schema)
+        out: List[Any] = [None] * sum(sizes)
+        vals = flat[value_col] if value_col is not None else None
+
+        def update(acc, i):
+            if vals is None:  # COUNT(*)
+                return spec.update(acc, True)
+            v = vals[i]
+            return acc if v is None else spec.update(acc, v)
+
+        for idx in ordered_groups:
+            if not order_cols:
+                acc = spec.init()
+                for i in idx:
+                    acc = update(acc, i)
+                result = spec.final(acc)
+                for i in idx:
+                    out[i] = result
+                continue
+            # running frame: walk peer groups (rows tied on the order
+            # key), extend the accumulator by the whole peer group,
+            # then assign one value to all its members
+            acc = spec.init()
+            j = 0
+            while j < len(idx):
+                k = j
+                key_j = tuple(flat[c][idx[j]] for c in order_cols)
+                while (
+                    k < len(idx)
+                    and tuple(flat[c][idx[k]] for c in order_cols)
+                    == key_j
+                ):
+                    acc = update(acc, idx[k])
+                    k += 1
+                result = spec.final(acc)
+                if isinstance(result, list):
+                    # collect_* finals return the live accumulator;
+                    # later frame extensions must not mutate earlier
+                    # rows' snapshots
+                    result = list(result)
+                for m in range(j, k):
+                    out[idx[m]] = result
+                j = k
+
+        from sparkdl_tpu.sql.types import ObjectType
+
+        dtype = _agg_result_type(
+            fn_key,
+            self._field_type(value_col) if value_col is not None else None,
+        )
+        if isinstance(dtype, ObjectType):
+            probe = next((v for v in out if v is not None), None)
+            dtype = infer_type(probe)
+        return self._scatter_window_column(name, out, sizes, dtype)
+
+    def _with_window_shift_column(
+        self,
+        name: str,
+        direction: int,  # -1 = LAG, +1 = LEAD
+        value_col: str,
+        offset: int,
+        default: Any,
+        partition_cols: Sequence[str],
+        order_cols: Sequence[str],
+        ascending: Sequence[bool],
+    ) -> "DataFrame":
+        """``LAG/LEAD(x[, offset[, default]]) OVER (...)`` — the row
+        ``offset`` positions before/after in the partition's order, or
+        ``default`` (NULL unless given) off either end."""
+        flat, ordered_groups, sizes = self._window_groups(
+            partition_cols, order_cols, ascending,
+            extra_cols=[value_col],
+        )
+        vals = flat[value_col]
+        out: List[Any] = [default] * sum(sizes)
+        for idx in ordered_groups:
+            for pos, i in enumerate(idx):
+                src = pos + direction * offset
+                if 0 <= src < len(idx):
+                    out[i] = vals[idx[src]]
+        return self._scatter_window_column(
+            name, out, sizes, self._field_type(value_col)
+        )
 
     def dropDuplicates(
         self, subset: Optional[Sequence[str]] = None
@@ -1061,6 +1196,39 @@ _AGG_SPECS: Dict[str, _AggSpec] = {
 _AGG_SPECS["mean"] = _AGG_SPECS["avg"]
 
 
+def _agg_result_type(fn_key: str, src: "Optional[DataType]") -> DataType:
+    """Declared output type of aggregate ``fn_key`` over a column of
+    declared type ``src`` (None for ``COUNT(*)``) — ONE mapping shared
+    by GROUP BY and window aggregation so the two cannot drift.
+    ``ObjectType`` means "unknown, probe the values"."""
+    from sparkdl_tpu.sql.types import (
+        ArrayType,
+        DoubleType,
+        FloatType,
+        IntegerType,
+        LongType,
+        ObjectType,
+    )
+
+    if fn_key in ("count", "count_distinct"):
+        return LongType()
+    if fn_key in ("avg", "mean", "stddev", "stddev_samp", "stddev_pop",
+                  "variance", "var_samp", "var_pop"):
+        return DoubleType()
+    if fn_key == "sum":
+        # Spark widens: integral sums to long, fractional to double
+        if isinstance(src, (IntegerType, LongType)):
+            return LongType()
+        if isinstance(src, (FloatType, DoubleType)):
+            return DoubleType()
+        return src if src is not None else ObjectType()
+    if fn_key in ("min", "max"):
+        return src if src is not None else ObjectType()
+    if fn_key in ("collect_list", "collect_set"):
+        return ArrayType(src if src is not None else ObjectType())
+    return ObjectType()
+
+
 class GroupedData:
     """Result of :meth:`DataFrame.groupBy` — the pyspark ``GroupedData``
     subset the engine needs (count/sum/avg/min/max/agg).  Groups preserve
@@ -1189,42 +1357,16 @@ class GroupedData:
         schema, not value probes — an all-NULL output column (outer-join
         side that never matched) must keep its declared type so
         ``df.na.fill``'s type-matched semantics still reach it."""
-        from sparkdl_tpu.sql.types import (
-            ArrayType,
-            DoubleType,
-            FloatType,
-            IntegerType,
-            LongType,
-            ObjectType,
-        )
+        from sparkdl_tpu.sql.types import ObjectType
 
         st = StructType()
         for k in self._keys:
             st.add(k, self._df._field_type(k))
         for col_name, fn_key, label in pairs:
-            src = (
-                self._df._field_type(col_name) if col_name != "*" else None
+            t = _agg_result_type(
+                fn_key,
+                self._df._field_type(col_name) if col_name != "*" else None,
             )
-            if fn_key in ("count", "count_distinct"):
-                t: DataType = LongType()
-            elif fn_key in ("avg", "mean", "stddev", "stddev_samp",
-                            "stddev_pop", "variance", "var_samp",
-                            "var_pop"):
-                t = DoubleType()
-            elif fn_key == "sum":
-                # Spark widens: integral sums to long, fractional to double
-                if isinstance(src, (IntegerType, LongType)):
-                    t = LongType()
-                elif isinstance(src, (FloatType, DoubleType)):
-                    t = DoubleType()
-                else:
-                    t = src if src is not None else ObjectType()
-            elif fn_key in ("min", "max"):
-                t = src if src is not None else ObjectType()
-            elif fn_key in ("collect_list", "collect_set"):
-                t = ArrayType(src if src is not None else ObjectType())
-            else:  # pragma: no cover - every fn above is enumerated
-                t = ObjectType()
             if isinstance(t, ObjectType):
                 probe = next(
                     (v for v in part_out[label] if v is not None), None

@@ -292,12 +292,18 @@ class TPUSession:
         rf"\b(?P<fn>{_AGG_FN_ALT})\s*\(", re.IGNORECASE
     )
 
-    #: ranking window functions — the OVER () clause the reference's
-    #: serving analytics used through Spark SQL (top-K per group)
+    #: window functions — the OVER () clause the reference's serving
+    #: analytics used through Spark SQL: ranking (top-K per group),
+    #: aggregates (share-of-partition, running totals under Spark's
+    #: default RANGE frame), and LAG/LEAD shifts
     _WINDOW_RE = re.compile(
-        r"^(?P<fn>ROW_NUMBER|RANK|DENSE_RANK)\s*\(\s*\)\s+OVER\s*\(\s*"
-        r"(?:PARTITION\s+BY\s+(?P<part>.+?)\s+)?"
-        r"ORDER\s+BY\s+(?P<ord>.+?)\s*\)\s*$",
+        r"^(?P<fn>ROW_NUMBER|RANK|DENSE_RANK|LAG|LEAD"
+        r"|COUNT|SUM|AVG|MEAN|MIN|MAX"
+        r"|STDDEV_SAMP|STDDEV_POP|STDDEV|VAR_SAMP|VAR_POP|VARIANCE"
+        r"|COLLECT_LIST|COLLECT_SET)"
+        r"\s*\(\s*(?P<arg>.*?)\s*\)\s+OVER\s*\(\s*"
+        r"(?:PARTITION\s+BY\s+(?P<part>.+?)\s*)?"
+        r"(?:ORDER\s+BY\s+(?P<ord>.+?)\s*)?\)\s*$",
         re.IGNORECASE | re.DOTALL,
     )
 
@@ -540,9 +546,13 @@ class TPUSession:
             if wm is None and re.search(r"\bOVER\s*\(", text,
                                         re.IGNORECASE):
                 raise ValueError(
-                    f"Unsupported window expression {text!r}; supported: "
-                    "ROW_NUMBER()/RANK()/DENSE_RANK() OVER "
-                    "([PARTITION BY ...] ORDER BY ...)"
+                    f"Unsupported window expression {text!r}; supported "
+                    "as a FULL projection (not inside arithmetic — use a "
+                    "derived table for that): ranking "
+                    "(ROW_NUMBER/RANK/DENSE_RANK), aggregates "
+                    "(COUNT/SUM/AVG/MIN/MAX/STDDEV*/VAR*/COLLECT_*), "
+                    "LAG/LEAD — each OVER ([PARTITION BY ...] "
+                    "[ORDER BY ...])"
                 )
             return wm
 
@@ -731,14 +741,21 @@ class TPUSession:
             out = out.drop(h)
         return out
 
+    _RANK_FNS = frozenset(("row_number", "rank", "dense_rank"))
+
     def _apply_window(
         self, df: DataFrame, out_name: str, wm, quals
     ) -> DataFrame:
-        """Materialize one ranking window as a column named
-        ``out_name``.  PARTITION BY / ORDER BY items may be plain
-        columns, qualified names, or expressions (computed as helper
-        columns, dropped after ranking)."""
+        """Materialize one window function as a column named
+        ``out_name`` — ranking (no argument, ORDER BY required),
+        aggregate (``SUM(x) OVER (PARTITION BY k)``; with ORDER BY the
+        running aggregate under Spark's default frame), or
+        ``LAG/LEAD(x[, offset[, default]])``.  PARTITION BY / ORDER BY
+        items and value arguments may be plain columns, qualified
+        names, or expressions (computed as helper columns, dropped
+        after)."""
         fn_key = wm.group("fn").lower()
+        arg = (wm.group("arg") or "").strip()
         helpers: List[str] = []
 
         def resolve(text: str, tag: str) -> str:
@@ -766,10 +783,74 @@ class TPUSession:
             if wm.group("part")
             else []
         )
-        ords = self._parse_order_items(wm.group("ord"))
+        ords = (
+            self._parse_order_items(wm.group("ord"))
+            if wm.group("ord") else []
+        )
         ord_cols = [resolve(t, "o") for t, _ in ords]
         ascs = [a for _, a in ords]
-        df = df._with_rank_column(out_name, fn_key, part_cols, ord_cols, ascs)
+
+        if fn_key in self._RANK_FNS:
+            if arg:
+                raise ValueError(
+                    f"{fn_key.upper()}() takes no argument"
+                )
+            if not ord_cols:
+                raise ValueError(
+                    f"{fn_key.upper()}() OVER requires ORDER BY"
+                )
+            df = df._with_rank_column(
+                out_name, fn_key, part_cols, ord_cols, ascs
+            )
+        elif fn_key in ("lag", "lead"):
+            if not ord_cols:
+                raise ValueError("LAG/LEAD OVER requires ORDER BY")
+            args = (
+                [a.strip() for a in self._split_projections(arg)]
+                if arg else []
+            )
+            if not args or len(args) > 3:
+                raise ValueError(
+                    "LAG/LEAD takes (column[, offset[, default]])"
+                )
+            vcol = resolve(args[0], "v")
+            offset = 1
+            if len(args) >= 2:
+                if not re.fullmatch(r"\d+", args[1]):
+                    raise ValueError(
+                        f"LAG/LEAD offset must be a literal integer, "
+                        f"got {args[1]!r}"
+                    )
+                offset = int(args[1])
+            default = None
+            if len(args) == 3:
+                p = _PredicateParser(args[2], session=self)
+                default = p._literal()
+                if p.i != len(p.tokens):
+                    raise ValueError(
+                        f"LAG/LEAD default must be a single literal, "
+                        f"got {args[2]!r}"
+                    )
+            df = df._with_window_shift_column(
+                out_name, -1 if fn_key == "lag" else 1, vcol, offset,
+                default, part_cols, ord_cols, ascs,
+            )
+        else:  # aggregate over a window
+            if arg == "*":
+                if fn_key != "count":
+                    raise ValueError(
+                        f"{fn_key}(*) is not defined; use a column"
+                    )
+                vcol = None
+            elif not arg:
+                raise ValueError(
+                    f"{fn_key.upper()}() OVER requires an argument"
+                )
+            else:
+                vcol = resolve(arg, "v")
+            df = df._with_window_agg_column(
+                out_name, fn_key, vcol, part_cols, ord_cols, ascs
+            )
         for h in helpers:
             df = df.drop(h)
         return df

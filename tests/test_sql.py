@@ -1416,7 +1416,7 @@ class TestWindowFunctions:
     def test_unsupported_window_fn_errors(self, tpu_session, scored):
         with pytest.raises(ValueError, match="window"):
             tpu_session.sql(
-                "SELECT SUM(score) OVER (PARTITION BY label ORDER BY "
+                "SELECT NTILE(4) OVER (PARTITION BY label ORDER BY "
                 "score) FROM win_t"
             )
 
@@ -1696,3 +1696,114 @@ class TestDialectReviewFixes:
             """
         ).collect()
         assert [(r.k, r.rn) for r in rows] == [("a", 1), ("b", 1)]
+
+
+class TestAggregateWindows:
+    """Aggregate/LAG/LEAD window functions (round-5 extension of the
+    ranking windows — the Spark serving-analytics running-total and
+    share-of-partition idioms)."""
+
+    @pytest.fixture()
+    def view(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("cat", 1, 0.5), ("cat", 2, 0.3), ("cat", 3, 0.3),
+             ("dog", 4, 0.9)],
+            ["label", "i", "score"], numPartitions=2,
+        ).createOrReplaceTempView("aw_t")
+
+    def test_partition_aggregate_broadcasts(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT i, SUM(score) OVER (PARTITION BY label) AS tot "
+            "FROM aw_t"
+        ).collect()
+        got = {r.i: round(r.tot, 6) for r in rows}
+        assert got == {1: 1.1, 2: 1.1, 3: 1.1, 4: 0.9}
+
+    def test_running_aggregate_default_frame(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT i, SUM(score) OVER (PARTITION BY label ORDER BY i) "
+            "AS run FROM aw_t"
+        ).collect()
+        got = {r.i: round(r.run, 6) for r in rows}
+        assert got == {1: 0.5, 2: 0.8, 3: 1.1, 4: 0.9}
+
+    def test_running_frame_peers_share(self, tpu_session, view):
+        # Spark's default RANGE frame: rows tied on the order key are
+        # peers and share the frame end
+        rows = tpu_session.sql(
+            "SELECT i, COUNT(*) OVER (PARTITION BY label ORDER BY "
+            "score) AS c FROM aw_t"
+        ).collect()
+        got = {r.i: r.c for r in rows}
+        assert got == {1: 3, 2: 2, 3: 2, 4: 1}
+
+    def test_count_star_over_empty_spec(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT i, COUNT(*) OVER () AS n FROM aw_t"
+        ).collect()
+        assert {r.n for r in rows} == {4}
+
+    def test_avg_window_excludes_nulls(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("a", 2.0), ("a", None), ("a", 4.0)], ["k", "x"]
+        ).createOrReplaceTempView("aw_null")
+        rows = tpu_session.sql(
+            "SELECT AVG(x) OVER (PARTITION BY k) AS m FROM aw_null"
+        ).collect()
+        assert all(r.m == 3.0 for r in rows)
+
+    def test_lag_lead(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT i, LAG(score) OVER (PARTITION BY label ORDER BY i) "
+            "AS prev, LEAD(score, 1, -1.0) OVER (PARTITION BY label "
+            "ORDER BY i) AS nxt FROM aw_t"
+        ).collect()
+        got = {r.i: (r.prev, r.nxt) for r in rows}
+        assert got == {
+            1: (None, 0.3), 2: (0.5, 0.3), 3: (0.3, -1.0),
+            4: (None, -1.0),
+        }
+
+    def test_lag_offset_two(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT i, LAG(score, 2) OVER (ORDER BY i) AS p2 FROM aw_t"
+        ).collect()
+        got = {r.i: r.p2 for r in rows}
+        assert got == {1: None, 2: None, 3: 0.5, 4: 0.3}
+
+    def test_share_of_partition_via_derived_table(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT i, score / tot AS share FROM (SELECT i, score, "
+            "SUM(score) OVER (PARTITION BY label) AS tot FROM aw_t) d "
+            "ORDER BY i"
+        ).collect()
+        assert [round(r.share, 3) for r in rows] == [
+            0.455, 0.273, 0.273, 1.0,
+        ]
+
+    def test_rank_still_requires_order(self, tpu_session, view):
+        with pytest.raises(ValueError, match="ORDER BY"):
+            tpu_session.sql(
+                "SELECT ROW_NUMBER() OVER (PARTITION BY label) FROM aw_t"
+            )
+
+    def test_window_preserves_partitioning(self, tpu_session, view):
+        out = tpu_session.sql(
+            "SELECT *, SUM(score) OVER (PARTITION BY label) AS t FROM aw_t"
+        )
+        assert out.getNumPartitions() == 2
+
+    def test_lag_default_must_be_single_literal(self, tpu_session, view):
+        with pytest.raises(ValueError, match="single literal"):
+            tpu_session.sql(
+                "SELECT LAG(score, 1, 7 + 99) OVER (ORDER BY i) FROM aw_t"
+            )
+
+    def test_collect_list_window_schema_is_array(self, tpu_session, view):
+        from sparkdl_tpu.sql.types import ArrayType, DoubleType
+
+        out = tpu_session.sql(
+            "SELECT i, COLLECT_LIST(score) OVER (PARTITION BY label) "
+            "AS xs FROM aw_t"
+        )
+        assert out.schema["xs"].dataType == ArrayType(DoubleType())
