@@ -481,6 +481,72 @@ class DataFrame:
 
     unionAll = union
 
+    def _row_fingerprints(self) -> "Dict[tuple, int]":
+        """Full-row content fingerprint -> occurrence count (the
+        multiset the set operations compare)."""
+        names = self.columns
+        counts: Dict[tuple, int] = {}
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            cols = [part[c] for c in names]
+            for i in range(n):
+                fp = tuple(_dedupe_key(col[i]) for col in cols)
+                counts[fp] = counts.get(fp, 0) + 1
+        return counts
+
+    def _setop_filter(self, other: "DataFrame", keep) -> "DataFrame":
+        """Shared engine for intersect/except: stream partitions in
+        order, keeping row occurrence #k (1-based, per fingerprint) iff
+        ``keep(k, other_count)``."""
+        if self.columns != other.columns:
+            raise ValueError(
+                f"Set operation requires same columns: {self.columns} "
+                f"vs {other.columns}"
+            )
+        other_counts = other._row_fingerprints()
+        seen: Dict[tuple, int] = {}
+        names = self.columns
+        out_parts: List[Partition] = []
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            cols = [part[c] for c in names]
+            mask = []
+            for i in range(n):
+                fp = tuple(_dedupe_key(col[i]) for col in cols)
+                k = seen.get(fp, 0) + 1
+                seen[fp] = k
+                mask.append(keep(k, other_counts.get(fp, 0)))
+            out_parts.append(
+                {
+                    c: [v for v, m in zip(vals, mask) if m]
+                    for c, vals in part.items()
+                }
+            )
+        return self._with_partitions(out_parts)
+
+    def intersect(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows present in BOTH frames (SQL ``INTERSECT``)."""
+        return self._setop_filter(
+            other, lambda k, oc: k == 1 and oc > 0
+        )
+
+    def intersectAll(self, other: "DataFrame") -> "DataFrame":
+        """Multiset intersection: each row min(count_self, count_other)
+        times (SQL ``INTERSECT ALL``)."""
+        return self._setop_filter(other, lambda k, oc: k <= oc)
+
+    def subtract(self, other: "DataFrame") -> "DataFrame":
+        """Distinct rows of this frame absent from ``other`` (SQL
+        ``EXCEPT``; pyspark ``subtract``)."""
+        return self._setop_filter(
+            other, lambda k, oc: k == 1 and oc == 0
+        )
+
+    def exceptAll(self, other: "DataFrame") -> "DataFrame":
+        """Multiset difference: each row max(0, count_self -
+        count_other) times (SQL ``EXCEPT ALL``)."""
+        return self._setop_filter(other, lambda k, oc: k > oc)
+
     def repartition(self, numPartitions: int) -> "DataFrame":
         names = self.columns
         all_cols: Dict[str, List[Any]] = {c: [] for c in names}

@@ -354,26 +354,28 @@ class TPUSession:
         raise ValueError(f"Unbalanced parentheses in {text!r}")
 
     @classmethod
-    def _split_union(cls, query: str):
-        """Split at top-level ``UNION [ALL]`` joints.  Returns
-        ``(segments, ops)`` where ``ops[i]`` ('all'/'distinct') joins
-        segment i and i+1."""
+    def _split_set_ops(cls, query: str):
+        """Split at top-level ``UNION/INTERSECT/EXCEPT [ALL]`` joints.
+        Returns ``[(op_joining_to_previous, segment), ...]`` with the
+        first op ``None``; op strings are e.g. ``union``/``union_all``."""
         spans = cls._literal_spans(query)
         depth_at = cls._depth_profile(query, spans)
 
         def in_str(i: int) -> bool:
             return any(lo <= i < hi for lo, hi in spans)
 
-        parts, ops, last = [], [], 0
-        for m in re.finditer(r"\bUNION(?:\s+(ALL))?\b", query,
-                             re.IGNORECASE):
+        out, last, prev_op = [], 0, None
+        for m in re.finditer(
+            r"\b(UNION|INTERSECT|EXCEPT)(?:\s+(ALL))?\b", query,
+            re.IGNORECASE,
+        ):
             if in_str(m.start()) or depth_at[m.start()] != 0:
                 continue
-            parts.append(query[last:m.start()])
-            ops.append("all" if m.group(1) else "distinct")
+            out.append((prev_op, query[last:m.start()]))
+            prev_op = m.group(1).lower() + ("_all" if m.group(2) else "")
             last = m.end()
-        parts.append(query[last:])
-        return parts, ops
+        out.append((prev_op, query[last:]))
+        return out
 
     @classmethod
     def _parse_order_items(cls, text: str) -> List[tuple]:
@@ -440,34 +442,70 @@ class TPUSession:
             for n in created:
                 self.catalog.dropTempView(n)
 
+    @staticmethod
+    def _align_columns(left: DataFrame, right: DataFrame) -> DataFrame:
+        """Positional column resolution for set operations: the first
+        branch's names win (as Spark); two-phase rename avoids
+        transient collisions."""
+        names = left.columns
+        if len(right.columns) != len(names):
+            raise ValueError(
+                f"Set operation requires the same column count: "
+                f"{names} vs {right.columns}"
+            )
+        if right.columns != names:
+            tmp = [f"__setop_{i}" for i in range(len(names))]
+            for old, t in zip(list(right.columns), tmp):
+                right = right.withColumnRenamed(old, t)
+            for t, new in zip(tmp, names):
+                right = right.withColumnRenamed(t, new)
+        return right
+
+    def _fold_setop(
+        self, left: DataFrame, op: str, right: DataFrame
+    ) -> DataFrame:
+        right = self._align_columns(left, right)
+        if op == "union_all":
+            return left.union(right)
+        if op == "union":  # bare UNION dedupes the combined result
+            return left.union(right).dropDuplicates()
+        if op == "except":
+            return left.subtract(right)
+        if op == "except_all":
+            return left.exceptAll(right)
+        if op == "intersect":
+            return left.intersect(right)
+        if op == "intersect_all":
+            return left.intersectAll(right)
+        raise AssertionError(op)  # pragma: no cover
+
     def _sql_query(self, query: str, created: List[str]) -> DataFrame:
-        parts, ops = self._split_union(query)
-        if not ops:
+        pieces = self._split_set_ops(query)
+        if len(pieces) == 1:
             return self._sql_select(query, created)
         # standard SQL: a trailing ORDER BY / LIMIT closes the whole
-        # union, not the last branch
-        tail, order_text, limit_n = self._strip_tail_order_limit(parts[-1])
-        parts = parts[:-1] + [tail]
-        dfs = [self._sql_select(p, created) for p in parts]
-        names = dfs[0].columns
-        out = dfs[0]
-        for op, nxt in zip(ops, dfs[1:]):
-            if len(nxt.columns) != len(names):
-                raise ValueError(
-                    f"UNION requires the same column count: {names} "
-                    f"vs {nxt.columns}"
-                )
-            if nxt.columns != names:
-                # positional resolution, first branch's names win (as
-                # Spark); two-phase rename avoids transient collisions
-                tmp = [f"__union_{i}" for i in range(len(names))]
-                for old, t in zip(list(nxt.columns), tmp):
-                    nxt = nxt.withColumnRenamed(old, t)
-                for t, new in zip(tmp, names):
-                    nxt = nxt.withColumnRenamed(t, new)
-            out = out.union(nxt)
-            if op == "distinct":  # left-associative, as SQL
-                out = out.dropDuplicates()
+        # compound query, not the last branch
+        last_op, last_seg = pieces[-1]
+        tail, order_text, limit_n = self._strip_tail_order_limit(last_seg)
+        pieces[-1] = (last_op, tail)
+        evaluated = [
+            (op, self._sql_select(seg, created)) for op, seg in pieces
+        ]
+        # precedence: INTERSECT [ALL] binds tighter than UNION/EXCEPT
+        # (as SQL/Spark) — fold intersect-runs first, then the chain
+        groups: List[tuple] = []
+        cur_op, cur_df = None, None
+        for op, df in evaluated:
+            if op in ("intersect", "intersect_all"):
+                cur_df = self._fold_setop(cur_df, op, df)
+            else:
+                if cur_df is not None:
+                    groups.append((cur_op, cur_df))
+                cur_op, cur_df = op, df
+        groups.append((cur_op, cur_df))
+        out = groups[0][1]
+        for op, df in groups[1:]:
+            out = self._fold_setop(out, op, df)
         if order_text:
             keys, ascs = [], []
             for text, asc in self._parse_order_items(order_text):
@@ -1001,11 +1039,32 @@ class TPUSession:
         as derived columns named by their normalized text); HAVING may
         use direct aggregate calls (computed as hidden columns and
         dropped after the filter)."""
+        # select-list aliases are legal group keys (GROUP BY b where the
+        # projection says CAST(n AS int) AS b — Spark resolution order:
+        # real column first, then alias)
+        alias_map: Dict[str, str] = {}
+        for raw in proj_raw:
+            expr_text, alias = self._strip_alias(raw)
+            if alias:
+                alias_map[alias] = expr_text
         keys: List[str] = []
         if group:
             for raw_key in self._split_projections(group):
-                if not raw_key.strip():
+                raw_key = raw_key.strip()
+                if not raw_key:
                     continue
+                if (
+                    re.fullmatch(r"\w+", raw_key)
+                    and raw_key not in df.columns
+                    and raw_key in alias_map
+                ):
+                    target = alias_map[raw_key]
+                    if self._AGG_RE.match(target):
+                        raise ValueError(
+                            f"GROUP BY {raw_key!r}: cannot group by an "
+                            "aggregate's alias"
+                        )
+                    raw_key = target
                 kname, kexpr = self._group_key(
                     raw_key, qualifiers, columns
                 )
@@ -1492,6 +1551,21 @@ class _PredicateParser:
             self.i += 1
             return -self._factor()
         if kind == "punct" and val == "(":
+            k2, v2 = self._peek(1)
+            if k2 == "ident" and v2.upper() == "SELECT":
+                # scalar subquery: one column, at most one row (zero
+                # rows is NULL, as Spark); evaluated eagerly to a
+                # literal — WHERE score > (SELECT AVG(score) FROM t)
+                from sparkdl_tpu.sql.functions import lit
+
+                self.i += 1
+                vals = self._in_subquery_values()
+                if len(vals) > 1:
+                    raise ValueError(
+                        f"Scalar subquery returned {len(vals)} rows "
+                        f"(at most 1 allowed) in {self.text!r}"
+                    )
+                return lit(vals[0] if vals else None)
             self.i += 1
             inner = self._sum_expr()
             self._expect("punct", ")")
