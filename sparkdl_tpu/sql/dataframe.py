@@ -68,6 +68,17 @@ def _dedupe_key(v):
     return repr(v)
 
 
+def _disjoint_tmp_names(n: int, taken) -> List[str]:
+    """``n`` temp column names guaranteed absent from ``taken`` (a
+    two-phase positional rename with colliding temps would silently
+    clobber real columns)."""
+    taken = set(taken)
+    base = "__tmp"
+    while any(f"{base}_{i}" in taken for i in range(n)):
+        base += "_"
+    return [f"{base}_{i}" for i in range(n)]
+
+
 def _partition_nrows(part: Partition) -> int:
     if not part:
         return 0
@@ -611,6 +622,12 @@ class DataFrame:
             asc = [bool(a) for a in ascending]
         else:
             asc = [bool(ascending)] * len(keys)
+        # Column.asc()/desc() markers override the ascending argument
+        # per key (pyspark: df.orderBy(F.desc("score")))
+        for i, c in enumerate(cols):
+            marker = getattr(c, "_sort_asc", None)
+            if marker is not None:
+                asc[i] = marker
         # Sort a row-index permutation using ONLY the key columns (no Row
         # materialization), then apply it to each column and re-split at
         # the original partition sizes: downstream mapPartitions keeps
@@ -938,6 +955,218 @@ class DataFrame:
         return GroupedData(self, keys)
 
     groupby = groupBy
+
+    def selectExpr(self, *exprs: str) -> "DataFrame":
+        """Project SQL expression strings (pyspark ``selectExpr``):
+        ``df.selectExpr("score * 100 AS pct", "label")``."""
+        if self.sparkSession is None:
+            raise RuntimeError("selectExpr requires a session")
+        parsed: List[Column] = []
+        for e in exprs:
+            e = e.strip()
+            if e == "*":
+                parsed.extend(_col(c) for c in self.columns)
+            else:
+                parsed.append(
+                    self.sparkSession._parse_projection(
+                        e, frozenset(), self.columns
+                    )
+                )
+        return self.select(*parsed)
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        """Cartesian product (pyspark ``crossJoin``); output keeps the
+        left frame's partition count."""
+        clashes = sorted(set(self.columns) & set(other.columns))
+        if clashes:
+            raise ValueError(
+                f"crossJoin would produce duplicate column names "
+                f"{clashes}; rename or drop them on one side first"
+            )
+        right_cols: Dict[str, List[Any]] = {c: [] for c in other.columns}
+        for part in other._partitions:
+            for c in other.columns:
+                right_cols[c].extend(part[c])
+        n_right = len(next(iter(right_cols.values()))) if other.columns else 0
+        out_parts: List[Partition] = []
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            p: Partition = {}
+            for c in self.columns:
+                p[c] = [v for v in part[c] for _ in range(n_right)]
+            for c in other.columns:
+                p[c] = list(right_cols[c]) * n
+            out_parts.append(p)
+        schema = StructType(
+            [StructField(f.name, f.dataType) for f in self._schema]
+            + [StructField(f.name, f.dataType) for f in other._schema]
+        )
+        return DataFrame(out_parts, schema, self.sparkSession)
+
+    def sample(
+        self,
+        withReplacement=None,
+        fraction: Optional[float] = None,
+        seed: Optional[int] = None,
+    ) -> "DataFrame":
+        """Row sampling (pyspark argument juggling supported:
+        ``sample(0.5)``, ``sample(0.5, seed)``, ``sample(False, 0.5,
+        seed)``).  Without replacement: Bernoulli(fraction) per row;
+        with replacement: Poisson(fraction) copies per row."""
+        if isinstance(withReplacement, (int, float)) and not isinstance(
+            withReplacement, bool
+        ):
+            withReplacement, fraction, seed = False, withReplacement, fraction
+        if fraction is None:
+            raise ValueError("sample requires a fraction")
+        import numpy as np
+
+        rng = np.random.RandomState(seed)
+        out_parts: List[Partition] = []
+        for part in self._partitions:
+            n = _partition_nrows(part)
+            if withReplacement:
+                counts = rng.poisson(float(fraction), size=n)
+            else:
+                counts = (
+                    rng.random_sample(n) < float(fraction)
+                ).astype(int)
+            out_parts.append(
+                {
+                    c: [v for v, k in zip(vals, counts)
+                        for _ in range(int(k))]
+                    for c, vals in part.items()
+                }
+            )
+        return self._with_partitions(out_parts)
+
+    def describe(self, *cols: str) -> "DataFrame":
+        """count/mean/stddev/min/max summary (pyspark ``describe``):
+        numeric columns get all five, string columns count/min/max."""
+        from sparkdl_tpu.sql.types import (
+            DoubleType,
+            FloatType,
+            IntegerType,
+            LongType,
+            StringType,
+        )
+
+        numeric = (IntegerType, LongType, FloatType, DoubleType)
+        targets = list(cols) or [
+            f.name
+            for f in self._schema
+            if isinstance(f.dataType, numeric + (StringType,))
+        ]
+        for c in targets:
+            if c not in self.columns:
+                raise KeyError(f"No such column: {c!r}")
+        stats = ["count", "mean", "stddev", "min", "max"]
+        # ONE aggregation pass over all target columns (Spark's
+        # describe is one-pass too), labels prefixed per column
+        pairs: List[tuple] = []
+        per_col: Dict[str, Dict[str, str]] = {}
+        for c in targets:
+            is_num = isinstance(self._field_type(c), numeric)
+            fns = (
+                [("count", "count"), ("avg", "mean"),
+                 ("stddev", "stddev"), ("min", "min"), ("max", "max")]
+                if is_num
+                else [("count", "count"), ("min", "min"), ("max", "max")]
+            )
+            per_col[c] = {}
+            for fn_key, stat in fns:
+                label = f"__describe_{stat}({c})"
+                pairs.append((c, fn_key, label))
+                per_col[c][stat] = label
+        row = self.groupBy()._aggregate(pairs).collect()[0]
+        part: Partition = {"summary": list(stats)}
+        for c in targets:
+            part[c] = [
+                str(row[per_col[c][s]])
+                if s in per_col[c] and row[per_col[c][s]] is not None
+                else None
+                for s in stats
+            ]
+        st = StructType().add("summary", StringType())
+        for c in targets:
+            st.add(c, StringType())
+        return DataFrame([part], st, self.sparkSession)
+
+    def corr(self, col1: str, col2: str) -> float:
+        """Pearson correlation of two numeric columns (pyspark
+        ``df.corr``); NULL-bearing pairs are excluded."""
+        import numpy as np
+
+        xs, ys = self._numeric_pairs(col1, col2)
+        if len(xs) < 2:
+            return float("nan")
+        return float(np.corrcoef(xs, ys)[0, 1])
+
+    def cov(self, col1: str, col2: str) -> float:
+        """Sample covariance of two numeric columns (pyspark
+        ``df.cov``)."""
+        import numpy as np
+
+        xs, ys = self._numeric_pairs(col1, col2)
+        if len(xs) < 2:
+            return float("nan")
+        return float(np.cov(xs, ys, ddof=1)[0, 1])
+
+    def _numeric_pairs(self, col1: str, col2: str):
+        for c in (col1, col2):
+            if c not in self.columns:
+                raise KeyError(f"No such column: {c!r}")
+        xs: List[float] = []
+        ys: List[float] = []
+        for part in self._partitions:
+            for a, b in zip(part[col1], part[col2]):
+                if a is not None and b is not None:
+                    xs.append(float(a))
+                    ys.append(float(b))
+        return xs, ys
+
+    def isEmpty(self) -> bool:
+        return self.count() == 0
+
+    def tail(self, num: int) -> List[Row]:
+        rows = self.collect()
+        return rows[len(rows) - num:] if num < len(rows) else rows
+
+    def toDF(self, *names: str) -> "DataFrame":
+        """Rename every column positionally (pyspark ``toDF``)."""
+        if len(names) != len(self.columns):
+            raise ValueError(
+                f"toDF needs {len(self.columns)} names, got {len(names)}"
+            )
+        out = self
+        tmp = _disjoint_tmp_names(
+            len(names), set(self.columns) | set(names)
+        )
+        for old, t in zip(list(out.columns), tmp):
+            out = out.withColumnRenamed(old, t)
+        for t, new in zip(tmp, names):
+            out = out.withColumnRenamed(t, new)
+        return out
+
+    def withColumns(self, colsMap: "Dict[str, Column]") -> "DataFrame":
+        out = self
+        for name, expr in colsMap.items():
+            out = out.withColumn(name, expr)
+        return out
+
+    def sortWithinPartitions(
+        self, *cols: "Column | str", ascending: "bool | Sequence[bool]" = True
+    ) -> "DataFrame":
+        """Sort each partition independently (pyspark analog) — the
+        local-sort primitive before a mapPartitions that wants ordered
+        input without a global shuffle."""
+        out_parts = []
+        for part in self._partitions:
+            single = DataFrame([part], self._schema, self.sparkSession)
+            out_parts.extend(
+                single.orderBy(*cols, ascending=ascending)._partitions
+            )
+        return self._with_partitions(out_parts)
 
     def cache(self) -> "DataFrame":
         return self
@@ -1306,19 +1535,43 @@ class GroupedData:
         self._keys = keys
 
     # -- core -----------------------------------------------------------
-    def agg(self, exprs: "Dict[str, str] | None" = None, **kwargs: str
-            ) -> DataFrame:
-        """``agg({"score": "avg", "*": "count"})`` or
-        ``agg(score="avg")``; output columns are named ``fn(col)`` as in
-        pyspark."""
-        spec = dict(exprs or {})
+    def agg(self, *exprs, **kwargs: str) -> DataFrame:
+        """``agg({"score": "avg", "*": "count"})``, ``agg(score="avg")``,
+        or aggregate Column expressions built by
+        :mod:`sparkdl_tpu.sql.functions` —
+        ``agg(F.avg("score").alias("m"), F.count("*"))`` — as pyspark;
+        output columns default to ``fn(col)``."""
+        pairs: List[tuple] = []
+        spec: Dict[str, str] = {}
+        # back-compat: the pre-round-5 signature was agg(exprs={...})
+        if isinstance(kwargs.get("exprs"), dict):
+            spec.update(kwargs.pop("exprs"))
+        for e in exprs:
+            if e is None:
+                continue
+            if isinstance(e, dict):
+                spec.update(e)
+            elif isinstance(e, Column):
+                marker = getattr(e, "_agg", None)
+                if marker is None:
+                    raise ValueError(
+                        f"agg() Column {e._name!r} is not an aggregate; "
+                        "build it with functions.avg/sum/count/... "
+                        "(optionally .alias(...))"
+                    )
+                col_name, fn_key = marker
+                pairs.append((col_name, fn_key, e._name))
+            else:
+                raise TypeError(
+                    f"agg() takes a dict, keyword fn names, or aggregate "
+                    f"Columns, got {type(e).__name__}"
+                )
         spec.update(kwargs)
-        if not spec:
-            raise ValueError("agg requires at least one aggregate")
-        pairs = []
         for col_name, fn_name in spec.items():
             fn_key = fn_name.lower()
             pairs.append((col_name, fn_key, f"{fn_key}({col_name})"))
+        if not pairs:
+            raise ValueError("agg requires at least one aggregate")
         return self._aggregate(pairs)
 
     def _aggregate(self, pairs: List[tuple]) -> DataFrame:

@@ -38,7 +38,24 @@ class Column:
         return Column(lambda cols, n: [value] * n, str(value))
 
     def alias(self, name: str) -> "Column":
-        return Column(self._eval, name)
+        out = Column(self._eval, name)
+        # aggregate/sort markers survive aliasing (F.avg("x").alias("m")
+        # must still aggregate; F.desc("x") has no alias use but be safe)
+        for attr in ("_agg", "_sort_asc"):
+            if hasattr(self, attr):
+                setattr(out, attr, getattr(self, attr))
+        return out
+
+    # -- sort direction markers (pyspark Column.asc/desc) ----------------
+    def asc(self) -> "Column":
+        out = Column(self._eval, self._name)
+        out._sort_asc = True
+        return out
+
+    def desc(self) -> "Column":
+        out = Column(self._eval, self._name)
+        out._sort_asc = False
+        return out
 
     def getField(self, field: str) -> "Column":
         def ev(cols, n):
@@ -270,6 +287,28 @@ class Column:
             f"({self._name} IS NOT NULL)",
         )
 
+    # -- CASE WHEN (pyspark when/otherwise chain) ------------------------
+    def when(self, condition: "Column", value) -> "Column":
+        """Chain another WHEN branch (only valid on a Column started by
+        :func:`when`)."""
+        branches = getattr(self, "_when_branches", None)
+        if branches is None:
+            raise TypeError(
+                "when() can only chain on a Column created by "
+                "functions.when(...)"
+            )
+        return _case_column(branches + [(condition, value)], None)
+
+    def otherwise(self, value) -> "Column":
+        branches = getattr(self, "_when_branches", None)
+        if branches is None:
+            raise TypeError(
+                "otherwise() requires a Column created by "
+                "functions.when(...)"
+            )
+        return _case_column(branches, value if isinstance(value, Column)
+                            else Column._literal(value))
+
     def __repr__(self):
         return f"Column<{self._name}>"
 
@@ -283,6 +322,233 @@ column = col
 
 def lit(value: Any) -> Column:
     return Column._literal(value)
+
+
+def _case_column(branches, default: "Optional[Column]") -> Column:
+    """CASE evaluator with SQL conditional-evaluation semantics shared
+    by the dialect's ``CASE WHEN`` and the pyspark ``when/otherwise``
+    chain: branch conditions run in order only on still-unmatched rows,
+    and branch VALUES run only on the rows their condition selected
+    (``when(n != 0, 100 / n)`` never divides by the guarded zero); a
+    NULL condition falls through, as in Spark."""
+    norm = [
+        (c, v if isinstance(v, Column) else Column._literal(v))
+        for c, v in branches
+    ]
+
+    def ev(cols, n):
+        out = [None] * n
+        remaining = list(range(n))
+
+        def sub_eval(expr, idx):
+            sub = {c: [vals[i] for i in idx] for c, vals in cols.items()}
+            return expr._eval(sub, len(idx))
+
+        for cexpr, vexpr in norm:
+            if not remaining:
+                break
+            cvals = sub_eval(cexpr, remaining)
+            matched = [i for i, cv in zip(remaining, cvals) if cv]
+            if matched:
+                for i, v in zip(matched, sub_eval(vexpr, matched)):
+                    out[i] = v
+            remaining = [i for i, cv in zip(remaining, cvals) if not cv]
+        if default is not None and remaining:
+            for i, v in zip(remaining, sub_eval(default, remaining)):
+                out[i] = v
+        return out
+
+    col_ = Column(ev, "CASE")
+    if default is None:
+        # only an open chain accepts further .when()/.otherwise()
+        # (pyspark rejects otherwise-after-otherwise too)
+        col_._when_branches = list(branches)
+    return col_
+
+
+def when(condition: Column, value) -> Column:
+    """Start a pyspark ``when/otherwise`` chain:
+    ``F.when(col("n") > 0, 1).when(...).otherwise(0)``."""
+    return _case_column([(condition, value)], None)
+
+
+def _agg_column(fn_key: str, col_or_name, label: Optional[str] = None
+                ) -> Column:
+    """An aggregate-marked Column for ``GroupedData.agg`` — evaluating
+    it outside an aggregation raises (as pyspark's analysis would)."""
+    name = col_or_name if isinstance(col_or_name, str) else col_or_name._name
+    label = label or f"{fn_key}({name})"
+
+    def ev(cols, n):
+        raise ValueError(
+            f"aggregate expression {label!r} can only be used inside "
+            "GroupedData.agg(...)"
+        )
+
+    out = Column(ev, label)
+    out._agg = (name, fn_key)
+    return out
+
+
+def count(col_or_name) -> Column:
+    name = col_or_name if isinstance(col_or_name, str) else col_or_name._name
+    if name == "*":
+        return _agg_column("count", "*", "count(*)")
+    return _agg_column("count", col_or_name)
+
+
+def countDistinct(col_or_name) -> Column:
+    name = col_or_name if isinstance(col_or_name, str) else col_or_name._name
+    return _agg_column("count_distinct", name, f"count(DISTINCT {name})")
+
+
+def sum(col_or_name) -> Column:  # noqa: A001 - pyspark name
+    return _agg_column("sum", col_or_name)
+
+
+def avg(col_or_name) -> Column:
+    return _agg_column("avg", col_or_name)
+
+
+mean = avg
+
+
+def min(col_or_name) -> Column:  # noqa: A001 - pyspark name
+    return _agg_column("min", col_or_name)
+
+
+def max(col_or_name) -> Column:  # noqa: A001 - pyspark name
+    return _agg_column("max", col_or_name)
+
+
+def stddev(col_or_name) -> Column:
+    return _agg_column("stddev", col_or_name)
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(col_or_name) -> Column:
+    return _agg_column("stddev_pop", col_or_name)
+
+
+def variance(col_or_name) -> Column:
+    return _agg_column("variance", col_or_name)
+
+
+var_samp = variance
+
+
+def var_pop(col_or_name) -> Column:
+    return _agg_column("var_pop", col_or_name)
+
+
+def collect_list(col_or_name) -> Column:
+    return _agg_column("collect_list", col_or_name)
+
+
+def collect_set(col_or_name) -> Column:
+    return _agg_column("collect_set", col_or_name)
+
+
+def asc(name: str) -> Column:
+    return col(name).asc()
+
+
+def desc(name: str) -> Column:
+    return col(name).desc()
+
+
+def _scalar_fn(name, fn, *cols_in) -> Column:
+    cols_ = [
+        c if isinstance(c, Column) else col(c) for c in cols_in
+    ]
+
+    def ev(colmap, n):
+        if not cols_:
+            # zero-arg call (concat() -> "" per row, coalesce() -> NULL):
+            # zip(*[]) would silently yield ZERO rows, dropping data
+            return [fn() for _ in range(n)]
+        evaluated = [c._eval(colmap, n) for c in cols_]
+        return [fn(*vals) for vals in zip(*evaluated)] if n else []
+
+    return Column(
+        ev, "%s(%s)" % (name, ", ".join(c._name for c in cols_))
+    )
+
+
+def abs(col_or_name) -> Column:  # noqa: A001 - pyspark name
+    import builtins
+
+    return _scalar_fn(
+        "abs", lambda a: None if a is None else builtins.abs(a),
+        col_or_name,
+    )
+
+
+def upper(col_or_name) -> Column:
+    return _scalar_fn(
+        "upper", lambda a: None if a is None else a.upper(), col_or_name
+    )
+
+
+def lower(col_or_name) -> Column:
+    return _scalar_fn(
+        "lower", lambda a: None if a is None else a.lower(), col_or_name
+    )
+
+
+def length(col_or_name) -> Column:
+    return _scalar_fn(
+        "length", lambda a: None if a is None else len(a), col_or_name
+    )
+
+
+def concat(*cols_in) -> Column:
+    return _scalar_fn(
+        "concat",
+        lambda *vs: None if any(v is None for v in vs)
+        else "".join(str(v) for v in vs),
+        *cols_in,
+    )
+
+
+def substring(col_or_name, pos: int, length_: int) -> Column:
+    # SQL 1-based positions, as pyspark
+    return _scalar_fn(
+        "substring",
+        lambda a: None if a is None else a[pos - 1:pos - 1 + length_],
+        col_or_name,
+    )
+
+
+def coalesce(*cols_in) -> Column:
+    return _scalar_fn(
+        "coalesce",
+        lambda *vs: next((v for v in vs if v is not None), None),
+        *cols_in,
+    )
+
+
+def isnull(col_or_name) -> Column:
+    c = col_or_name if isinstance(col_or_name, Column) else col(col_or_name)
+    return c.isNull()
+
+
+def expr(text: str) -> Column:
+    """Parse a SQL expression string into a Column against the active
+    session's UDF registry (``F.expr("score * 100")``, ``F.expr("n AS
+    m")`` — a trailing alias is honored, as pyspark)."""
+    from sparkdl_tpu.sql.session import TPUSession, _PredicateParser
+
+    body, alias = TPUSession._strip_alias(text.strip())
+    session = TPUSession._active
+    out = _PredicateParser(
+        body,
+        udf_registry=session.udf if session else None,
+        session=session,
+    ).parse_expression()
+    return out.alias(alias or body)
 
 
 def struct(*cols: "Column | str") -> Column:

@@ -454,7 +454,11 @@ class TPUSession:
                 f"{names} vs {right.columns}"
             )
         if right.columns != names:
-            tmp = [f"__setop_{i}" for i in range(len(names))]
+            from sparkdl_tpu.sql.dataframe import _disjoint_tmp_names
+
+            tmp = _disjoint_tmp_names(
+                len(names), set(right.columns) | set(names)
+            )
             for old, t in zip(list(right.columns), tmp):
                 right = right.withColumnRenamed(old, t)
             for t, new in zip(tmp, names):
@@ -1642,39 +1646,11 @@ class _PredicateParser:
         default = self._sum_expr() if self._accept_kw("ELSE") else None
         if not self._accept_kw("END"):
             raise ValueError(f"Expected END closing CASE in {self.text!r}")
+        # one CASE evaluator (SQL conditional-evaluation guarantee)
+        # shared with the pyspark when/otherwise chain
+        from sparkdl_tpu.sql.functions import _case_column
 
-        def ev(cols, n):
-            # SQL conditional-evaluation guarantee (as Spark): branch
-            # conditions run in order only on still-unmatched rows, and
-            # branch VALUES run only on the rows their condition
-            # selected — `CASE WHEN n != 0 THEN 100 / n ELSE 0 END`
-            # must never divide by the guarded zero
-            out = [None] * n
-            remaining = list(range(n))
-
-            def sub_eval(expr, idx):
-                sub = {c: [vals[i] for i in idx] for c, vals in cols.items()}
-                return expr._eval(sub, len(idx))
-
-            for cexpr, vexpr in branches:
-                if not remaining:
-                    break
-                cvals = sub_eval(cexpr, remaining)
-                matched = [
-                    i for i, cv in zip(remaining, cvals) if cv
-                ]  # None and False both fall through
-                if matched:
-                    for i, v in zip(matched, sub_eval(vexpr, matched)):
-                        out[i] = v
-                remaining = [
-                    i for i, cv in zip(remaining, cvals) if not cv
-                ]
-            if default is not None and remaining:
-                for i, v in zip(remaining, sub_eval(default, remaining)):
-                    out[i] = v
-            return out
-
-        return Column(ev, "CASE")
+        return _case_column(branches, default).alias("CASE")
 
     _CAST_TYPES = {
         "int": "int", "integer": "int", "bigint": "long", "long": "long",

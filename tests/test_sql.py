@@ -1926,3 +1926,177 @@ class TestSetOpsAndScalarSubqueries:
             views.sql(
                 "SELECT n AS k, COUNT(*) AS c FROM so_x GROUP BY k"
             )
+
+
+class TestFunctionsSurface:
+    """pyspark.sql.functions free-function parity (F.avg/F.desc/F.when/
+    F.expr) + the round-5 DataFrame method batch."""
+
+    @pytest.fixture()
+    def fdf(self, tpu_session):
+        return tpu_session.createDataFrame(
+            [("a", 1, 0.5), ("a", 2, 1.5), ("b", 3, 2.5)],
+            ["k", "n", "x"], numPartitions=2,
+        )
+
+    def test_agg_with_function_columns(self, fdf):
+        import sparkdl_tpu.sql.functions as F
+
+        out = fdf.groupBy("k").agg(
+            F.avg("x").alias("m"), F.count("*"), F.countDistinct("n")
+        )
+        assert out.columns == ["k", "m", "count(*)", "count(DISTINCT n)"]
+        got = {r.k: (r.m, r["count(*)"]) for r in out.collect()}
+        assert got == {"a": (1.0, 2), "b": (2.5, 1)}
+
+    def test_agg_rejects_non_aggregate_column(self, fdf):
+        from sparkdl_tpu.sql.functions import col
+
+        with pytest.raises(ValueError, match="not an aggregate"):
+            fdf.groupBy("k").agg(col("x"))
+
+    def test_order_by_desc_marker(self, fdf):
+        import sparkdl_tpu.sql.functions as F
+
+        assert [r.n for r in fdf.orderBy(F.desc("n")).collect()] == [3, 2, 1]
+        assert [
+            r.n for r in fdf.orderBy(F.asc("k"), F.desc("x")).collect()
+        ] == [2, 1, 3]
+
+    def test_when_otherwise_chain(self, fdf):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import col
+
+        out = fdf.withColumn(
+            "sign",
+            F.when(col("x") > 1, "hi").when(col("x") > 0.4, "mid")
+            .otherwise("lo"),
+        )
+        assert [r.sign for r in out.collect()] == ["mid", "hi", "hi"]
+
+    def test_when_guards_division(self, tpu_session):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import col, lit
+
+        df = tpu_session.createDataFrame([(4.0,), (0.0,)], ["d"])
+        out = df.withColumn(
+            "q", F.when(col("d") != 0, lit(100.0) / col("d")).otherwise(0.0)
+        )
+        assert [r.q for r in out.collect()] == [25.0, 0.0]
+
+    def test_otherwise_requires_when(self, fdf):
+        from sparkdl_tpu.sql.functions import col
+
+        with pytest.raises(TypeError, match="when"):
+            col("x").otherwise(0)
+
+    def test_expr_and_select_expr(self, fdf):
+        import sparkdl_tpu.sql.functions as F
+
+        out = fdf.select(F.expr("x * 100").alias("pct"))
+        assert [r.pct for r in out.collect()] == [50.0, 150.0, 250.0]
+        out2 = fdf.selectExpr("k", "x * 2 AS dbl")
+        assert out2.columns == ["k", "dbl"]
+        assert [r.dbl for r in out2.collect()] == [1.0, 3.0, 5.0]
+
+    def test_scalar_function_helpers(self, tpu_session):
+        import sparkdl_tpu.sql.functions as F
+
+        df = tpu_session.createDataFrame(
+            [("Ab", -2, None), (None, 3, "z")], ["s", "i", "t"]
+        )
+        out = df.select(
+            F.upper("s").alias("u"), F.abs("i").alias("a"),
+            F.coalesce("s", "t").alias("c"),
+            F.concat("s", "t").alias("cat"),
+        )
+        rows = out.collect()
+        assert (rows[0].u, rows[0].a, rows[0].c) == ("AB", 2, "Ab")
+        assert (rows[1].u, rows[1].a, rows[1].c) == (None, 3, "z")
+        assert rows[0].cat is None  # NULL-propagating concat, as Spark
+        out2 = tpu_session.createDataFrame(
+            [("hello",)], ["w"]
+        ).select(F.substring("w", 2, 3).alias("sub"))
+        assert out2.collect()[0].sub == "ell"
+
+    def test_cross_join(self, fdf):
+        left = fdf.select("k").withColumnRenamed("k", "k1")
+        out = left.crossJoin(fdf.select("n"))
+        assert out.count() == 9
+        assert out.columns == ["k1", "n"]
+        with pytest.raises(ValueError, match="duplicate"):
+            fdf.crossJoin(fdf)
+
+    def test_sample(self, fdf):
+        assert fdf.sample(1.0).count() == 3
+        assert fdf.sample(0.0, 42).count() == 0
+        big = fdf.sparkSession.createDataFrame(
+            [(i,) for i in range(2000)], ["i"]
+        )
+        n = big.sample(0.5, seed=7).count()
+        assert 850 < n < 1150  # Bernoulli(0.5), ~5 sigma
+        m = big.sample(True, 0.5, 7).count()  # Poisson with replacement
+        assert 850 < m < 1150
+
+    def test_describe(self, fdf):
+        out = fdf.describe("x")
+        assert out.columns == ["summary", "x"]
+        got = {r.summary: r.x for r in out.collect()}
+        assert got["count"] == "3" and got["mean"] == "1.5"
+        assert float(got["stddev"]) == pytest.approx(1.0)
+        assert got["min"] == "0.5" and got["max"] == "2.5"
+
+    def test_corr_cov_tail_isempty_todf(self, fdf):
+        assert fdf.corr("n", "x") == pytest.approx(1.0)
+        assert fdf.cov("n", "x") == pytest.approx(1.0)
+        assert [r.n for r in fdf.tail(2)] == [2, 3]
+        assert not fdf.isEmpty() and fdf.limit(0).isEmpty()
+        assert fdf.toDF("a", "b", "c").columns == ["a", "b", "c"]
+
+    def test_with_columns_and_sort_within_partitions(self, fdf):
+        from sparkdl_tpu.sql.functions import col
+
+        out = fdf.withColumns(
+            {"y": col("x") * 2, "z": col("n") + 1}
+        )
+        assert out.columns == ["k", "n", "x", "y", "z"]
+        import sparkdl_tpu.sql.functions as F
+
+        sp = fdf.sortWithinPartitions(F.desc("n"))
+        assert sp.getNumPartitions() == fdf.getNumPartitions()
+        # each partition individually descending
+        descending = []
+        sp.foreachPartition(
+            lambda p: descending.append(
+                all(a >= b for a, b in zip(p["n"], p["n"][1:]))
+            )
+        )
+        assert all(descending)
+
+    def test_agg_exprs_keyword_back_compat(self, fdf):
+        out = fdf.groupBy("k").agg(exprs={"x": "avg"})
+        assert {r.k: r["avg(x)"] for r in out.collect()} == {
+            "a": 1.0, "b": 2.5,
+        }
+
+    def test_zero_arg_scalar_fns_keep_rows(self, fdf):
+        import sparkdl_tpu.sql.functions as F
+
+        out = fdf.select(F.concat().alias("c"), F.coalesce().alias("n0"))
+        rows = out.collect()
+        assert len(rows) == 3
+        assert all(r.c == "" and r.n0 is None for r in rows)
+
+    def test_todf_temp_names_cannot_clobber(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [(1, 2)], ["b", "__tmp_0"]
+        ).toDF("x", "y")
+        assert df.columns == ["x", "y"]
+        assert df.collect()[0] == Row(x=1, y=2)
+
+    def test_expr_with_alias(self, fdf):
+        import sparkdl_tpu.sql.functions as F
+
+        out = fdf.select(F.expr("n AS m"))
+        assert out.columns == ["m"]
+        assert [r.m for r in out.collect()] == [1, 2, 3]
