@@ -1530,9 +1530,27 @@ class GroupedData:
     first-appearance order; aggregation collects to the driver (the engine
     is a local substrate — SURVEY.md §7 — so no shuffle is involved)."""
 
-    def __init__(self, df: DataFrame, keys: List[str]):
+    def __init__(self, df: DataFrame, keys: List[str],
+                 pivot: Optional[tuple] = None):
         self._df = df
         self._keys = keys
+        self._pivot = pivot  # (pivot_col, explicit values or None)
+
+    def pivot(self, pivot_col: str, values: Optional[Sequence] = None
+              ) -> "GroupedData":
+        """Pivot the distinct values of ``pivot_col`` into output
+        columns (pyspark ``GroupedData.pivot``): the subsequent
+        aggregate runs per (group, pivot value).  ``values`` fixes the
+        column set explicitly (missing combinations are NULL);
+        discovered values are sorted ascending, NULLs excluded."""
+        if pivot_col not in self._df.columns:
+            raise KeyError(f"No such column: {pivot_col!r}")
+        if self._pivot is not None:
+            raise ValueError("pivot() can only be applied once")
+        return GroupedData(
+            self._df, self._keys,
+            pivot=(pivot_col, list(values) if values is not None else None),
+        )
 
     # -- core -----------------------------------------------------------
     def agg(self, *exprs, **kwargs: str) -> DataFrame:
@@ -1578,7 +1596,8 @@ class GroupedData:
         """``pairs``: (column-or-*, fn key, OUTPUT column name).  All
         validation lives here (every caller path gets the same errors):
         fn must be known, columns must exist, ``*`` only pairs with
-        count, and output names must be unique.
+        count, and output names must be unique.  With a pivot set, the
+        pairs compute per (group, pivot value) and reshape wide.
 
         Execution is partial aggregation with projection pushdown: each
         partition folds ONLY the key + referenced columns into per-group
@@ -1600,6 +1619,8 @@ class GroupedData:
                     )
             elif col_name not in self._df.columns:
                 raise KeyError(f"No such column: {col_name!r}")
+        if self._pivot is not None:
+            return self._aggregate_pivot(pairs)
         out_names = list(self._keys) + [label for _, _, label in pairs]
         if len(set(out_names)) != len(out_names):
             raise ValueError(
@@ -1669,6 +1690,82 @@ class GroupedData:
             [part_out], self._output_schema(pairs, part_out),
             self._df.sparkSession,
         )
+
+    def _aggregate_pivot(self, pairs: List[tuple]) -> DataFrame:
+        """Wide reshape: aggregate grouped by keys + pivot column, then
+        spread each pivot value into its own column set.  Missing
+        (group, value) combinations are NULL; one aggregate names
+        columns ``str(value)``, several name them ``value_label``."""
+        pcol, pvals = self._pivot
+        base = GroupedData(
+            self._df, self._keys + [pcol]
+        )._aggregate(pairs)
+        labels = [label for _, _, label in pairs]
+        base_part = base._partitions[0]
+        if pvals is None:
+            seen = {
+                v for v in base_part[pcol] if v is not None
+            }  # discovered values: NULL pivot groups are dropped
+            try:
+                pvals = sorted(seen)
+            except TypeError:
+                pvals = sorted(seen, key=lambda v: (str(type(v)), str(v)))
+        single = len(labels) == 1
+
+        def col_name(v, label):
+            v_str = "null" if v is None else str(v)
+            return v_str if single else f"{v_str}_{label}"
+
+        # pivot-derived names are data-driven: a value that collides
+        # with a group key, or two values that stringify identically
+        # (1 vs "1"), would silently overwrite dict entries downstream
+        out_names = list(self._keys) + [
+            col_name(v, label) for v in pvals for label in labels
+        ]
+        if len(set(out_names)) != len(out_names):
+            dupes = sorted(
+                {n for n in out_names if out_names.count(n) > 1}
+            )
+            raise ValueError(
+                f"pivot produces duplicate output columns {dupes}; "
+                "rename the group key or restrict/clean the pivot "
+                "values"
+            )
+
+        # (group key tuple) -> {pivot value -> row index in base}
+        n_base = _partition_nrows(base_part)
+        key_cols = [base_part[k] for k in self._keys]
+        pivot_vals = base_part[pcol]
+        index: Dict[tuple, Dict[Any, int]] = {}
+        gorder: List[tuple] = []
+        for i in range(n_base):
+            key = tuple(kc[i] for kc in key_cols)
+            if key not in index:
+                index[key] = {}
+                gorder.append(key)
+            index[key][pivot_vals[i]] = i
+
+        out: Partition = {k: [] for k in self._keys}
+        for v in pvals:
+            for label in labels:
+                out[col_name(v, label)] = []
+        for key in gorder:
+            for k, kv in zip(self._keys, key):
+                out[k].append(kv)
+            for v in pvals:
+                i = index[key].get(v)
+                for label in labels:
+                    out[col_name(v, label)].append(
+                        base_part[label][i] if i is not None else None
+                    )
+
+        st = StructType()
+        for k in self._keys:
+            st.add(k, self._df._field_type(k))
+        for v in pvals:
+            for label in labels:
+                st.add(col_name(v, label), base.schema[label].dataType)
+        return DataFrame([out], st, self._df.sparkSession)
 
     def _output_schema(self, pairs: List[tuple], part_out: Partition
                        ) -> StructType:

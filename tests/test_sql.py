@@ -2100,3 +2100,67 @@ class TestFunctionsSurface:
         out = fdf.select(F.expr("n AS m"))
         assert out.columns == ["m"]
         assert [r.m for r in out.collect()] == [1, 2, 3]
+
+
+class TestPivot:
+    """GroupedData.pivot (the pyspark wide-reshape idiom)."""
+
+    @pytest.fixture()
+    def pdf(self, tpu_session):
+        return tpu_session.createDataFrame(
+            [("a", "cat", 1.0), ("a", "dog", 2.0), ("b", "cat", 3.0),
+             ("a", "cat", 5.0), ("b", None, 9.0)],
+            ["k", "animal", "x"], numPartitions=2,
+        )
+
+    def test_pivot_single_aggregate(self, pdf):
+        out = pdf.groupBy("k").pivot("animal").agg({"x": "sum"})
+        # discovered values sorted ascending; NULL pivot groups dropped
+        assert out.columns == ["k", "cat", "dog"]
+        got = {r.k: (r.cat, r.dog) for r in out.collect()}
+        assert got == {"a": (6.0, 2.0), "b": (3.0, None)}
+
+    def test_pivot_explicit_values(self, pdf):
+        out = pdf.groupBy("k").pivot("animal", ["cat", "owl"]).agg(
+            {"x": "sum"}
+        )
+        assert out.columns == ["k", "cat", "owl"]
+        got = {r.k: (r.cat, r.owl) for r in out.collect()}
+        assert got == {"a": (6.0, None), "b": (3.0, None)}
+
+    def test_pivot_multi_aggregate_names(self, pdf):
+        import sparkdl_tpu.sql.functions as F
+
+        out = pdf.groupBy("k").pivot("animal").agg(
+            F.sum("x").alias("s"), F.count("*").alias("c")
+        )
+        assert out.columns == ["k", "cat_s", "cat_c", "dog_s", "dog_c"]
+        got = {r.k: (r["cat_s"], r["cat_c"]) for r in out.collect()}
+        assert got == {"a": (6.0, 2), "b": (3.0, 1)}
+
+    def test_pivot_schema_types(self, pdf):
+        from sparkdl_tpu.sql.types import DoubleType, StringType
+
+        out = pdf.groupBy("k").pivot("animal").agg({"x": "sum"})
+        assert out.schema["k"].dataType == StringType()
+        assert out.schema["cat"].dataType == DoubleType()
+
+    def test_pivot_twice_errors(self, pdf):
+        with pytest.raises(ValueError, match="once"):
+            pdf.groupBy("k").pivot("animal").pivot("animal")
+
+    def test_pivot_named_helper(self, pdf):
+        out = pdf.groupBy("k").pivot("animal").sum("x")
+        assert out.columns == ["k", "cat", "dog"]
+
+    def test_pivot_name_collision_raises(self, tpu_session):
+        df = tpu_session.createDataFrame(
+            [("a", "k", 1.0), ("b", "cat", 2.0)], ["k", "animal", "x"]
+        )
+        with pytest.raises(ValueError, match="duplicate"):
+            df.groupBy("k").pivot("animal").agg({"x": "sum"})
+        df2 = tpu_session.createDataFrame(
+            [("a", 1, 1.0), ("a", "1", 2.0)], ["k", "v", "x"]
+        )
+        with pytest.raises(ValueError, match="duplicate"):
+            df2.groupBy("k").pivot("v").agg({"x": "sum"})
