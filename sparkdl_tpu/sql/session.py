@@ -513,12 +513,22 @@ class TPUSession:
         if order_text:
             keys, ascs = [], []
             for text, asc in self._parse_order_items(order_text):
-                if not re.fullmatch(r"\w+", text) or text not in out.columns:
+                if re.fullmatch(r"\d+", text):
+                    n_ = int(text)
+                    if not 1 <= n_ <= len(out.columns):
+                        raise ValueError(
+                            f"ORDER BY position {n_} is out of range "
+                            f"({len(out.columns)} output columns)"
+                        )
+                    keys.append(out.columns[n_ - 1])
+                elif re.fullmatch(r"\w+", text) and text in out.columns:
+                    keys.append(text)
+                else:
                     raise ValueError(
-                        f"ORDER BY after UNION supports output column "
-                        f"names only; {text!r} is not one of {out.columns}"
+                        f"ORDER BY after a set operation supports "
+                        f"output column names or ordinals; {text!r} is "
+                        f"not one of {out.columns}"
                     )
-                keys.append(text)
                 ascs.append(asc)
             out = out.orderBy(*keys, ascending=ascs)
         if limit_n is not None:
@@ -628,12 +638,14 @@ class TPUSession:
                     "supported; aggregate in a derived table first "
                     "(FROM (SELECT ... GROUP BY ...) t)"
                 )
-            out = self._sql_aggregate(
+            out, select_names = self._sql_aggregate(
                 out, proj_raw, group, having=m.group("having"),
                 qualifiers=quals, columns=out.columns,
             )
             if order_items:
-                out = self._order_aggregated(out, order_items, quals)
+                out = self._order_aggregated(
+                    out, order_items, quals, select_names
+                )
         else:
             out = self._project_and_order(
                 out, m.group("proj").strip(), proj_raw, order_items,
@@ -644,11 +656,13 @@ class TPUSession:
         return out
 
     def _order_aggregated(
-        self, out: DataFrame, order_items: List[tuple], quals
+        self, out: DataFrame, order_items: List[tuple], quals,
+        select_names: List[str],
     ) -> DataFrame:
         """ORDER BY over an aggregation's output: plain output columns,
-        or expressions over them (``ORDER BY cnt / total``); direct
-        aggregate calls must be aliased in the select list instead.
+        select-list ordinals (``ORDER BY 2 DESC``), or expressions over
+        them (``ORDER BY cnt / total``); direct aggregate calls must be
+        aliased in the select list instead.
 
         The non-aggregate analog lives in :meth:`_project_and_order`;
         the two attach hidden sort columns at different pipeline stages
@@ -659,7 +673,15 @@ class TPUSession:
         ascs: List[bool] = []
         hidden: List[str] = []
         for text, asc in order_items:
-            if re.fullmatch(r"\w+", text):
+            if re.fullmatch(r"\d+", text):
+                n_ = int(text)
+                if not 1 <= n_ <= len(select_names):
+                    raise ValueError(
+                        f"ORDER BY position {n_} is out of range "
+                        f"(select list has {len(select_names)} items)"
+                    )
+                keys.append(select_names[n_ - 1])
+            elif re.fullmatch(r"\w+", text):
                 if text not in out.columns:
                     raise ValueError(
                         f"ORDER BY {text!r}: not an output column of "
@@ -745,11 +767,20 @@ class TPUSession:
         ascs: List[bool] = []
         hidden: List[str] = []
         for text, asc in order_items:
-            # SQL resolution: select list first (aliases win over
+            # SQL resolution: ordinals first (ORDER BY 2 = second
+            # select item), then the select list (aliases win over
             # same-named input columns), else an expression over the
             # input — a plain column, t.col, score + 1, ABS(score) —
             # projected as a hidden column and dropped after the sort
-            if text in post_names:
+            if re.fullmatch(r"\d+", text):
+                n_ = int(text)
+                if not 1 <= n_ <= len(post_names):
+                    raise ValueError(
+                        f"ORDER BY position {n_} is out of range "
+                        f"(select list has {len(post_names)} items)"
+                    )
+                keys.append(post_names[n_ - 1])
+            elif text in post_names:
                 keys.append(text)
             else:
                 if re.fullmatch(r"\w+", text) and text not in out.columns:
@@ -1057,6 +1088,21 @@ class TPUSession:
                 raw_key = raw_key.strip()
                 if not raw_key:
                     continue
+                if re.fullmatch(r"\d+", raw_key):
+                    # select-list ordinal (GROUP BY 1)
+                    n_ = int(raw_key)
+                    if not 1 <= n_ <= len(proj_raw):
+                        raise ValueError(
+                            f"GROUP BY position {n_} is out of range "
+                            f"(select list has {len(proj_raw)} items)"
+                        )
+                    target, _ = self._strip_alias(proj_raw[n_ - 1])
+                    if self._AGG_RE.match(target):
+                        raise ValueError(
+                            f"GROUP BY position {n_} refers to an "
+                            "aggregate"
+                        )
+                    raw_key = target
                 if (
                     re.fullmatch(r"\w+", raw_key)
                     and raw_key not in df.columns
@@ -1078,6 +1124,8 @@ class TPUSession:
         pairs = []  # (col, fn, OUTPUT name) for GroupedData._aggregate
         renames = []  # (key, alias) — keys only; aggregates alias directly
         passthrough = []
+        select_names: List[str] = []  # output name per select item, in
+        # SELECT order (what ORDER BY ordinals resolve against)
         tmp_idx = [0]
         for raw in proj_raw:
             expr, alias = self._strip_alias(raw)
@@ -1098,6 +1146,7 @@ class TPUSession:
                     columns,
                 )
                 pairs.append(pair)
+                select_names.append(label)
             else:
                 # a projection matches a group key by its RESOLVED name
                 # (bare column, de-qualified t.col, or normalized
@@ -1124,6 +1173,7 @@ class TPUSession:
                         # output column named by the SELECT spelling
                         renames.append((match, pname))
                     passthrough.append(match)
+                    select_names.append(alias or pname)
                 else:
                     raise ValueError(
                         f"Projection {raw!r} must be a GROUP BY key or "
@@ -1169,7 +1219,7 @@ class TPUSession:
                 out = out.drop(k)
         for key, alias in renames:
             out = out.withColumnRenamed(key, alias)
-        return out
+        return out, select_names
 
     def _rewrite_having_aggs(
         self, text: str, df: DataFrame, tmp_idx: List[int],
@@ -1718,7 +1768,59 @@ class _PredicateParser:
             1, None,
             lambda *vs: next((v for v in vs if v is not None), None),
         ),
+        "concat": (
+            1, None,
+            lambda *vs: None if any(v is None for v in vs)
+            else "".join(str(v) for v in vs),
+        ),
+        "substring": (2, 3, "_substring"),
+        "substr": (2, 3, "_substring"),
+        "trim": (1, 1, lambda a: None if a is None else a.strip()),
+        "ltrim": (1, 1, lambda a: None if a is None else a.lstrip()),
+        "rtrim": (1, 1, lambda a: None if a is None else a.rstrip()),
+        "replace": (
+            3, 3,
+            # empty search string: Spark returns the input unchanged
+            # (Python's str.replace would interleave the replacement)
+            lambda s, find, repl: None
+            if s is None or find is None or repl is None
+            else (s if find == "" else s.replace(find, repl)),
+        ),
+        # INSTR: 1-based position of the first occurrence, 0 when absent
+        "instr": (
+            2, 2,
+            lambda s, sub: None if s is None or sub is None
+            else s.find(sub) + 1,
+        ),
+        "split": (2, 2, "_split_regex"),
     }
+
+    @staticmethod
+    def _substring(s, pos, ln=None):
+        # SQL 1-based; Spark: pos 0 behaves like 1, negative counts
+        # from the end; NULL in any arg -> NULL.  The length window is
+        # applied BEFORE clamping (Spark's substringSQL): a negative
+        # start beyond the string's head consumes length "before" the
+        # string, so SUBSTRING('abc', -5, 3) is 'a', not 'abc'.
+        if s is None or pos is None:
+            return None
+        pos = int(pos)
+        if pos > 0:
+            start = pos - 1
+        elif pos == 0:
+            start = 0
+        else:
+            start = len(s) + pos  # may stay negative: virtual pre-start
+        if ln is None:
+            return s[max(start, 0):]
+        end = start + int(ln)
+        return s[max(start, 0):max(end, 0)]
+
+    @staticmethod
+    def _split_regex(s, pattern):
+        if s is None or pattern is None:
+            return None
+        return re.split(pattern, s)
 
     @staticmethod
     def _round_half_up(a, d=0):

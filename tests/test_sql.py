@@ -2164,3 +2164,100 @@ class TestPivot:
         )
         with pytest.raises(ValueError, match="duplicate"):
             df2.groupBy("k").pivot("v").agg({"x": "sum"})
+
+
+class TestOrdinalsAndStringBuiltins:
+    """ORDER BY / GROUP BY select-list ordinals + the string builtin
+    batch (CONCAT/SUBSTRING/TRIM/REPLACE/INSTR/SPLIT)."""
+
+    @pytest.fixture()
+    def view(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("b", 2), ("a", 1), ("c", 3), ("a", 4)], ["k", "n"]
+        ).createOrReplaceTempView("ord_t")
+
+    def test_order_by_ordinal(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT k, n FROM ord_t ORDER BY 2 DESC"
+        ).collect()
+        assert [r.n for r in rows] == [4, 3, 2, 1]
+
+    def test_order_by_ordinal_out_of_range(self, tpu_session, view):
+        with pytest.raises(ValueError, match="out of range"):
+            tpu_session.sql("SELECT k FROM ord_t ORDER BY 3")
+
+    def test_group_by_ordinal(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT k, COUNT(*) AS c FROM ord_t GROUP BY 1 ORDER BY 1"
+        ).collect()
+        assert [(r.k, r.c) for r in rows] == [("a", 2), ("b", 1), ("c", 1)]
+
+    def test_group_by_ordinal_of_aggregate_errors(self, tpu_session, view):
+        with pytest.raises(ValueError, match="aggregate"):
+            tpu_session.sql(
+                "SELECT COUNT(*) AS c, k FROM ord_t GROUP BY 1"
+            )
+
+    def test_agg_order_by_ordinal_follows_select_order(
+        self, tpu_session, view
+    ):
+        # ordinal 1 is the aggregate (SELECT order), NOT the group key
+        rows = tpu_session.sql(
+            "SELECT SUM(n) AS sn, k FROM ord_t GROUP BY k ORDER BY 1 DESC"
+        ).collect()
+        assert [r.k for r in rows] == ["a", "c", "b"]
+
+    def test_union_order_by_ordinal(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT k FROM ord_t UNION SELECT k FROM ord_t "
+            "ORDER BY 1 DESC LIMIT 2"
+        ).collect()
+        assert [r.k for r in rows] == ["c", "b"]
+
+    def test_string_builtins(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("  hello  ", "path/to/img.png")], ["s", "p"]
+        ).createOrReplaceTempView("str_t")
+        row = tpu_session.sql(
+            "SELECT TRIM(s) AS t, LTRIM(s) AS lt, RTRIM(s) AS rt, "
+            "CONCAT(TRIM(s), '!', 42) AS c, SUBSTRING(p, 1, 4) AS sub, "
+            "SUBSTR(p, -7) AS tail7, REPLACE(p, '/', ':') AS rp, "
+            "INSTR(p, 'img') AS ix, SPLIT(p, '/') AS parts FROM str_t"
+        ).collect()[0]
+        assert row.t == "hello"
+        assert row.lt == "hello  " and row.rt == "  hello"
+        assert row.c == "hello!42"
+        assert row.sub == "path" and row.tail7 == "img.png"
+        assert row.rp == "path:to:img.png"
+        assert row.ix == 9
+        assert row.parts == ["path", "to", "img.png"]
+
+    def test_string_builtins_null_propagation(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(None,)], ["s"]
+        ).createOrReplaceTempView("str_null")
+        row = tpu_session.sql(
+            "SELECT CONCAT(s, 'x') AS c, TRIM(s) AS t, "
+            "SPLIT(s, ',') AS sp FROM str_null"
+        ).collect()[0]
+        assert row.c is None and row.t is None and row.sp is None
+
+    def test_substring_negative_start_window(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("abc",)], ["s"]
+        ).createOrReplaceTempView("sub_t")
+        row = tpu_session.sql(
+            "SELECT SUBSTRING(s, -5, 3) AS a, SUBSTRING(s, -2) AS b, "
+            "SUBSTRING(s, -2, 1) AS c FROM sub_t"
+        ).collect()[0]
+        # Spark: the length window applies before clamping
+        assert row.a == "a" and row.b == "bc" and row.c == "b"
+
+    def test_replace_empty_search_is_identity(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("b",)], ["s"]
+        ).createOrReplaceTempView("rep_t")
+        row = tpu_session.sql(
+            "SELECT REPLACE(s, '', 'x') AS r FROM rep_t"
+        ).collect()[0]
+        assert row.r == "b"  # Spark: empty search leaves input unchanged
