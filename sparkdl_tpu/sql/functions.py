@@ -459,6 +459,40 @@ def desc(name: str) -> Column:
     return col(name).desc()
 
 
+def _substring_sql(s, pos, ln=None):
+    """Spark ``substringSQL``: 1-based, pos 0 behaves like 1, negative
+    counts from the end, and the length window applies BEFORE clamping
+    (``SUBSTRING('abc', -5, 3)`` is ``'a'``).  ONE implementation shared
+    by the SQL builtin and :func:`substring` so the two surfaces cannot
+    drift."""
+    if s is None or pos is None:
+        return None
+    pos = int(pos)
+    if pos > 0:
+        start = pos - 1
+    elif pos == 0:
+        start = 0
+    else:
+        start = len(s) + pos  # may stay negative: virtual pre-start
+    # no max(): this module shadows the builtin with the aggregate marker
+    lo = start if start > 0 else 0
+    if ln is None:
+        return s[lo:]
+    end = start + int(ln)
+    return s[lo:end if end > 0 else 0]
+
+
+def _concat_vals(*vs):
+    return (
+        None if any(v is None for v in vs)
+        else "".join(str(v) for v in vs)
+    )
+
+
+def _coalesce_vals(*vs):
+    return next((v for v in vs if v is not None), None)
+
+
 def _scalar_fn(name, fn, *cols_in) -> Column:
     cols_ = [
         c if isinstance(c, Column) else col(c) for c in cols_in
@@ -505,29 +539,19 @@ def length(col_or_name) -> Column:
 
 
 def concat(*cols_in) -> Column:
-    return _scalar_fn(
-        "concat",
-        lambda *vs: None if any(v is None for v in vs)
-        else "".join(str(v) for v in vs),
-        *cols_in,
-    )
+    return _scalar_fn("concat", _concat_vals, *cols_in)
 
 
 def substring(col_or_name, pos: int, length_: int) -> Column:
-    # SQL 1-based positions, as pyspark
     return _scalar_fn(
         "substring",
-        lambda a: None if a is None else a[pos - 1:pos - 1 + length_],
+        lambda a: _substring_sql(a, pos, length_),
         col_or_name,
     )
 
 
 def coalesce(*cols_in) -> Column:
-    return _scalar_fn(
-        "coalesce",
-        lambda *vs: next((v for v in vs if v is not None), None),
-        *cols_in,
-    )
+    return _scalar_fn("coalesce", _coalesce_vals, *cols_in)
 
 
 def isnull(col_or_name) -> Column:

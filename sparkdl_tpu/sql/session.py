@@ -1764,25 +1764,19 @@ class _PredicateParser:
         "upper": (1, 1, lambda a: None if a is None else a.upper()),
         "lower": (1, 1, lambda a: None if a is None else a.lower()),
         "length": (1, 1, lambda a: None if a is None else len(a)),
-        "coalesce": (
-            1, None,
-            lambda *vs: next((v for v in vs if v is not None), None),
-        ),
-        "concat": (
-            1, None,
-            lambda *vs: None if any(v is None for v in vs)
-            else "".join(str(v) for v in vs),
-        ),
-        "substring": (2, 3, "_substring"),
-        "substr": (2, 3, "_substring"),
+        "coalesce": (1, None, "functions._coalesce_vals"),
+        "concat": (1, None, "functions._concat_vals"),
+        "substring": (2, 3, "functions._substring_sql"),
+        "substr": (2, 3, "functions._substring_sql"),
         "trim": (1, 1, lambda a: None if a is None else a.strip()),
         "ltrim": (1, 1, lambda a: None if a is None else a.lstrip()),
         "rtrim": (1, 1, lambda a: None if a is None else a.rstrip()),
         "replace": (
-            3, 3,
-            # empty search string: Spark returns the input unchanged
-            # (Python's str.replace would interleave the replacement)
-            lambda s, find, repl: None
+            2, 3,
+            # two-arg form deletes occurrences (Spark); empty search
+            # string returns the input unchanged (Python's str.replace
+            # would interleave the replacement)
+            lambda s, find, repl="": None
             if s is None or find is None or repl is None
             else (s if find == "" else s.replace(find, repl)),
         ),
@@ -1794,27 +1788,6 @@ class _PredicateParser:
         ),
         "split": (2, 2, "_split_regex"),
     }
-
-    @staticmethod
-    def _substring(s, pos, ln=None):
-        # SQL 1-based; Spark: pos 0 behaves like 1, negative counts
-        # from the end; NULL in any arg -> NULL.  The length window is
-        # applied BEFORE clamping (Spark's substringSQL): a negative
-        # start beyond the string's head consumes length "before" the
-        # string, so SUBSTRING('abc', -5, 3) is 'a', not 'abc'.
-        if s is None or pos is None:
-            return None
-        pos = int(pos)
-        if pos > 0:
-            start = pos - 1
-        elif pos == 0:
-            start = 0
-        else:
-            start = len(s) + pos  # may stay negative: virtual pre-start
-        if ln is None:
-            return s[max(start, 0):]
-        end = start + int(ln)
-        return s[max(start, 0):max(end, 0)]
 
     @staticmethod
     def _split_regex(s, pattern):
@@ -1859,7 +1832,14 @@ class _PredicateParser:
             return registered(*args)
         lo, hi, fn = builtin
         if isinstance(fn, str):
-            fn = getattr(self, fn)
+            if fn.startswith("functions."):
+                # shared with the pyspark-functions surface (one
+                # implementation; the two APIs cannot drift)
+                import sparkdl_tpu.sql.functions as _F
+
+                fn = getattr(_F, fn.split(".", 1)[1])
+            else:
+                fn = getattr(self, fn)
         if len(args) < lo or (hi is not None and len(args) > hi):
             raise ValueError(
                 f"{name.upper()} takes "

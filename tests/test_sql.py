@@ -2261,3 +2261,22 @@ class TestOrdinalsAndStringBuiltins:
             "SELECT REPLACE(s, '', 'x') AS r FROM rep_t"
         ).collect()[0]
         assert row.r == "b"  # Spark: empty search leaves input unchanged
+
+    def test_replace_two_arg_deletes(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("path/to/img",)], ["p"]
+        ).createOrReplaceTempView("rep2_t")
+        row = tpu_session.sql(
+            "SELECT REPLACE(p, '/') AS r FROM rep2_t"
+        ).collect()[0]
+        assert row.r == "pathtoimg"
+
+    def test_f_substring_matches_sql_semantics(self, tpu_session):
+        import sparkdl_tpu.sql.functions as F
+
+        df = tpu_session.createDataFrame([("abc",)], ["s"])
+        out = df.select(
+            F.substring("s", -5, 3).alias("a"),
+            F.substring("s", 2, 2).alias("b"),
+        ).collect()[0]
+        assert out.a == "a" and out.b == "bc"
