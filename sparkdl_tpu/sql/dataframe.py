@@ -208,6 +208,22 @@ class DataFrame:
                     exprs.append(_col(c))
             else:
                 exprs.append(c)
+        if any(hasattr(e, "_window") for e in exprs):
+            # window-bound expressions (F.row_number().over(w)) need the
+            # whole-frame evaluators: materialize each as a hidden
+            # column first, then project
+            base = self
+            final_exprs: List[Column] = []
+            for j, e in enumerate(exprs):
+                if hasattr(e, "_window"):
+                    h = f"__winsel_{j}"
+                    while h in base.columns:
+                        h = "_" + h
+                    base = base._apply_window_marker(h, e)
+                    final_exprs.append(_col(h).alias(e._name))
+                else:
+                    final_exprs.append(e)
+            return base.select(*final_exprs)
         out_parts: List[Partition] = []
         for part in self._partitions:
             n = _partition_nrows(part)
@@ -242,6 +258,17 @@ class DataFrame:
             from sparkdl_tpu.sql.functions import udf as _udf
 
             value = _udf(value)(*input_cols)
+        if isinstance(value, Column) and hasattr(value, "_window"):
+            if name not in self.columns:
+                return self._apply_window_marker(name, value)
+            # replacing a column the window itself may reference (as
+            # value/partition/order key): evaluate against the
+            # PRE-replacement frame into a hidden name, then swap
+            h = f"__wincol_{name}"
+            while h in self.columns:
+                h = "_" + h
+            out = self._apply_window_marker(h, value)
+            return out.drop(name).withColumnRenamed(h, name)
         expr: Column = value
         out_parts: List[Partition] = []
         for part in self._partitions:
@@ -665,6 +692,35 @@ class DataFrame:
 
     sort = orderBy
 
+    def _apply_window_marker(self, name: str, expr: Column) -> "DataFrame":
+        """Dispatch a ``Column.over(WindowSpec)`` expression to the
+        engine's window evaluators, appending column ``name``."""
+        desc, window = expr._window
+        part_cols = list(window._partition_cols)
+        ord_cols = [c for c, _ in window._order]
+        ascs = [a for _, a in window._order]
+        kind = desc[0]
+        if kind == "rank":
+            if not ord_cols:
+                raise ValueError(
+                    f"{desc[1]}() requires a window with orderBy"
+                )
+            return self._with_rank_column(
+                name, desc[1], part_cols, ord_cols, ascs
+            )
+        if kind == "shift":
+            direction, vcol, offset, default = desc[1:]
+            if not ord_cols:
+                raise ValueError("lag/lead require a window with orderBy")
+            return self._with_window_shift_column(
+                name, direction, vcol, offset, default, part_cols,
+                ord_cols, ascs,
+            )
+        fn_key, vcol = desc[1], desc[2]
+        return self._with_window_agg_column(
+            name, fn_key, vcol, part_cols, ord_cols, ascs
+        )
+
     def _window_groups(
         self,
         partition_cols: Sequence[str],
@@ -694,6 +750,16 @@ class DataFrame:
             flat[c] = vals
         total = sum(sizes)
 
+        # several windows over one spec (the top-N idiom: rank + lag +
+        # lead on the same PARTITION BY/ORDER BY) share the bucketing
+        # and sort; the memo rides along layout-preserving scatters
+        memo_key = (
+            tuple(partition_cols), tuple(order_cols), tuple(ascending)
+        )
+        memo = getattr(self, "_win_memo", None)
+        if memo is not None and memo_key in memo:
+            return flat, memo[memo_key], sizes
+
         groups: Dict[tuple, List[int]] = {}
         gorder: List[tuple] = []
         for i in range(total):
@@ -721,7 +787,12 @@ class DataFrame:
                     ),
                     reverse=not a,
                 )
-        return flat, [groups[k] for k in gorder], sizes
+        ordered = [groups[k] for k in gorder]
+        if memo is None:
+            memo = {}
+            self._win_memo = memo
+        memo[memo_key] = ordered
+        return flat, ordered, sizes
 
     def _scatter_window_column(
         self, name: str, values: List[Any], sizes: List[int], dtype
@@ -744,7 +815,11 @@ class DataFrame:
             [StructField(f.name, f.dataType) for f in self._schema]
         )
         schema.add(name, dtype)
-        return self._with_partitions(out_parts, schema)
+        out = self._with_partitions(out_parts, schema)
+        # scatter preserves row layout, so the spec memo stays valid
+        if getattr(self, "_win_memo", None):
+            out._win_memo = self._win_memo
+        return out
 
     def _with_rank_column(
         self,

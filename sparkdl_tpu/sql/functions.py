@@ -39,9 +39,12 @@ class Column:
 
     def alias(self, name: str) -> "Column":
         out = Column(self._eval, name)
-        # aggregate/sort markers survive aliasing (F.avg("x").alias("m")
-        # must still aggregate; F.desc("x") has no alias use but be safe)
-        for attr in ("_agg", "_sort_asc"):
+        # aggregate/sort/window markers survive aliasing
+        # (F.avg("x").alias("m") must still aggregate;
+        # F.rank().over(w).alias("rk") must still window) —
+        # _when_branches deliberately does NOT survive: .alias() seals
+        # a when/otherwise chain
+        for attr in ("_agg", "_sort_asc", "_window", "_rank_fn", "_shift"):
             if hasattr(self, attr):
                 setattr(out, attr, getattr(self, attr))
         return out
@@ -309,6 +312,43 @@ class Column:
         return _case_column(branches, value if isinstance(value, Column)
                             else Column._literal(value))
 
+    def over(self, window: "WindowSpec") -> "Column":
+        """Bind a ranking/aggregate/shift function to a window
+        (pyspark ``F.row_number().over(Window.partitionBy(...)
+        .orderBy(...))``); evaluated by the DataFrame window engine in
+        ``select``/``withColumn``."""
+        if not isinstance(window, WindowSpec):
+            raise TypeError(
+                f"over() takes a WindowSpec (build one with "
+                f"Window.partitionBy/orderBy), got {type(window).__name__}"
+            )
+        rank_fn = getattr(self, "_rank_fn", None)
+        shift = getattr(self, "_shift", None)
+        agg = getattr(self, "_agg", None)
+        if rank_fn is not None:
+            desc = ("rank", rank_fn)
+        elif shift is not None:
+            desc = ("shift", *shift)
+        elif agg is not None:
+            col_name, fn_key = agg
+            desc = ("agg", fn_key, None if col_name == "*" else col_name)
+        else:
+            raise ValueError(
+                f"{self._name!r} is not a window function; use "
+                "row_number/rank/dense_rank/lag/lead or an aggregate "
+                "(sum/avg/count/...)"
+            )
+
+        def ev(cols, n):
+            raise ValueError(
+                f"window expression {self._name!r} can only be used in "
+                "select()/withColumn(), not inside another expression"
+            )
+
+        out = Column(ev, f"{self._name} OVER ({window._describe()})")
+        out._window = (desc, window)
+        return out
+
     def __repr__(self):
         return f"Column<{self._name}>"
 
@@ -449,6 +489,102 @@ def collect_list(col_or_name) -> Column:
 
 def collect_set(col_or_name) -> Column:
     return _agg_column("collect_set", col_or_name)
+
+
+class WindowSpec:
+    """Immutable PARTITION BY / ORDER BY specification (the pyspark
+    ``Window`` builder's product).  No explicit frame support: the frame
+    is Spark's default — whole partition without ORDER BY, RANGE
+    UNBOUNDED PRECEDING..CURRENT ROW with it."""
+
+    def __init__(self, partition_cols=(), order=()):
+        self._partition_cols = tuple(partition_cols)
+        self._order = tuple(order)  # (column_name, ascending)
+
+    def partitionBy(self, *cols) -> "WindowSpec":
+        names = [c if isinstance(c, str) else c._name for c in cols]
+        return WindowSpec(self._partition_cols + tuple(names), self._order)
+
+    def orderBy(self, *cols) -> "WindowSpec":
+        order = []
+        for c in cols:
+            if isinstance(c, str):
+                order.append((c, True))
+            else:
+                order.append((c._name, getattr(c, "_sort_asc", True)))
+        return WindowSpec(self._partition_cols, self._order + tuple(order))
+
+    def _describe(self) -> str:
+        parts = []
+        if self._partition_cols:
+            parts.append(
+                "PARTITION BY " + ", ".join(self._partition_cols)
+            )
+        if self._order:
+            parts.append(
+                "ORDER BY " + ", ".join(
+                    f"{c}{'' if a else ' DESC'}" for c, a in self._order
+                )
+            )
+        return " ".join(parts)
+
+
+class Window:
+    """pyspark ``Window`` entry points: ``Window.partitionBy("k")
+    .orderBy(F.desc("score"))``."""
+
+    @staticmethod
+    def partitionBy(*cols) -> WindowSpec:
+        return WindowSpec().partitionBy(*cols)
+
+    @staticmethod
+    def orderBy(*cols) -> WindowSpec:
+        return WindowSpec().orderBy(*cols)
+
+
+def _rank_column(fn_key: str) -> Column:
+    def ev(cols, n):
+        raise ValueError(
+            f"{fn_key}() must be bound to a window with .over(...)"
+        )
+
+    out = Column(ev, f"{fn_key}()")
+    out._rank_fn = fn_key
+    return out
+
+
+def row_number() -> Column:
+    return _rank_column("row_number")
+
+
+def rank() -> Column:
+    return _rank_column("rank")
+
+
+def dense_rank() -> Column:
+    return _rank_column("dense_rank")
+
+
+def _shift_column(direction: int, col_or_name, offset: int, default
+                  ) -> Column:
+    name = col_or_name if isinstance(col_or_name, str) else col_or_name._name
+    fn = "lag" if direction < 0 else "lead"
+    out = Column(
+        lambda cols, n: (_ for _ in ()).throw(
+            ValueError(f"{fn}() must be bound to a window with .over(...)")
+        ),
+        f"{fn}({name})",
+    )
+    out._shift = (direction, name, int(offset), default)
+    return out
+
+
+def lag(col_or_name, offset: int = 1, default=None) -> Column:
+    return _shift_column(-1, col_or_name, offset, default)
+
+
+def lead(col_or_name, offset: int = 1, default=None) -> Column:
+    return _shift_column(1, col_or_name, offset, default)
 
 
 def asc(name: str) -> Column:

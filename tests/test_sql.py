@@ -2280,3 +2280,137 @@ class TestOrdinalsAndStringBuiltins:
             F.substring("s", 2, 2).alias("b"),
         ).collect()[0]
         assert out.a == "a" and out.b == "bc"
+
+
+class TestWindowSpecAPI:
+    """pyspark Window/over() DataFrame API — the programmatic twin of
+    the SQL OVER clause."""
+
+    @pytest.fixture()
+    def wdf(self, tpu_session):
+        return tpu_session.createDataFrame(
+            [("cat", "a", 0.9), ("cat", "b", 0.7), ("dog", "c", 0.6),
+             ("dog", "d", 0.95)],
+            ["label", "img", "score"], numPartitions=2,
+        )
+
+    def test_row_number_over(self, wdf):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import Window, col
+
+        w = Window.partitionBy("label").orderBy(F.desc("score"))
+        r = wdf.withColumn("rn", F.row_number().over(w))
+        assert {x.img: x.rn for x in r.collect()} == {
+            "a": 1, "b": 2, "c": 2, "d": 1,
+        }
+        top1 = r.filter(col("rn") == 1)
+        assert sorted((x.label, x.img) for x in top1.collect()) == [
+            ("cat", "a"), ("dog", "d"),
+        ]
+        assert r.getNumPartitions() == 2
+
+    def test_mixed_window_select(self, wdf):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import Window
+
+        w = Window.partitionBy("label").orderBy(F.desc("score"))
+        sel = wdf.select(
+            "img",
+            F.rank().over(w).alias("rk"),
+            F.sum("score").over(Window.partitionBy("label")).alias("tot"),
+            F.lag("score").over(w).alias("prev"),
+            F.lead("score", 1, -1.0).over(w).alias("nxt"),
+        )
+        got = {x.img: (x.rk, round(x.tot, 2), x.prev, x.nxt)
+               for x in sel.collect()}
+        assert got == {
+            "a": (1, 1.6, None, 0.7), "b": (2, 1.6, 0.9, -1.0),
+            "c": (2, 1.55, 0.95, -1.0), "d": (1, 1.55, None, 0.6),
+        }
+
+    def test_running_aggregate_over(self, wdf):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import Window
+
+        w = Window.partitionBy("label").orderBy("score")
+        out = wdf.withColumn("run", F.sum("score").over(w))
+        got = {x.img: round(x.run, 2) for x in out.collect()}
+        assert got == {"a": 1.6, "b": 0.7, "c": 0.6, "d": 1.55}
+
+    def test_errors(self, wdf):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import Window, col
+
+        with pytest.raises(TypeError, match="WindowSpec"):
+            F.row_number().over("nope")
+        with pytest.raises(ValueError, match="orderBy"):
+            wdf.select(F.row_number().over(Window.partitionBy("label")))
+        with pytest.raises(ValueError, match="not a window function"):
+            col("score").over(Window.partitionBy("label"))
+        with pytest.raises(ValueError, match="over"):
+            wdf.select(F.row_number())  # unbound rank fn
+
+    def test_window_replace_existing_column(self, wdf):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import Window
+
+        w = Window.orderBy("score")
+        once = wdf.withColumn("rn", F.row_number().over(w))
+        twice = once.withColumn("rn", F.row_number().over(
+            Window.orderBy(F.desc("score"))
+        ))
+        a = {x.img: x.rn for x in once.collect()}
+        b = {x.img: x.rn for x in twice.collect()}
+        assert a["d"] == 4 and b["d"] == 1  # replaced, not duplicated
+        assert twice.columns.count("rn") == 1
+
+    def test_window_replacing_referenced_column(self, wdf):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import Window
+
+        # replace 'score' with a window computed FROM 'score'
+        out = wdf.withColumn(
+            "score", F.sum("score").over(Window.partitionBy("label"))
+        )
+        got = {x.img: round(x.score, 2) for x in out.collect()}
+        assert got == {"a": 1.6, "b": 1.6, "c": 1.55, "d": 1.55}
+        assert out.columns.count("score") == 1
+
+    def test_shared_spec_single_sort(self, wdf, monkeypatch):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql import dataframe as df_mod
+        from sparkdl_tpu.sql.functions import Window
+
+        w = Window.partitionBy("label").orderBy(F.desc("score"))
+        sorts = {"n": 0}
+        orig = list.sort
+
+        def counting_sort(self, **kw):
+            sorts["n"] += 1
+            return orig(self, **kw)
+
+        monkeypatch.setattr(
+            df_mod.DataFrame, "_window_groups",
+            _counting_groups(df_mod.DataFrame._window_groups, sorts),
+        )
+        out = wdf.select(
+            "img",
+            F.rank().over(w).alias("rk"),
+            F.lag("score").over(w).alias("prev"),
+            F.lead("score").over(w).alias("nxt"),
+        )
+        assert out.count() == 4
+        # 3 windows over ONE spec: bucketing+sort computed once, memoized
+        assert sorts["n"] == 1
+
+
+def _counting_groups(orig, counter):
+    def wrapped(self, partition_cols, order_cols, ascending,
+                extra_cols=()):
+        memo = getattr(self, "_win_memo", None)
+        key = (tuple(partition_cols), tuple(order_cols), tuple(ascending))
+        if memo is None or key not in memo:
+            counter["n"] += 1
+        return orig(self, partition_cols, order_cols, ascending,
+                    extra_cols=extra_cols)
+    return wrapped
