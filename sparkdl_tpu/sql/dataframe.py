@@ -706,7 +706,8 @@ class DataFrame:
                     f"{desc[1]}() requires a window with orderBy"
                 )
             return self._with_rank_column(
-                name, desc[1], part_cols, ord_cols, ascs
+                name, desc[1], part_cols, ord_cols, ascs,
+                n_buckets=desc[2],
             )
         if kind == "shift":
             direction, vcol, offset, default = desc[1:]
@@ -828,24 +829,51 @@ class DataFrame:
         partition_cols: Sequence[str],
         order_cols: Sequence[str],
         ascending: Sequence[bool],
+        n_buckets: Optional[int] = None,
     ) -> "DataFrame":
-        """Append an integer ranking column — the window-function
-        evaluator behind SQL ``ROW_NUMBER()/RANK()/DENSE_RANK() OVER
-        (PARTITION BY ... ORDER BY ...)`` (the Spark-SQL window idiom the
-        reference's serving analytics leaned on, SURVEY.md §1 L0 / §3.3).
+        """Append a ranking-family column — the window-function
+        evaluator behind SQL ``ROW_NUMBER()/RANK()/DENSE_RANK()/
+        PERCENT_RANK()/CUME_DIST()/NTILE(n) OVER (PARTITION BY ...
+        ORDER BY ...)`` (the Spark-SQL window idiom the reference's
+        serving analytics leaned on, SURVEY.md §1 L0 / §3.3).
 
-        Reads ONLY the partition/order key columns; rank values scatter
-        back into the existing partition layout.  Ties: ``rank`` repeats
-        with gaps, ``dense_rank`` repeats without gaps, ``row_number``
-        breaks ties by input order (deterministic — the engine has no
-        shuffle nondeterminism to hide)."""
-        if fn_key not in ("row_number", "rank", "dense_rank"):
+        Reads ONLY the partition/order key columns; values scatter back
+        into the existing partition layout.  Ties: ``rank`` repeats with
+        gaps, ``dense_rank`` without, ``row_number`` breaks ties by
+        input order (deterministic — the engine has no shuffle
+        nondeterminism to hide); ``percent_rank`` = (rank-1)/(n-1) (0
+        for a single row), ``cume_dist`` counts peers inclusively,
+        ``ntile`` deals row_number round-robin into ``n_buckets`` with
+        the first n%k buckets one larger, as Spark."""
+        if fn_key not in ("row_number", "rank", "dense_rank",
+                          "percent_rank", "cume_dist", "ntile"):
             raise ValueError(f"Unsupported window function {fn_key!r}")
+        if fn_key == "ntile" and (n_buckets is None or n_buckets < 1):
+            raise ValueError("NTILE requires a positive bucket count")
         flat, ordered_groups, sizes = self._window_groups(
             partition_cols, order_cols, ascending
         )
-        ranks = [0] * sum(sizes)
+        ranks: List[Any] = [0] * sum(sizes)
         for idx in ordered_groups:
+            n = len(idx)
+            if fn_key == "cume_dist":
+                # peer-run walk (same pattern as the running-aggregate
+                # frame): every member of a tie run shares the run's
+                # INCLUSIVE end position
+                j = 0
+                while j < n:
+                    key_j = tuple(flat[c][idx[j]] for c in order_cols)
+                    k_ = j
+                    while (
+                        k_ < n
+                        and tuple(flat[c][idx[k_]] for c in order_cols)
+                        == key_j
+                    ):
+                        k_ += 1
+                    for m in range(j, k_):
+                        ranks[idx[m]] = k_ / n
+                    j = k_
+                continue
             prev: "Any" = object()  # never equal to a real key tuple
             rank = dense = 0
             for pos, i in enumerate(idx, start=1):
@@ -854,15 +882,32 @@ class DataFrame:
                     dense += 1
                     rank = pos
                     prev = cur
-                ranks[i] = (
-                    pos if fn_key == "row_number"
-                    else rank if fn_key == "rank"
-                    else dense
-                )
+                if fn_key == "row_number":
+                    ranks[i] = pos
+                elif fn_key == "rank":
+                    ranks[i] = rank
+                elif fn_key == "dense_rank":
+                    ranks[i] = dense
+                elif fn_key == "percent_rank":
+                    ranks[i] = (rank - 1) / (n - 1) if n > 1 else 0.0
+                else:  # ntile
+                    base, extra = divmod(n, n_buckets)
+                    # first `extra` buckets hold base+1 rows; when
+                    # base == 0 every row lands in the first branch
+                    # (boundary == n), so the else-arm implies base > 0
+                    boundary = extra * (base + 1)
+                    if pos <= boundary:
+                        ranks[i] = (pos - 1) // (base + 1) + 1
+                    else:
+                        ranks[i] = extra + (pos - boundary - 1) // base + 1
 
-        from sparkdl_tpu.sql.types import LongType
+        from sparkdl_tpu.sql.types import DoubleType, LongType
 
-        return self._scatter_window_column(name, ranks, sizes, LongType())
+        dtype = (
+            DoubleType()
+            if fn_key in ("percent_rank", "cume_dist") else LongType()
+        )
+        return self._scatter_window_column(name, ranks, sizes, dtype)
 
     def _with_window_agg_column(
         self,
@@ -1563,6 +1608,22 @@ _AGG_SPECS: Dict[str, _AggSpec] = {
         lambda a: list(a.values()),
     ),
 }
+_AGG_SPECS["first"] = _AggSpec(
+    # first NON-NULL value in partition order (Spark's
+    # first(col, ignorenulls=True); nulls were pre-filtered)
+    lambda: (None, False),
+    lambda a, v: a if a[1] else (v, True),
+    lambda a, b: a if a[1] else b,
+    lambda a: a[0],
+)
+_AGG_SPECS["last"] = _AggSpec(
+    lambda: (None, False),
+    lambda a, v: (v, True),
+    lambda a, b: b if b[1] else a,
+    lambda a: a[0],
+)
+_AGG_SPECS["first_value"] = _AGG_SPECS["first"]
+_AGG_SPECS["last_value"] = _AGG_SPECS["last"]
 _AGG_SPECS["mean"] = _AGG_SPECS["avg"]
 
 
@@ -1592,7 +1653,8 @@ def _agg_result_type(fn_key: str, src: "Optional[DataType]") -> DataType:
         if isinstance(src, (FloatType, DoubleType)):
             return DoubleType()
         return src if src is not None else ObjectType()
-    if fn_key in ("min", "max"):
+    if fn_key in ("min", "max", "first", "last", "first_value",
+                  "last_value"):
         return src if src is not None else ObjectType()
     if fn_key in ("collect_list", "collect_set"):
         return ArrayType(src if src is not None else ObjectType())

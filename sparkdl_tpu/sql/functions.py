@@ -44,7 +44,8 @@ class Column:
         # F.rank().over(w).alias("rk") must still window) —
         # _when_branches deliberately does NOT survive: .alias() seals
         # a when/otherwise chain
-        for attr in ("_agg", "_sort_asc", "_window", "_rank_fn", "_shift"):
+        for attr in ("_agg", "_sort_asc", "_window", "_rank_fn",
+                     "_ntile_n", "_shift"):
             if hasattr(self, attr):
                 setattr(out, attr, getattr(self, attr))
         return out
@@ -326,7 +327,7 @@ class Column:
         shift = getattr(self, "_shift", None)
         agg = getattr(self, "_agg", None)
         if rank_fn is not None:
-            desc = ("rank", rank_fn)
+            desc = ("rank", rank_fn, getattr(self, "_ntile_n", None))
         elif shift is not None:
             desc = ("shift", *shift)
         elif agg is not None:
@@ -491,6 +492,16 @@ def collect_set(col_or_name) -> Column:
     return _agg_column("collect_set", col_or_name)
 
 
+def first(col_or_name) -> Column:
+    """First NON-NULL value in partition order (Spark's
+    ``first(col, ignorenulls=True)``)."""
+    return _agg_column("first", col_or_name)
+
+
+def last(col_or_name) -> Column:
+    return _agg_column("last", col_or_name)
+
+
 class WindowSpec:
     """Immutable PARTITION BY / ORDER BY specification (the pyspark
     ``Window`` builder's product).  No explicit frame support: the frame
@@ -563,6 +574,22 @@ def rank() -> Column:
 
 def dense_rank() -> Column:
     return _rank_column("dense_rank")
+
+
+def percent_rank() -> Column:
+    return _rank_column("percent_rank")
+
+
+def cume_dist() -> Column:
+    return _rank_column("cume_dist")
+
+
+def ntile(n: int) -> Column:
+    if not isinstance(n, int) or n < 1:
+        raise ValueError("ntile requires a positive integer bucket count")
+    out = _rank_column("ntile")
+    out._ntile_n = n
+    return out
 
 
 def _shift_column(direction: int, col_or_name, offset: int, default

@@ -282,6 +282,7 @@ class TPUSession:
     _AGG_FN_ALT = (
         r"count|sum|avg|mean|min|max|stddev_samp|stddev_pop|stddev"
         r"|var_samp|var_pop|variance|collect_list|collect_set"
+        r"|first_value|first|last_value|last"
     )
     _AGG_RE = re.compile(
         rf"^(?P<fn>{_AGG_FN_ALT})\s*\(\s*"
@@ -297,10 +298,11 @@ class TPUSession:
     #: aggregates (share-of-partition, running totals under Spark's
     #: default RANGE frame), and LAG/LEAD shifts
     _WINDOW_RE = re.compile(
-        r"^(?P<fn>ROW_NUMBER|RANK|DENSE_RANK|LAG|LEAD"
+        r"^(?P<fn>ROW_NUMBER|RANK|DENSE_RANK|PERCENT_RANK|CUME_DIST"
+        r"|NTILE|LAG|LEAD"
         r"|COUNT|SUM|AVG|MEAN|MIN|MAX"
         r"|STDDEV_SAMP|STDDEV_POP|STDDEV|VAR_SAMP|VAR_POP|VARIANCE"
-        r"|COLLECT_LIST|COLLECT_SET)"
+        r"|COLLECT_LIST|COLLECT_SET|FIRST_VALUE|FIRST|LAST_VALUE|LAST)"
         r"\s*\(\s*(?P<arg>.*?)\s*\)\s+OVER\s*\(\s*"
         r"(?:PARTITION\s+BY\s+(?P<part>.+?)\s*)?"
         r"(?:ORDER\s+BY\s+(?P<ord>.+?)\s*)?\)\s*$",
@@ -814,7 +816,10 @@ class TPUSession:
             out = out.drop(h)
         return out
 
-    _RANK_FNS = frozenset(("row_number", "rank", "dense_rank"))
+    _RANK_FNS = frozenset(
+        ("row_number", "rank", "dense_rank", "percent_rank",
+         "cume_dist", "ntile")
+    )
 
     def _apply_window(
         self, df: DataFrame, out_name: str, wm, quals
@@ -864,7 +869,15 @@ class TPUSession:
         ascs = [a for _, a in ords]
 
         if fn_key in self._RANK_FNS:
-            if arg:
+            n_buckets = None
+            if fn_key == "ntile":
+                if not re.fullmatch(r"\d+", arg or ""):
+                    raise ValueError(
+                        f"NTILE requires a literal positive bucket "
+                        f"count, got {arg!r}"
+                    )
+                n_buckets = int(arg)
+            elif arg:
                 raise ValueError(
                     f"{fn_key.upper()}() takes no argument"
                 )
@@ -873,7 +886,8 @@ class TPUSession:
                     f"{fn_key.upper()}() OVER requires ORDER BY"
                 )
             df = df._with_rank_column(
-                out_name, fn_key, part_cols, ord_cols, ascs
+                out_name, fn_key, part_cols, ord_cols, ascs,
+                n_buckets=n_buckets,
             )
         elif fn_key in ("lag", "lead"):
             if not ord_cols:
@@ -1300,7 +1314,9 @@ class TPUSession:
             # qualified simple column (t.score): output name is the bare
             # column, as in Spark
             expr = col(m_q.group(2))
-        elif re.fullmatch(r"\w+", text):
+        elif re.fullmatch(r"(?!\d)\w+", text):
+            # bare digits are literals (SELECT 1 — the EXISTS idiom),
+            # not column refs; they fall to the expression parser below
             expr = col(text)
         else:
             # full expression projection: arithmetic over columns,
@@ -1384,6 +1400,7 @@ class _PredicateParser:
             "stddev", "stddev_samp", "stddev_pop",
             "variance", "var_samp", "var_pop",
             "collect_list", "collect_set",
+            "first", "last", "first_value", "last_value",
         )
     )
 
@@ -1473,6 +1490,22 @@ class _PredicateParser:
         if self._accept_kw("NOT"):
             return ~self._not_expr()
         kind, val = self._peek()
+        if (
+            kind == "ident"
+            and val.upper() == "EXISTS"
+            and self._peek(1) == ("punct", "(")
+            and self._peek(2)[0] == "ident"
+            and self._peek(2)[1].upper() == "SELECT"
+        ):
+            # uncorrelated EXISTS: the subquery evaluates once to a
+            # constant truth value (Spark's uncorrelated-EXISTS plan
+            # does the same).  The three-token lookahead keeps a COLUMN
+            # named `exists` parseable (`WHERE exists > 1`).
+            from sparkdl_tpu.sql.functions import lit
+
+            self.i += 2  # consume EXISTS and '('
+            df = self._subquery_df()
+            return lit(df.count() > 0)
         if kind == "punct" and val == "(":
             # '(' opens either a parenthesized predicate or an arithmetic
             # group ("(a + b) * 2 > 4"): try the predicate read, and on
@@ -1544,14 +1577,13 @@ class _PredicateParser:
             return c != value
         return {"<": c < value, "<=": c <= value, ">": c > value, ">=": c >= value}[op]
 
-    def _in_subquery_values(self) -> list:
-        """Evaluate an uncorrelated ``IN (SELECT ...)`` subquery to its
-        value list (single output column required; NULLs kept — the
-        three-valued IN semantics live in :meth:`Column.isin`).  The
-        opening paren has been consumed; consumes through the close."""
+    def _subquery_df(self):
+        """Evaluate the subquery starting at the current token (its
+        opening paren already consumed) through the matching close;
+        returns the result DataFrame."""
         if self.session is None:
             raise ValueError(
-                f"IN (SELECT ...) requires a session: {self.text!r}"
+                f"subqueries require a session: {self.text!r}"
             )
         depth, j = 1, self.i
         while j < len(self.tokens):
@@ -1565,18 +1597,26 @@ class _PredicateParser:
             j += 1
         if depth:
             raise ValueError(
-                f"Unbalanced parentheses in IN (SELECT ...): {self.text!r}"
+                f"Unbalanced parentheses in subquery: {self.text!r}"
             )
         start = self._spans[self.i][0]
         end = self._spans[j][0]
         df = self.session.sql(self.text[start:end])
+        self.i = j + 1
+        return df
+
+    def _in_subquery_values(self) -> list:
+        """Evaluate an uncorrelated ``IN (SELECT ...)`` subquery to its
+        value list (single output column required; NULLs kept — the
+        three-valued IN semantics live in :meth:`Column.isin`).  The
+        opening paren has been consumed; consumes through the close."""
+        df = self._subquery_df()
         if len(df.columns) != 1:
             raise ValueError(
                 f"IN subquery must select exactly one column, got "
                 f"{df.columns}"
             )
         name = df.columns[0]
-        self.i = j + 1
         vals: list = []
         for part in df._partitions:
             vals.extend(part[name])

@@ -1416,8 +1416,8 @@ class TestWindowFunctions:
     def test_unsupported_window_fn_errors(self, tpu_session, scored):
         with pytest.raises(ValueError, match="window"):
             tpu_session.sql(
-                "SELECT NTILE(4) OVER (PARTITION BY label ORDER BY "
-                "score) FROM win_t"
+                "SELECT NTH_VALUE(score, 2) OVER (PARTITION BY label "
+                "ORDER BY score) FROM win_t"
             )
 
     def test_window_with_group_by_errors(self, tpu_session, scored):
@@ -2414,3 +2414,117 @@ def _counting_groups(orig, counter):
         return orig(self, partition_cols, order_cols, ascending,
                     extra_cols=extra_cols)
     return wrapped
+
+
+class TestRankFamilyAndExists:
+    """NTILE/PERCENT_RANK/CUME_DIST, FIRST/LAST aggregates, and
+    uncorrelated EXISTS."""
+
+    @pytest.fixture()
+    def view(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("a", i, float(i)) for i in range(1, 7)] + [("b", 9, 1.0)],
+            ["k", "i", "x"], numPartitions=2,
+        ).createOrReplaceTempView("rf_t")
+
+    def test_ntile_percent_rank_cume_dist(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT i, NTILE(3) OVER (PARTITION BY k ORDER BY i) AS b, "
+            "PERCENT_RANK() OVER (PARTITION BY k ORDER BY i) AS pr, "
+            "CUME_DIST() OVER (PARTITION BY k ORDER BY i) AS cd "
+            "FROM rf_t WHERE k = 'a'"
+        ).collect()
+        assert [r.b for r in rows] == [1, 1, 2, 2, 3, 3]
+        assert [round(r.pr, 3) for r in rows] == [
+            0.0, 0.2, 0.4, 0.6, 0.8, 1.0,
+        ]
+        assert [round(r.cd, 3) for r in rows] == [
+            round(i / 6, 3) for i in range(1, 7)
+        ]
+
+    def test_ntile_uneven_and_single_row(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(i,) for i in range(1, 6)], ["i"]
+        ).createOrReplaceTempView("nt_t")
+        rows = tpu_session.sql(
+            "SELECT i, NTILE(3) OVER (ORDER BY i) AS b FROM nt_t"
+        ).collect()
+        # 5 rows into 3 buckets: sizes 2,2,1 (first n%k get one extra)
+        assert [r.b for r in rows] == [1, 1, 2, 2, 3]
+
+    def test_cume_dist_with_ties(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(1,), (2,), (2,), (3,)], ["v"]
+        ).createOrReplaceTempView("cd_t")
+        rows = tpu_session.sql(
+            "SELECT v, CUME_DIST() OVER (ORDER BY v) AS cd FROM cd_t"
+        ).collect()
+        got = sorted((r.v, round(r.cd, 3)) for r in rows)
+        # peers share the INCLUSIVE frame end: both 2s get 3/4
+        assert got == [(1, 0.25), (2, 0.75), (2, 0.75), (3, 1.0)]
+
+    def test_first_last_aggregates(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT k, FIRST(x) AS f, LAST(x) AS l FROM rf_t "
+            "GROUP BY k ORDER BY k"
+        ).collect()
+        assert [(r.k, r.f, r.l) for r in rows] == [
+            ("a", 1.0, 6.0), ("b", 1.0, 1.0),
+        ]
+
+    def test_first_skips_nulls(self, tpu_session):
+        tpu_session.createDataFrame(
+            [("a", None), ("a", 2.0), ("a", 3.0)], ["k", "x"]
+        ).createOrReplaceTempView("fn_t")
+        row = tpu_session.sql(
+            "SELECT FIRST(x) AS f FROM fn_t GROUP BY k"
+        ).collect()[0]
+        assert row.f == 2.0  # ignorenulls semantics, documented
+
+    def test_exists_and_not_exists(self, tpu_session, view):
+        assert tpu_session.sql(
+            "SELECT k FROM rf_t WHERE EXISTS "
+            "(SELECT k FROM rf_t WHERE x > 5)"
+        ).count() == 7
+        assert tpu_session.sql(
+            "SELECT k FROM rf_t WHERE NOT EXISTS "
+            "(SELECT k FROM rf_t WHERE x > 99)"
+        ).count() == 7
+        assert tpu_session.sql(
+            "SELECT k FROM rf_t WHERE EXISTS "
+            "(SELECT k FROM rf_t WHERE x > 99)"
+        ).count() == 0
+
+    def test_window_api_ntile_first(self, tpu_session, view):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import Window
+
+        df = tpu_session.table("rf_t")
+        w = Window.partitionBy("k").orderBy("i")
+        out = df.select("i", F.ntile(2).over(w).alias("h"))
+        got = [r.h for r in out.collect() if True]
+        assert got == [1, 1, 1, 2, 2, 2, 1]
+        agg = df.groupBy("k").agg(
+            F.first("x").alias("f"), F.last("x").alias("l")
+        )
+        assert sorted((r.k, r.f, r.l) for r in agg.collect()) == [
+            ("a", 1.0, 6.0), ("b", 1.0, 1.0),
+        ]
+
+    def test_ntile_requires_positive_literal(self, tpu_session, view):
+        import sparkdl_tpu.sql.functions as F
+
+        with pytest.raises(ValueError, match="NTILE"):
+            tpu_session.sql(
+                "SELECT NTILE(x) OVER (ORDER BY i) FROM rf_t"
+            )
+        with pytest.raises(ValueError, match="positive"):
+            F.ntile(0)
+
+    def test_column_named_exists_still_works(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(1,), (2,)], ["exists"]
+        ).createOrReplaceTempView("ex_t")
+        assert tpu_session.sql(
+            "SELECT exists FROM ex_t WHERE exists > 1"
+        ).count() == 1
