@@ -719,7 +719,8 @@ class DataFrame:
             )
         fn_key, vcol = desc[1], desc[2]
         return self._with_window_agg_column(
-            name, fn_key, vcol, part_cols, ord_cols, ascs
+            name, fn_key, vcol, part_cols, ord_cols, ascs,
+            frame=window._frame,
         )
 
     def _window_groups(
@@ -917,12 +918,17 @@ class DataFrame:
         partition_cols: Sequence[str],
         order_cols: Sequence[str],
         ascending: Sequence[bool],
+        frame: Optional[tuple] = None,
     ) -> "DataFrame":
         """Aggregate-over-window column: ``SUM(x) OVER (PARTITION BY k)``
         broadcasts the partition aggregate to every row; with ORDER BY it
         is the RUNNING aggregate under Spark's default frame (RANGE
         UNBOUNDED PRECEDING .. CURRENT ROW — tied rows are peers and
-        share one value).  NULLs are excluded, as in GROUP BY."""
+        share one value).  An explicit ``frame`` is a ROWS window
+        ``(lo, hi)`` of offsets relative to the current row (None =
+        unbounded on that side; -2..0 is the 3-row moving window) —
+        row-based, so peers do NOT share.  NULLs are excluded, as in
+        GROUP BY."""
         if fn_key == "mean":
             fn_key = "avg"
         if fn_key not in _AGG_SPECS:
@@ -945,6 +951,42 @@ class DataFrame:
             return acc if v is None else spec.update(acc, v)
 
         for idx in ordered_groups:
+            if frame is not None:
+                # explicit ROWS frame: a per-row offset window
+                lo_off, hi_off = frame
+                n = len(idx)
+                if lo_off is None:
+                    # unbounded-preceding frames (the cumulative idiom)
+                    # share ONE growing accumulator: O(n), not O(n^2)
+                    acc = spec.init()
+                    upto = 0  # rows folded so far (exclusive)
+                    empty = spec.final(spec.init())
+                    for pos in range(n):
+                        hi = (n - 1) if hi_off is None else pos + hi_off
+                        hi = hi if hi < n - 1 else n - 1
+                        while upto <= hi:
+                            acc = update(acc, idx[upto])
+                            upto += 1
+                        if hi < 0:
+                            result = empty
+                        else:
+                            result = spec.final(acc)
+                            if isinstance(result, list):
+                                result = list(result)
+                        out[idx[pos]] = result
+                    continue
+                for pos in range(n):
+                    lo = pos + lo_off
+                    hi = (n - 1) if hi_off is None else pos + hi_off
+                    acc = spec.init()
+                    for m in range(lo if lo > 0 else 0,
+                                   (hi if hi < n - 1 else n - 1) + 1):
+                        acc = update(acc, idx[m])
+                    result = spec.final(acc)
+                    if isinstance(result, list):
+                        result = list(result)
+                    out[idx[pos]] = result
+                continue
             if not order_cols:
                 acc = spec.init()
                 for i in idx:

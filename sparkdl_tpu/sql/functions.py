@@ -327,10 +327,22 @@ class Column:
         shift = getattr(self, "_shift", None)
         agg = getattr(self, "_agg", None)
         if rank_fn is not None:
+            if window._frame is not None:
+                raise ValueError(
+                    f"{rank_fn}() does not accept a frame (rowsBetween)"
+                )
             desc = ("rank", rank_fn, getattr(self, "_ntile_n", None))
         elif shift is not None:
+            if window._frame is not None:
+                raise ValueError(
+                    "lag/lead do not accept a frame (rowsBetween)"
+                )
             desc = ("shift", *shift)
         elif agg is not None:
+            if window._frame is not None and not window._order:
+                raise ValueError(
+                    "rowsBetween requires the window to have orderBy"
+                )
             col_name, fn_key = agg
             desc = ("agg", fn_key, None if col_name == "*" else col_name)
         else:
@@ -508,13 +520,16 @@ class WindowSpec:
     is Spark's default — whole partition without ORDER BY, RANGE
     UNBOUNDED PRECEDING..CURRENT ROW with it."""
 
-    def __init__(self, partition_cols=(), order=()):
+    def __init__(self, partition_cols=(), order=(), frame=None):
         self._partition_cols = tuple(partition_cols)
         self._order = tuple(order)  # (column_name, ascending)
+        self._frame = frame  # (lo, hi) row offsets; None bound=unbounded
 
     def partitionBy(self, *cols) -> "WindowSpec":
         names = [c if isinstance(c, str) else c._name for c in cols]
-        return WindowSpec(self._partition_cols + tuple(names), self._order)
+        return WindowSpec(
+            self._partition_cols + tuple(names), self._order, self._frame
+        )
 
     def orderBy(self, *cols) -> "WindowSpec":
         order = []
@@ -523,7 +538,39 @@ class WindowSpec:
                 order.append((c, True))
             else:
                 order.append((c._name, getattr(c, "_sort_asc", True)))
-        return WindowSpec(self._partition_cols, self._order + tuple(order))
+        return WindowSpec(
+            self._partition_cols, self._order + tuple(order), self._frame
+        )
+
+    def rowsBetween(self, start: int, end: int) -> "WindowSpec":
+        """Explicit ROWS frame (pyspark ``rowsBetween``): offsets
+        relative to the current row; ``Window.unboundedPreceding`` /
+        ``unboundedFollowing`` / ``currentRow`` sentinels accepted."""
+        def norm(v, lo_side):
+            # generous sentinel thresholds (pyspark code in the wild
+            # passes various huge stand-ins for "unbounded")
+            if v <= -(1 << 62):
+                return None if lo_side else _bad()
+            if v >= (1 << 62):
+                return _bad() if lo_side else None
+            return int(v)
+
+        def _bad():
+            raise ValueError(
+                "rowsBetween: start must not be unboundedFollowing and "
+                "end must not be unboundedPreceding"
+            )
+
+        frame = (norm(start, True), norm(end, False))
+        if (
+            frame[0] is not None
+            and frame[1] is not None
+            and frame[0] > frame[1]
+        ):
+            raise ValueError(
+                f"rowsBetween: start {start} is after end {end}"
+            )
+        return WindowSpec(self._partition_cols, self._order, frame)
 
     def _describe(self) -> str:
         parts = []
@@ -537,12 +584,33 @@ class WindowSpec:
                     f"{c}{'' if a else ' DESC'}" for c, a in self._order
                 )
             )
+        if self._frame is not None:
+            def bound(v, following):
+                if v is None:
+                    return (
+                        "UNBOUNDED FOLLOWING" if following
+                        else "UNBOUNDED PRECEDING"
+                    )
+                if v == 0:
+                    return "CURRENT ROW"
+                return (
+                    f"{v} FOLLOWING" if v > 0 else f"{-v} PRECEDING"
+                )
+
+            parts.append(
+                f"ROWS BETWEEN {bound(self._frame[0], False)} AND "
+                f"{bound(self._frame[1], True)}"
+            )
         return " ".join(parts)
 
 
 class Window:
     """pyspark ``Window`` entry points: ``Window.partitionBy("k")
-    .orderBy(F.desc("score"))``."""
+    .orderBy(F.desc("score")).rowsBetween(-2, Window.currentRow)``."""
+
+    unboundedPreceding = -(1 << 63)
+    unboundedFollowing = (1 << 63) - 1
+    currentRow = 0
 
     @staticmethod
     def partitionBy(*cols) -> WindowSpec:
@@ -551,6 +619,10 @@ class Window:
     @staticmethod
     def orderBy(*cols) -> WindowSpec:
         return WindowSpec().orderBy(*cols)
+
+    @staticmethod
+    def rowsBetween(start: int, end: int) -> WindowSpec:
+        return WindowSpec().rowsBetween(start, end)
 
 
 def _rank_column(fn_key: str) -> Column:

@@ -305,9 +305,35 @@ class TPUSession:
         r"|COLLECT_LIST|COLLECT_SET|FIRST_VALUE|FIRST|LAST_VALUE|LAST)"
         r"\s*\(\s*(?P<arg>.*?)\s*\)\s+OVER\s*\(\s*"
         r"(?:PARTITION\s+BY\s+(?P<part>.+?)\s*)?"
-        r"(?:ORDER\s+BY\s+(?P<ord>.+?)\s*)?\)\s*$",
+        r"(?:ORDER\s+BY\s+(?P<ord>.+?)\s*)?"
+        r"(?:ROWS\s+BETWEEN\s+(?P<fstart>UNBOUNDED\s+PRECEDING"
+        r"|\d+\s+PRECEDING|CURRENT\s+ROW|\d+\s+FOLLOWING)"
+        r"\s+AND\s+(?P<fend>UNBOUNDED\s+FOLLOWING|\d+\s+PRECEDING"
+        r"|CURRENT\s+ROW|\d+\s+FOLLOWING)\s*)?\)\s*$",
         re.IGNORECASE | re.DOTALL,
     )
+
+    @classmethod
+    def _parse_frame(cls, fstart: str, fend: str) -> tuple:
+        """ROWS bounds -> ``(lo, hi)`` row offsets (None = unbounded),
+        validated: an inverted frame (start after end) is an error, as
+        in Spark — not an all-NULL column."""
+        def bound(text: str) -> Optional[int]:
+            t = re.sub(r"\s+", " ", text.strip()).upper()
+            if t in ("UNBOUNDED PRECEDING", "UNBOUNDED FOLLOWING"):
+                return None
+            if t == "CURRENT ROW":
+                return 0
+            n, direction = t.split(" ")
+            return -int(n) if direction == "PRECEDING" else int(n)
+
+        lo, hi = bound(fstart), bound(fend)
+        if lo is not None and hi is not None and lo > hi:
+            raise ValueError(
+                f"ROWS BETWEEN: frame start ({fstart.strip()}) is "
+                f"after its end ({fend.strip()})"
+            )
+        return lo, hi
 
     _subq_counter = 0  # class-wide: unique derived-table view names
 
@@ -867,6 +893,20 @@ class TPUSession:
         )
         ord_cols = [resolve(t, "o") for t, _ in ords]
         ascs = [a for _, a in ords]
+        frame = None
+        if wm.group("fstart"):
+            frame = self._parse_frame(
+                wm.group("fstart"), wm.group("fend")
+            )
+            if not ord_cols:
+                raise ValueError(
+                    "ROWS BETWEEN requires ORDER BY in the window"
+                )
+            if fn_key in self._RANK_FNS or fn_key in ("lag", "lead"):
+                raise ValueError(
+                    f"{fn_key.upper()} does not accept a frame "
+                    "specification"
+                )
 
         if fn_key in self._RANK_FNS:
             n_buckets = None
@@ -936,7 +976,8 @@ class TPUSession:
             else:
                 vcol = resolve(arg, "v")
             df = df._with_window_agg_column(
-                out_name, fn_key, vcol, part_cols, ord_cols, ascs
+                out_name, fn_key, vcol, part_cols, ord_cols, ascs,
+                frame=frame,
             )
         for h in helpers:
             df = df.drop(h)

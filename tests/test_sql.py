@@ -2528,3 +2528,95 @@ class TestRankFamilyAndExists:
         assert tpu_session.sql(
             "SELECT exists FROM ex_t WHERE exists > 1"
         ).count() == 1
+
+
+class TestRowsFrames:
+    """Explicit ROWS BETWEEN frames (moving windows) in SQL and the
+    Window spec API."""
+
+    @pytest.fixture()
+    def view(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(i, float(i)) for i in range(1, 7)], ["i", "x"],
+            numPartitions=2,
+        ).createOrReplaceTempView("fr_t")
+
+    def test_moving_average_sql(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT i, AVG(x) OVER (ORDER BY i ROWS BETWEEN 2 "
+            "PRECEDING AND CURRENT ROW) AS ma FROM fr_t"
+        ).collect()
+        assert [round(r.ma, 3) for r in rows] == [
+            1.0, 1.5, 2.0, 3.0, 4.0, 5.0,
+        ]
+
+    def test_forward_frame_and_empty_frame_null(self, tpu_session, view):
+        rows = tpu_session.sql(
+            "SELECT i, SUM(x) OVER (ORDER BY i ROWS BETWEEN 1 "
+            "FOLLOWING AND UNBOUNDED FOLLOWING) AS rest FROM fr_t"
+        ).collect()
+        got = {r.i: r.rest for r in rows}
+        assert got[1] == 20.0 and got[5] == 6.0
+        assert got[6] is None  # empty frame: SUM of nothing is NULL
+
+    def test_rows_frame_is_row_based_not_peer_shared(self, tpu_session):
+        tpu_session.createDataFrame(
+            [(1, 1.0), (1, 2.0), (2, 4.0)], ["k", "x"]
+        ).createOrReplaceTempView("peer_t")
+        rows = tpu_session.sql(
+            "SELECT x, COUNT(*) OVER (ORDER BY k ROWS BETWEEN "
+            "UNBOUNDED PRECEDING AND CURRENT ROW) AS c FROM peer_t"
+        ).collect()
+        # ROWS: ties do NOT share (RANGE would give [2, 2, 3])
+        assert sorted(r.c for r in rows) == [1, 2, 3]
+
+    def test_window_api_rows_between(self, tpu_session, view):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import Window
+
+        df = tpu_session.table("fr_t")
+        w = Window.orderBy("i").rowsBetween(-2, Window.currentRow)
+        out = df.withColumn("ma", F.avg("x").over(w))
+        assert [round(r.ma, 3) for r in out.collect()] == [
+            1.0, 1.5, 2.0, 3.0, 4.0, 5.0,
+        ]
+        w2 = Window.orderBy("i").rowsBetween(
+            Window.unboundedPreceding, Window.currentRow
+        )
+        cum = df.withColumn("c", F.count("*").over(w2))
+        assert [r.c for r in cum.collect()] == [1, 2, 3, 4, 5, 6]
+
+    def test_frame_validation(self, tpu_session, view):
+        import sparkdl_tpu.sql.functions as F
+        from sparkdl_tpu.sql.functions import Window
+
+        with pytest.raises(ValueError, match="frame"):
+            F.row_number().over(Window.orderBy("i").rowsBetween(-1, 0))
+        with pytest.raises(ValueError, match="frame"):
+            F.lag("x").over(Window.orderBy("i").rowsBetween(-1, 0))
+        with pytest.raises(ValueError, match="after end"):
+            Window.orderBy("i").rowsBetween(1, -1)
+        with pytest.raises(ValueError, match="ORDER BY"):
+            tpu_session.sql(
+                "SELECT SUM(x) OVER (ROWS BETWEEN 1 PRECEDING AND "
+                "CURRENT ROW) FROM fr_t"
+            )
+
+    def test_inverted_sql_frame_errors(self, tpu_session, view):
+        with pytest.raises(ValueError, match="after its end"):
+            tpu_session.sql(
+                "SELECT SUM(x) OVER (ORDER BY i ROWS BETWEEN 2 "
+                "FOLLOWING AND 1 PRECEDING) FROM fr_t"
+            )
+
+    def test_unbounded_preceding_incremental_matches_naive(
+        self, tpu_session, view
+    ):
+        # (unbounded, -1): the lagged-cumulative shape exercises the
+        # empty-frame head AND the incremental accumulator
+        rows = tpu_session.sql(
+            "SELECT i, SUM(x) OVER (ORDER BY i ROWS BETWEEN UNBOUNDED "
+            "PRECEDING AND 1 PRECEDING) AS prior FROM fr_t"
+        ).collect()
+        got = {r.i: r.prior for r in rows}
+        assert got == {1: None, 2: 1.0, 3: 3.0, 4: 6.0, 5: 10.0, 6: 15.0}
