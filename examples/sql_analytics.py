@@ -117,6 +117,27 @@ def main():
     # the window's #1 must agree with the aggregate MAX per label
     best_by_window = {r.label: r.score for r in topk if r.rn == 1}
     assert best_by_window == {r.label: r.best for r in out}
+
+    # the same analytics through the pyspark-functions surface:
+    # per-label share of total score, then a wide per-category pivot
+    import sparkdl_tpu.sql.functions as F
+    from sparkdl_tpu.sql.functions import Window, col
+
+    scored_df = spark.table("scored")
+    share = (
+        scored_df
+        .withColumn(
+            "tot", F.sum("score").over(Window.partitionBy("label"))
+        )
+        .withColumn("share", col("score") / col("tot"))
+    )
+    assert abs(sum(r.share for r in share.collect()) - 3.0) < 1e-6
+    wide = (
+        spark.table("scored")
+        .join(spark.table("categories"), on="label")
+        .groupBy("label").pivot("category").agg(F.avg("score"))
+    )
+    assert wide.count() == 3 and len(wide.columns) == 4
     print("sql analytics OK")
 
 

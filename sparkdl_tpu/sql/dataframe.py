@@ -519,6 +519,52 @@ class DataFrame:
 
     unionAll = union
 
+    def unionByName(
+        self, other: "DataFrame", allowMissingColumns: bool = False
+    ) -> "DataFrame":
+        """Union resolving columns BY NAME (pyspark ``unionByName``);
+        with ``allowMissingColumns`` the asymmetric columns fill NULL."""
+        mine, theirs = set(self.columns), set(other.columns)
+        if mine != theirs:
+            if not allowMissingColumns:
+                raise ValueError(
+                    f"unionByName: column sets differ ({sorted(mine)} "
+                    f"vs {sorted(theirs)}); pass "
+                    "allowMissingColumns=True to NULL-fill"
+                )
+            all_cols = list(self.columns) + [
+                c for c in other.columns if c not in mine
+            ]
+        else:
+            all_cols = list(self.columns)
+
+        def conform(df: "DataFrame") -> "DataFrame":
+            if df.columns == all_cols:
+                return df  # already aligned: share partitions, no copy
+            out_parts = []
+            for part in df._partitions:
+                n = _partition_nrows(part)
+                out_parts.append(
+                    {
+                        c: (list(part[c]) if c in df.columns
+                            else [None] * n)
+                        for c in all_cols
+                    }
+                )
+            st = StructType()
+            for c in all_cols:
+                st.add(
+                    c,
+                    df._field_type(c) if c in df.columns
+                    else (
+                        self._field_type(c) if c in self.columns
+                        else other._field_type(c)
+                    ),
+                )
+            return DataFrame(out_parts, st, df.sparkSession)
+
+        return conform(self).union(conform(other))
+
     def _row_fingerprints(self) -> "Dict[tuple, int]":
         """Full-row content fingerprint -> occurrence count (the
         multiset the set operations compare)."""

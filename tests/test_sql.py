@@ -2620,3 +2620,25 @@ class TestRowsFrames:
         ).collect()
         got = {r.i: r.prior for r in rows}
         assert got == {1: None, 2: 1.0, 3: 3.0, 4: 6.0, 5: 10.0, 6: 15.0}
+
+
+class TestUnionByName:
+    def test_union_by_name_reorders(self, tpu_session):
+        a = tpu_session.createDataFrame([(1, "x")], ["n", "s"])
+        b = tpu_session.createDataFrame([("y", 2)], ["s", "n"])
+        out = a.unionByName(b)
+        assert out.columns == ["n", "s"]
+        assert [(r.n, r.s) for r in out.collect()] == [(1, "x"), (2, "y")]
+
+    def test_union_by_name_missing_columns(self, tpu_session):
+        a = tpu_session.createDataFrame([(1, "x")], ["n", "s"])
+        b = tpu_session.createDataFrame([(2,)], ["n"])
+        with pytest.raises(ValueError, match="column sets differ"):
+            a.unionByName(b)
+        out = a.unionByName(b, allowMissingColumns=True)
+        assert out.columns == ["n", "s"]
+        rows = out.collect()
+        assert rows[1].s is None
+        from sparkdl_tpu.sql.types import StringType
+
+        assert out.schema["s"].dataType == StringType()
