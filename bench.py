@@ -20,7 +20,10 @@ uses 1000 img/s — the commonly cited TF-fp32 InceptionV3 V100 batch-inference
 figure — so ``vs_baseline = measured / 1000``.
 
 Prints exactly one JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N}``
+— or, when the device is unreachable (bounded probe, no hang), the same
+shape with ``value``/``vs_baseline``/``mfu`` null plus an ``"error"``
+field, exit code 2.
 """
 
 import json
@@ -38,6 +41,27 @@ REPEATS = 3
 
 
 def main():
+    from sparkdl_tpu.utils.probes import bounded_subprocess_probe
+
+    ok, msg = bounded_subprocess_probe(
+        "import jax; print(jax.devices()[0].platform)", timeout_s=300
+    )
+    if not ok:
+        print(
+            json.dumps(
+                {
+                    "metric": "DeepImageFeaturizer(InceptionV3) bf16 "
+                    "batch inference throughput",
+                    "value": None,
+                    "unit": "images/sec/chip",
+                    "vs_baseline": None,
+                    "mfu": None,
+                    "error": f"device unreachable: {msg}",
+                }
+            )
+        )
+        return 2
+
     from sparkdl_tpu.utils.benchlib import measure_featurizer
 
     out = measure_featurizer("InceptionV3", BATCH, SCAN_LEN, REPEATS)

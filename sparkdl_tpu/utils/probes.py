@@ -1,0 +1,37 @@
+"""Bounded out-of-process liveness probes.
+
+A wedged PJRT tunnel makes client creation block FOREVER (observed
+round 5: a SIGKILLed client left the loopback relay's upstream session
+stuck — BASELINE.md r5 notes).  Anything that would touch the device
+unconditionally (bench.py, the native-stack tests) probes through this
+helper first, turning an unbounded hang into a loud bounded diagnostic.
+
+Deliberately jax-free: the probe must be importable and runnable before
+any in-process device initialization.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+def bounded_subprocess_probe(code: str, timeout_s: int) -> "tuple[bool, str]":
+    """Run ``code`` in a fresh interpreter with a hard timeout.
+
+    Returns ``(ok, message)``: on success the probe's stdout, on
+    timeout/failure a diagnostic (stderr tail).  One implementation so
+    the kill/timeout/truncation behavior cannot drift between callers.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung > {timeout_s}s (wedged tunnel?)"
+    if proc.returncode != 0:
+        return False, (proc.stderr or proc.stdout).strip()[-200:]
+    return True, proc.stdout.strip()

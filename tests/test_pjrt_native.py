@@ -30,33 +30,18 @@ def _plugin_usable() -> bool:
 
 
 def _tunnel_responsive(timeout_s: int = 120) -> "tuple[bool, str]":
-    """Bounded client-creation probe in a SUBPROCESS.  A wedged relay
-    (observed: a SIGKILLed client can leave the loopback tunnel's
-    upstream session stuck, after which PJRT_Client_Create blocks
-    forever) would otherwise hang the whole suite; probing out of
-    process turns that into a bounded, loud failure.  The in-process
+    """Bounded client-creation probe in a SUBPROCESS (shared helper —
+    see :mod:`sparkdl_tpu.utils.probes` for why).  The in-process
     client is only created after the probe succeeds."""
-    import sys
+    from sparkdl_tpu.utils.probes import bounded_subprocess_probe
 
-    code = (
+    return bounded_subprocess_probe(
         "from sparkdl_tpu.native import pjrt\n"
         "r = pjrt.PjrtRunner()\n"
         "print('PLATFORM', r.platform())\n"
-        "r.close()\n"
+        "r.close()\n",
+        timeout_s=timeout_s,
     )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=dict(os.environ),
-        )
-    except subprocess.TimeoutExpired:
-        return False, f"client creation hung > {timeout_s}s (wedged tunnel?)"
-    if proc.returncode != 0:
-        return False, (proc.stderr or proc.stdout).strip()[-200:]
-    return True, proc.stdout.strip()
 
 
 pytestmark = [
