@@ -1,0 +1,120 @@
+"""Online serving throughput: concurrent clients through the micro-batcher.
+
+End-to-end over :class:`sparkdl_tpu.serving.ModelServer`: concurrent
+client threads each issue blocking single-item ``predict`` calls for a
+fixed wall-clock window against a warmed endpoint (a small jitted MLP —
+the measurement targets the serving machinery, not the model).  Reports
+the sustained request rate plus the two health numbers the subsystem
+exists to optimize: mean batch occupancy (how well concurrent requests
+coalesce) and p99 request latency (what the admission/linger policy
+costs).
+
+Prints one JSON line; ``vs_baseline`` is null (record-only config).
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_serving.py --seconds 3
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+FEATURES = 64
+HIDDEN = 256
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0,
+                    help="measurement window per trial")
+    ap.add_argument("--clients", type=int, default=16,
+                    help="concurrent blocking client threads")
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    args = ap.parse_args()
+
+    from sparkdl_tpu.serving import ModelServer, ServingConfig
+    from sparkdl_tpu.utils.metrics import metrics
+
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(FEATURES, HIDDEN).astype(np.float32) * 0.05
+    w2 = rng.randn(HIDDEN, 8).astype(np.float32) * 0.05
+
+    def forward(x):
+        import jax.numpy as jnp
+
+        return jnp.maximum(x @ w1, 0.0) @ w2
+
+    metrics.reset()
+    server = ModelServer(
+        ServingConfig(
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            queue_capacity=max(256, 4 * args.clients),
+        )
+    )
+    server.register("mlp", forward, item_shape=(FEATURES,))
+    server.warmup()
+
+    stop = threading.Event()
+    served = [0] * args.clients
+    x = rng.rand(FEATURES).astype(np.float32)
+
+    def client(i):
+        while not stop.is_set():
+            server.predict(x, timeout=60.0)
+            served[i] += 1
+
+    threads = [
+        threading.Thread(target=client, args=(i,))
+        for i in range(args.clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(args.seconds)
+    stop.set()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+
+    snap = metrics.snapshot()
+    total = sum(served)
+    server.close()
+    print(
+        json.dumps(
+            {
+                "metric": "online serving sustained request rate "
+                f"({args.clients} concurrent clients)",
+                "value": round(total / elapsed, 1),
+                "unit": "requests/sec",
+                "requests": total,
+                "batches": int(snap.get("serving.batches", 0)),
+                "occupancy_mean": round(
+                    snap.get("serving.batch_occupancy.mean", 0.0), 4
+                ),
+                "p99_latency_ms": round(
+                    snap.get("serving.latency_ms.p99", 0.0), 3
+                ),
+                "p50_latency_ms": round(
+                    snap.get("serving.latency_ms.p50", 0.0), 3
+                ),
+                "compiles": int(snap.get("serving.compiles", 0)),
+                "shed": int(snap.get("serving.shed", 0)),
+                "seconds": args.seconds,
+                "max_batch": args.max_batch,
+                "max_wait_ms": args.max_wait_ms,
+                "vs_baseline": None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,23 @@ class KerasApplicationModel:
     def preprocess(self, x):
         return preprocess_input(x, self.preprocess_mode)
 
+    # -- online serving hooks ----------------------------------------
+    def serving_item_spec(self) -> Tuple[Tuple[int, int, int], Any]:
+        """The per-item ``(shape, dtype)`` an online endpoint for this
+        model serves — what ``ModelServer.register(item_shape=...)`` and
+        a cold ``warmup()`` need before any request has arrived."""
+        import numpy as np
+
+        h, w = self.input_size
+        return (h, w, 3), np.float32
+
+    def warmup_buckets(self, max_batch: int = 32) -> Tuple[int, ...]:
+        """The shape buckets an endpoint for this model should pre-trace
+        (the full serving ladder; one program per bucket)."""
+        from sparkdl_tpu.transformers.utils import bucket_ladder
+
+        return bucket_ladder(max_batch)
+
     # -- model construction ------------------------------------------
     def make_module(self, dtype: Optional[Any] = None, include_top: bool = True):
         return self.flax_cls(

@@ -1,0 +1,35 @@
+"""sparkdl_tpu.serving — online model serving over the jitted hot loop.
+
+Every inference path in the batch stack assumes the caller holds a full
+DataFrame; this package adds the missing online layer (SURVEY.md north
+star: serve heavy traffic): a dynamic micro-batcher coalescing concurrent
+single-item requests into padded, shape-bucketed forward calls, a warm
+program cache with explicit ``warmup()``, admission control with typed
+load-shedding and deadline propagation, and ``serving.*`` metrics
+(requests, batches, occupancy, queue depth, latency quantiles) in
+:mod:`sparkdl_tpu.utils.metrics`.
+"""
+
+from sparkdl_tpu.serving.admission import AdmissionQueue, Request
+from sparkdl_tpu.serving.batcher import MicroBatcher, ServingConfig
+from sparkdl_tpu.serving.cache import ProgramCache
+from sparkdl_tpu.serving.errors import (
+    DeadlineExceeded,
+    ServerClosed,
+    ServerOverloaded,
+    ServingError,
+)
+from sparkdl_tpu.serving.server import ModelServer
+
+__all__ = [
+    "AdmissionQueue",
+    "DeadlineExceeded",
+    "MicroBatcher",
+    "ModelServer",
+    "ProgramCache",
+    "Request",
+    "ServerClosed",
+    "ServerOverloaded",
+    "ServingConfig",
+    "ServingError",
+]

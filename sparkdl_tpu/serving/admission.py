@@ -1,0 +1,133 @@
+"""Admission control: bounded request queue with load-shedding and
+deadline bookkeeping.
+
+The reference stack (and our own batch path) assumes the caller already
+holds a full DataFrame of inputs; an online front-end instead sees a
+stream of single-item requests arriving on many threads.  This module is
+the valve between the two: requests are admitted into a *bounded* queue
+(full queue -> typed :class:`~sparkdl_tpu.serving.errors.ServerOverloaded`
+at submit time, never an unbounded backlog), and the micro-batcher's
+worker coalesces them with a classic first-item-then-linger policy
+(``max_batch`` / ``max_wait``), the MMLSpark sub-millisecond-batching
+idea (PAPERS.md) applied to our jitted hot loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+from sparkdl_tpu.serving.errors import ServerClosed, ServerOverloaded
+
+
+@dataclass
+class Request:
+    """One in-flight single-item request."""
+
+    value: Any
+    future: Future = field(default_factory=Future)
+    enqueued_at: float = field(default_factory=time.monotonic)
+    #: absolute ``time.monotonic()`` expiry, or None for no deadline
+    deadline: Optional[float] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) > self.deadline
+
+
+class AdmissionQueue:
+    """Bounded FIFO of :class:`Request` with coalescing take.
+
+    ``offer`` never blocks: a full queue sheds the request immediately
+    (backpressure surfaces at the caller as :class:`ServerOverloaded`
+    instead of as silent latency).  ``take`` blocks briefly for the first
+    request, then lingers up to ``max_wait_s`` gathering more — the
+    dynamic micro-batching window.
+    """
+
+    def __init__(self, capacity: int, depth_gauge=None, shed_counter=None):
+        if capacity < 1:
+            raise ValueError(f"queue capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._items: "deque[Request]" = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+        self._depth_gauge = depth_gauge
+        self._shed_counter = shed_counter
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def _set_depth_locked(self) -> None:
+        if self._depth_gauge is not None:
+            self._depth_gauge.set(len(self._items))
+
+    def offer(self, request: Request) -> None:
+        """Admit ``request`` or raise (``ServerOverloaded``/``ServerClosed``)."""
+        with self._not_empty:
+            if self._closed:
+                raise ServerClosed("endpoint is closed")
+            if len(self._items) >= self.capacity:
+                if self._shed_counter is not None:
+                    self._shed_counter.add(1)
+                raise ServerOverloaded(
+                    f"request queue full ({self.capacity} pending); "
+                    "load-shedding"
+                )
+            self._items.append(request)
+            self._set_depth_locked()
+            self._not_empty.notify()
+
+    def take(
+        self,
+        max_n: int,
+        max_wait_s: float,
+        poll_s: float = 0.05,
+    ) -> List[Request]:
+        """Coalesce up to ``max_n`` requests.
+
+        Blocks at most ``poll_s`` for the first request (so a closing
+        worker notices promptly); once one arrives, lingers up to
+        ``max_wait_s`` — measured from the first request — for more.
+        Returns ``[]`` on an idle poll or when closed.
+        """
+        with self._not_empty:
+            if not self._items and not self._closed:
+                self._not_empty.wait(poll_s)
+            if not self._items:
+                return []
+            batch = [self._items.popleft()]
+            linger_until = time.monotonic() + max_wait_s
+            while len(batch) < max_n and not self._closed:
+                if self._items:
+                    batch.append(self._items.popleft())
+                    continue
+                remaining = linger_until - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._not_empty.wait(remaining)
+            self._set_depth_locked()
+            return batch
+
+    def close(self) -> List[Request]:
+        """Stop admitting; return (and remove) everything still queued so
+        the caller can fail those futures."""
+        with self._not_empty:
+            self._closed = True
+            drained = list(self._items)
+            self._items.clear()
+            self._set_depth_locked()
+            self._not_empty.notify_all()
+        return drained
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed

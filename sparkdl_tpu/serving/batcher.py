@@ -1,0 +1,292 @@
+"""Dynamic micro-batcher: coalesce single-item requests into padded,
+shape-bucketed forward calls.
+
+This is the online analog of ``run_batched`` (transformers/utils.py) and
+shares its batching core: every device call's leading dim is one of the
+:func:`~sparkdl_tpu.transformers.utils.bucket_ladder` buckets, padded up
+with :func:`~sparkdl_tpu.transformers.utils.pad_to_batch`, so XLA
+compiles a bounded program set and steady state never recompiles (tf.data
+pipelining logic — PAPERS.md — applied to a request stream instead of an
+input pipeline).
+
+One worker thread per endpoint: requests for one model coalesce, the
+batch pads to its bucket, the warm :class:`ProgramCache` program runs it,
+and per-request futures resolve.  A forward that raises fails only that
+batch's futures — the worker survives and keeps serving (the crash case
+is fault-injection-tested).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from sparkdl_tpu.serving.admission import AdmissionQueue, Request
+from sparkdl_tpu.serving.cache import ProgramCache
+from sparkdl_tpu.serving.errors import DeadlineExceeded, ServerClosed
+from sparkdl_tpu.transformers.utils import pad_to_batch, shape_bucket
+from sparkdl_tpu.utils.metrics import metrics
+
+logger = logging.getLogger(__name__)
+
+
+class ServingConfig:
+    """Knobs of one online endpoint (shared by every endpoint of a
+    :class:`~sparkdl_tpu.serving.server.ModelServer`)."""
+
+    def __init__(
+        self,
+        max_batch: int = 32,
+        max_wait_ms: float = 2.0,
+        queue_capacity: int = 256,
+        cache_size: int = 32,
+        default_deadline_ms: Optional[float] = None,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.queue_capacity = int(queue_capacity)
+        self.cache_size = int(cache_size)
+        self.default_deadline_ms = default_deadline_ms
+
+    def __repr__(self):
+        return (
+            f"ServingConfig(max_batch={self.max_batch}, "
+            f"max_wait_ms={self.max_wait_ms}, "
+            f"queue_capacity={self.queue_capacity}, "
+            f"cache_size={self.cache_size}, "
+            f"default_deadline_ms={self.default_deadline_ms})"
+        )
+
+
+class MicroBatcher:
+    """One online endpoint: admission queue + worker + warm programs for a
+    single model ``forward(batch) -> batch`` callable.
+
+    ``compile=False`` runs ``forward`` as plain Python instead of jitting
+    per bucket — the escape hatch for non-JAX callables, and what the
+    fault-injection tests use to make worker behavior deterministic.
+    """
+
+    def __init__(
+        self,
+        model_id: str,
+        forward: Callable[[Any], Any],
+        config: ServingConfig,
+        cache: ProgramCache,
+        item_shape: Optional[Sequence[int]] = None,
+        dtype: Any = np.float32,
+        compile: bool = True,
+    ):
+        self.model_id = model_id
+        self._forward = forward
+        self._config = config
+        self._cache = cache
+        self._item_shape: Optional[Tuple[int, ...]] = (
+            tuple(int(d) for d in item_shape) if item_shape is not None
+            else None
+        )
+        self._dtype = np.dtype(dtype)
+        self._compile = bool(compile)
+        self._queue = AdmissionQueue(
+            config.queue_capacity,
+            depth_gauge=metrics.gauge(f"serving.queue_depth.{model_id}"),
+            shed_counter=metrics.counter("serving.shed"),
+        )
+        self._closed = False
+        self._worker_lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit(
+        self,
+        value,
+        deadline_ms: Optional[float] = None,
+    ) -> Future:
+        """Admit one item; returns a Future resolving to the model output
+        row.  Raises :class:`ServerOverloaded` when the queue is full and
+        :class:`ServerClosed` after :meth:`close`; a deadline that expires
+        while queued fails the future with :class:`DeadlineExceeded`."""
+        if self._closed:
+            raise ServerClosed(f"endpoint {self.model_id!r} is closed")
+        arr = np.asarray(value, dtype=self._dtype)
+        if self._item_shape is None:
+            # first request binds the endpoint's item shape (same
+            # one-fixed-shape contract as make_loader_decode_plan)
+            self._item_shape = tuple(arr.shape)
+        elif tuple(arr.shape) != self._item_shape:
+            raise ValueError(
+                f"endpoint {self.model_id!r} serves items of shape "
+                f"{self._item_shape}; got {tuple(arr.shape)} — one "
+                "endpoint serves one item shape (register another for a "
+                "second shape)"
+            )
+        if deadline_ms is None:
+            deadline_ms = self._config.default_deadline_ms
+        deadline = (
+            time.monotonic() + deadline_ms / 1000.0
+            if deadline_ms is not None
+            else None
+        )
+        req = Request(value=arr, deadline=deadline)
+        metrics.counter("serving.requests").add(1)
+        self._ensure_worker()
+        self._queue.offer(req)
+        return req.future
+
+    def predict(self, value, timeout: Optional[float] = None,
+                deadline_ms: Optional[float] = None):
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(value, deadline_ms=deadline_ms).result(timeout)
+
+    # ------------------------------------------------------------------
+    # warmup
+    # ------------------------------------------------------------------
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> Tuple[int, ...]:
+        """Pre-trace the endpoint's hot buckets (default: the whole
+        ladder up to ``max_batch``) so first-request latency is not a
+        compile.  Requires a known item shape (pass one at registration
+        for cold warmup)."""
+        if self._item_shape is None:
+            raise ValueError(
+                f"endpoint {self.model_id!r} has no item shape yet; "
+                "register with item_shape=... to warm up before traffic"
+            )
+        if not self._compile:
+            return ()
+        return self._cache.warmup(
+            self.model_id,
+            self._forward,
+            self._item_shape,
+            self._dtype,
+            buckets=buckets,
+            max_batch=self._config.max_batch,
+        )
+
+    # ------------------------------------------------------------------
+    # worker
+    # ------------------------------------------------------------------
+    def _ensure_worker(self) -> None:
+        """Start (or restart after an unexpected death) the batch worker —
+        a crashed worker must not strand queued futures forever."""
+        with self._worker_lock:
+            if self._closed:
+                return
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._worker_loop,
+                    name=f"sparkdl-serving-{self.model_id}",
+                    daemon=True,
+                )
+                self._worker.start()
+
+    def _worker_loop(self) -> None:
+        while not self._closed:
+            try:
+                batch = self._queue.take(
+                    self._config.max_batch,
+                    self._config.max_wait_ms / 1000.0,
+                )
+                if batch:
+                    self._run_batch(batch)
+            except Exception:  # pragma: no cover - defensive
+                # the per-batch path already routes model errors to the
+                # batch's futures; anything landing here is a batcher bug
+                # — log it and keep serving rather than silently dying
+                logger.exception(
+                    "serving worker for %r survived an internal error",
+                    self.model_id,
+                )
+
+    def _run_batch(self, reqs) -> None:
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.expired(now):
+                metrics.counter("serving.expired").add(1)
+                r.future.set_exception(
+                    DeadlineExceeded(
+                        f"request to {self.model_id!r} expired after "
+                        f"{(now - r.enqueued_at) * 1000:.1f}ms in queue"
+                    )
+                )
+            else:
+                live.append(r)
+        if not live:
+            return
+        bucket = shape_bucket(len(live), self._config.max_batch)
+        x = pad_to_batch(np.stack([r.value for r in live]), bucket)
+        try:
+            if self._compile:
+                fn = self._cache.program(
+                    self.model_id, self._forward, bucket,
+                    self._item_shape, self._dtype,
+                )
+                out = np.asarray(jax.device_get(fn(x)))
+            else:
+                out = np.asarray(self._forward(x))
+        except Exception as e:
+            metrics.counter("serving.errors").add(1)
+            for r in live:
+                r.future.set_exception(e)
+            return
+        done = time.monotonic()
+        latency = metrics.histogram("serving.latency_ms")
+        for i, r in enumerate(live):
+            r.future.set_result(out[i])
+            latency.observe((done - r.enqueued_at) * 1000.0)
+        metrics.counter("serving.batches").add(1)
+        metrics.histogram("serving.batch_occupancy").observe(
+            len(live) / bucket
+        )
+
+    # ------------------------------------------------------------------
+    # lifecycle / introspection
+    # ------------------------------------------------------------------
+    def close(self) -> None:
+        """Stop admitting, fail queued requests with ``ServerClosed``, and
+        join the worker."""
+        self._closed = True
+        for r in self._queue.close():
+            r.future.set_exception(
+                ServerClosed(f"endpoint {self.model_id!r} closed")
+            )
+        with self._worker_lock:
+            worker = self._worker
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=5.0)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def worker_alive(self) -> bool:
+        with self._worker_lock:
+            return self._worker is not None and self._worker.is_alive()
+
+    def describe(self) -> dict:
+        return {
+            "model_id": self.model_id,
+            "item_shape": (
+                list(self._item_shape) if self._item_shape else None
+            ),
+            "dtype": self._dtype.name,
+            "compiled": self._compile,
+            "queue_depth": self.queue_depth,
+            "queue_capacity": self._queue.capacity,
+            "worker_alive": self.worker_alive,
+            "closed": self._closed,
+        }

@@ -1,0 +1,114 @@
+"""Warm program cache: (model id, shape bucket) -> jitted executable.
+
+First-request latency on a cold endpoint is dominated by XLA compilation
+(seconds to tens of seconds on TPU — transformers/utils.py measured
+10-40s per program), so the serving layer keeps one ``jax.jit`` wrapper
+*per (model, bucket) key* in a bounded LRU and exposes an explicit
+:meth:`ProgramCache.warmup` that pre-traces the hot buckets before
+traffic arrives.
+
+One jit wrapper per key — rather than one shared wrapper whose internal
+cache holds every shape — is deliberate: it makes LRU eviction actually
+drop the compiled executable (hundreds of MB for big CNNs), and it makes
+compile activity observable (each wrapper traces exactly once, counted in
+``serving.compiles``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from sparkdl_tpu.transformers.utils import LRUCache, bucket_ladder
+
+
+class ProgramCache:
+    """Bounded LRU of jitted programs keyed by
+    ``(model_id, bucket, item_shape, dtype)``."""
+
+    def __init__(self, maxsize: int = 32, compile_counter=None):
+        self._lock = threading.Lock()
+        self._programs = LRUCache(maxsize)
+        self._compile_counter = compile_counter
+
+    @staticmethod
+    def _key(model_id: str, bucket: int, item_shape, dtype) -> Tuple:
+        return (
+            model_id,
+            int(bucket),
+            tuple(int(d) for d in item_shape),
+            np.dtype(dtype).str,
+        )
+
+    def program(
+        self,
+        model_id: str,
+        forward: Callable,
+        bucket: int,
+        item_shape: Sequence[int],
+        dtype: Any,
+    ) -> Callable:
+        """The jitted program for one (model, bucket) slot, compiling (and
+        counting the compile) on first use.  ``forward`` must be the *raw*
+        python callable — this cache owns the jit."""
+        key = self._key(model_id, bucket, item_shape, dtype)
+        with self._lock:
+            hit = self._programs.get(key)
+            if hit is not None:
+                return hit
+            counter = self._compile_counter
+
+            def counted(x, _forward=forward, _counter=counter):
+                # body runs only while jax traces — i.e. once per compile
+                if _counter is not None:
+                    _counter.add(1)
+                return _forward(x)
+
+            jitted = jax.jit(counted)
+            self._programs[key] = jitted
+            return jitted
+
+    def warmup(
+        self,
+        model_id: str,
+        forward: Callable,
+        item_shape: Sequence[int],
+        dtype: Any,
+        buckets: Optional[Sequence[int]] = None,
+        max_batch: int = 32,
+    ) -> Tuple[int, ...]:
+        """Pre-trace ``buckets`` (default: the full :func:`bucket_ladder`
+        of ``max_batch``) by running zeros through each program, so no
+        steady-state request shape compiles at request time.  Returns the
+        buckets traced."""
+        buckets = tuple(buckets) if buckets else bucket_ladder(max_batch)
+        for b in buckets:
+            fn = self.program(model_id, forward, b, item_shape, dtype)
+            x = np.zeros((int(b), *item_shape), dtype=np.dtype(dtype))
+            jax.block_until_ready(fn(x))
+        return buckets
+
+    def evict_model(self, model_id: str) -> int:
+        """Drop every program of ``model_id``; returns how many."""
+        with self._lock:
+            doomed = [k for k in self._programs if k[0] == model_id]
+            for k in doomed:
+                del self._programs[k]
+            return len(doomed)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            keys = list(self._programs)
+        return {
+            "programs": len(keys),
+            "maxsize": self._programs.maxsize,
+            "keys": [
+                {"model": k[0], "bucket": k[1], "item_shape": list(k[2]),
+                 "dtype": k[3]}
+                for k in keys
+            ],
+        }

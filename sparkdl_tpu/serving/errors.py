@@ -1,0 +1,31 @@
+"""Typed serving errors — the admission-control contract.
+
+Online callers need to distinguish *shed* (retry elsewhere / later),
+*expired* (the answer is worthless now), and *closed* (stop sending) from
+genuine model failures, so each is its own exception type rather than a
+string-matched RuntimeError.  All inherit :class:`ServingError` so a
+front-end can catch the whole family at once.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base class for all online-serving errors."""
+
+
+class ServerOverloaded(ServingError):
+    """The bounded request queue is full — the request was load-shed at
+    admission, before consuming any queue slot or TPU time.  Callers
+    should back off and retry; the server is alive."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline expired while it waited in the queue; it was
+    dropped before being padded into a batch (an expired answer would
+    waste a TPU slot to compute a result nobody reads)."""
+
+
+class ServerClosed(ServingError):
+    """The endpoint was closed: submissions are rejected and any requests
+    still queued at close time fail with this error."""

@@ -1090,7 +1090,14 @@ class DataFrame:
     ) -> "DataFrame":
         """``LAG/LEAD(x[, offset[, default]]) OVER (...)`` — the row
         ``offset`` positions before/after in the partition's order, or
-        ``default`` (NULL unless given) off either end."""
+        ``default`` (NULL unless given) off either end.
+
+        ``default`` must be NULL or type-compatible with the value
+        column's declared dtype: the filled edges land in the same
+        column as the shifted values, and a mismatched literal (e.g.
+        ``LAG(score, 1, 'n/a')`` over a DOUBLE) would silently produce
+        a mixed-type column that breaks downstream numeric ops."""
+        self._check_shift_default(value_col, default)
         flat, ordered_groups, sizes = self._window_groups(
             partition_cols, order_cols, ascending,
             extra_cols=[value_col],
@@ -1105,6 +1112,46 @@ class DataFrame:
         return self._scatter_window_column(
             name, out, sizes, self._field_type(value_col)
         )
+
+    def _check_shift_default(self, value_col: str, default: Any) -> None:
+        """Reject a LAG/LEAD ``default`` literal that cannot live in the
+        value column's declared type.  NULL always passes; an untyped
+        (Object) column accepts anything."""
+        if default is None:
+            return
+        from sparkdl_tpu.sql.types import (
+            BooleanType,
+            DoubleType,
+            FloatType,
+            IntegerType,
+            LongType,
+            StringType,
+        )
+
+        dtype = self._field_type(value_col)
+        # bool is an int subclass in Python; it is NOT a numeric literal
+        ok: bool
+        if isinstance(dtype, (IntegerType, LongType)):
+            ok = isinstance(default, int) and not isinstance(default, bool)
+        elif isinstance(dtype, (FloatType, DoubleType)):
+            ok = isinstance(default, (int, float)) and not isinstance(
+                default, bool
+            )
+        elif isinstance(dtype, StringType):
+            ok = isinstance(default, str)
+        elif isinstance(dtype, BooleanType):
+            ok = isinstance(default, bool)
+        else:
+            # Object/array/vector columns carry no checkable contract
+            return
+        if not ok:
+            raise ValueError(
+                f"LAG/LEAD default {default!r} "
+                f"({type(default).__name__}) is not compatible with "
+                f"column {value_col!r} of type "
+                f"{type(dtype).__name__}; use a literal of the "
+                "column's type or omit the default (NULL)"
+            )
 
     def dropDuplicates(
         self, subset: Optional[Sequence[str]] = None

@@ -504,13 +504,34 @@ def collect_set(col_or_name) -> Column:
     return _agg_column("collect_set", col_or_name)
 
 
-def first(col_or_name) -> Column:
-    """First NON-NULL value in partition order (Spark's
-    ``first(col, ignorenulls=True)``)."""
+def first(col_or_name, ignorenulls: bool = True) -> Column:
+    """First NON-NULL value in partition order.
+
+    The engine pre-filters NULLs before every aggregation, so only
+    Spark's ``ignorenulls=True`` behaviour exists here.  Spark's own
+    default is ``False`` (first value, null or not) — callers relying
+    on that must fail loudly rather than silently get non-null-first
+    semantics."""
+    if not ignorenulls:
+        raise NotImplementedError(
+            "first(col, ignorenulls=False) is not supported: the engine "
+            "drops NULLs before aggregating, so only the first NON-NULL "
+            "value is observable; pass ignorenulls=True (note Spark "
+            "defaults to False)"
+        )
     return _agg_column("first", col_or_name)
 
 
-def last(col_or_name) -> Column:
+def last(col_or_name, ignorenulls: bool = True) -> Column:
+    """Last NON-NULL value in partition order (same ``ignorenulls``
+    contract as :func:`first`)."""
+    if not ignorenulls:
+        raise NotImplementedError(
+            "last(col, ignorenulls=False) is not supported: the engine "
+            "drops NULLs before aggregating, so only the last NON-NULL "
+            "value is observable; pass ignorenulls=True (note Spark "
+            "defaults to False)"
+        )
     return _agg_column("last", col_or_name)
 
 

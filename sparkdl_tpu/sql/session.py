@@ -1089,6 +1089,24 @@ class TPUSession:
         as derived columns first.  Returns ``(df, pair)``."""
         if fn_key == "mean":
             fn_key = "avg"
+        if fn_key in ("first", "last", "first_value", "last_value"):
+            # Spark's two-arg form: FIRST(col, ignoreNulls).  The engine
+            # drops NULLs before aggregating, so only the true spelling
+            # (Spark's NON-default) is expressible — false must fail
+            # loudly, not silently act like true.
+            ig = re.fullmatch(
+                r"(?P<col>.+?)\s*,\s*(?P<ig>true|false)", arg,
+                re.IGNORECASE | re.DOTALL,
+            )
+            if ig:
+                if ig.group("ig").lower() == "false":
+                    raise NotImplementedError(
+                        f"{fn_key.upper()}({arg}): ignoreNulls=false is "
+                        "not supported — the engine drops NULLs before "
+                        "aggregating, so only the first/last NON-NULL "
+                        "value is observable"
+                    )
+                arg = ig.group("col").strip()
         if distinct:
             if fn_key != "count":
                 raise ValueError(
@@ -1187,6 +1205,17 @@ class TPUSession:
             am = self._AGG_RE.match(expr)
             if am:
                 fn_key = am.group("fn").lower()
+                if self.udf is not None and fn_key in self.udf:
+                    # inside an aggregate query the SQL aggregate used to
+                    # silently shadow a same-named scalar UDF — ambiguous
+                    # calls must be an error, not a coin flip
+                    raise ValueError(
+                        f"{fn_key.upper()}(...) is ambiguous: "
+                        f"{fn_key!r} is both a SQL aggregate and a "
+                        "registered UDF.  Unregister or rename the UDF "
+                        "(outside GROUP BY the UDF keeps its per-row "
+                        "meaning; inside one the call cannot be resolved)"
+                    )
                 arg = am.group("arg").strip()
                 distinct = bool(am.group("distinct"))
                 # the alias IS the output column (aliasing after the fact

@@ -70,11 +70,65 @@ class LRUCache:
     def get(self, key, default=None):
         return self[key] if key in self._data else default
 
+    def __delitem__(self, key):
+        del self._data[key]
+
+    def __iter__(self):
+        return iter(list(self._data))
+
     def __len__(self):
         return len(self._data)
 
 
 _resize_cache = LRUCache(16)
+
+
+# ---------------------------------------------------------------------------
+# batching core — the pad/bucket discipline shared by the offline loops
+# (run_batched*) and the online micro-batcher (sparkdl_tpu.serving): every
+# batch the device sees has one of a small, fixed set of leading dims, so
+# XLA compiles a bounded program set and steady state never recompiles.
+# ---------------------------------------------------------------------------
+
+
+def pad_to_batch(batch: np.ndarray, batch_size: int) -> np.ndarray:
+    """Pad ``batch``'s leading dim up to ``batch_size`` by repeating the
+    last row (sliced back by the caller).  Repeating a real row — rather
+    than zero-filling — keeps the padding numerically inert for
+    row-independent forwards while never feeding the model out-of-
+    distribution values."""
+    k = batch.shape[0]
+    if k >= batch_size:
+        return batch
+    return np.concatenate(
+        [batch, np.repeat(batch[-1:], batch_size - k, axis=0)], axis=0
+    )
+
+
+def shape_bucket(n: int, max_batch: int) -> int:
+    """The padded leading dim for an ``n``-row micro-batch: the smallest
+    power of two >= n, capped at ``max_batch`` (which is always its own
+    bucket, power of two or not)."""
+    if n <= 0:
+        raise ValueError(f"shape_bucket requires n >= 1, got {n}")
+    if n >= max_batch:
+        return int(max_batch)
+    return min(1 << (int(n) - 1).bit_length(), int(max_batch))
+
+
+def bucket_ladder(max_batch: int) -> Tuple[int, ...]:
+    """Every bucket :func:`shape_bucket` can produce for ``max_batch`` —
+    the full set a serving warmup must pre-trace so no request shape
+    compiles at request time."""
+    if max_batch <= 0:
+        raise ValueError(f"bucket_ladder requires max_batch >= 1, got {max_batch}")
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b <<= 1
+    out.append(int(max_batch))
+    return tuple(out)
 
 # Resolved once per process (a 1-tuple holding the Mesh or None): callers
 # place params at build/registration time but batches are placed per call,
@@ -479,12 +533,7 @@ def run_batched_multi(
             chunks = [a[lo : lo + batch_size] for a in arrays]
             k = chunks[0].shape[0]
             if k < batch_size:
-                chunks = [
-                    np.concatenate(
-                        [c, np.repeat(c[-1:], batch_size - k, axis=0)], axis=0
-                    )
-                    for c in chunks
-                ]
+                chunks = [pad_to_batch(c, batch_size) for c in chunks]
             results = fn(*[_place(c) for c in chunks])
             if not isinstance(results, (tuple, list)):
                 results = (results,)
@@ -569,11 +618,7 @@ def run_batched_rows(
     def decode_chunk(lo, hi):
         batch = decode(rows[lo:hi])
         k = batch.shape[0]
-        if k < batch_size:
-            batch = np.concatenate(
-                [batch, np.repeat(batch[-1:], batch_size - k, axis=0)], axis=0
-            )
-        return batch, k
+        return pad_to_batch(batch, batch_size), k
 
     cancel = threading.Event()
     if serial:

@@ -85,15 +85,15 @@ def registerKerasImageUDF(
     fn = _resolve_model(keras_model_or_file, compute_dtype=computeDtype)
     size = getattr(fn, "input_hw", None)
     params = place_params(fn.params)
-    inner = fn._jitted()
 
-    @jax.jit
-    def forward(x):
+    def forward_core(x):
         # cast + resize fuse with the model into one device program, so
         # batches arrive at source size (uint8 when possible — the
         # host->device link is the serving path's bottleneck)
         x = cast_and_resize_on_device(x, size)
-        return inner(params, x)[0]
+        return fn.apply(params, x)[0]
+
+    forward = jax.jit(forward_core)
 
     def evaluate(values):
         # decode and forward run as a pipeline (run_batched_rows): host
@@ -128,8 +128,22 @@ def registerKerasImageUDF(
         return [DenseVector(v) for v in flat]
 
     udf = UserDefinedFunction(evaluate, name=udfName, vectorized=True)
+    # online-serving hook: the raw (un-jitted) fused forward plus its item
+    # contract, so ModelServer.from_registered_udf can serve this exact
+    # model through the micro-batcher (which owns per-bucket jit).  File-
+    # loader UDFs keep item_shape=None: the preprocessor's output shape is
+    # bound by the first request.
+    udf._serving_endpoint = {
+        "model_id": udfName,
+        "forward": forward_core,
+        "item_shape": (size[0], size[1], 3) if size is not None else None,
+        "dtype": np.float32,
+    }
     from sparkdl_tpu.sql.session import TPUSession
 
     session = session or TPUSession.getActiveSession()
-    session.udf.register(udfName, udf)
+    registered = session.udf.register(udfName, udf)
+    # the registry re-wraps the UDF instance; the serving hook must ride
+    # on the copy the registry hands back to from_registered_udf
+    registered._serving_endpoint = udf._serving_endpoint
     return udf

@@ -14,10 +14,12 @@ Thread-safe (transforms may run from CrossValidator worker threads).
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -78,13 +80,98 @@ class Timer:
             return self._entries
 
 
+class Gauge:
+    """Last-set value (e.g. current queue depth) — not monotonic."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def add(self, delta: float) -> None:
+        with self._lock:
+            self._value += delta
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+#: quantiles every histogram exports in ``snapshot()``
+_SNAPSHOT_QUANTILES: Tuple[Tuple[float, str], ...] = (
+    (0.5, "p50"), (0.95, "p95"), (0.99, "p99"),
+)
+
+
+class Histogram:
+    """Sliding-window distribution: lifetime count/sum plus the last
+    ``window`` observations for quantiles.
+
+    The serving path needs p50/p95/p99 latency of *recent* traffic, not of
+    the process lifetime (a cold-start compile would poison lifetime
+    quantiles forever), so quantiles are computed over a bounded window of
+    the most recent observations; ``count``/``total``/``mean`` stay
+    lifetime-accurate.
+    """
+
+    def __init__(self, name: str, window: int = 4096):
+        self.name = name
+        self._lock = threading.Lock()
+        self._window: "deque[float]" = deque(maxlen=int(window))
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._window.append(float(value))
+            self._count += 1
+            self._sum += value
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Linear-interpolated quantile over the window; None when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            data = sorted(self._window)
+        if not data:
+            return None
+        rank = q * (len(data) - 1)
+        lo = math.floor(rank)
+        hi = min(lo + 1, len(data) - 1)
+        frac = rank - lo
+        return data[lo] * (1.0 - frac) + data[hi] * frac
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def total(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def mean(self) -> Optional[float]:
+        with self._lock:
+            return (self._sum / self._count) if self._count else None
+
+
 class MetricsRegistry:
-    """Process-wide named counters/timers (Spark-accumulator analog)."""
+    """Process-wide named counters/timers/gauges/histograms
+    (Spark-accumulator analog)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._timers: Dict[str, Timer] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -98,16 +185,45 @@ class MetricsRegistry:
                 self._timers[name] = Timer(name)
             return self._timers[name]
 
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name not in self._gauges:
+                self._gauges[name] = Gauge(name)
+            return self._gauges[name]
+
+    def histogram(self, name: str, window: int = 4096) -> Histogram:
+        with self._lock:
+            if name not in self._histograms:
+                self._histograms[name] = Histogram(name, window=window)
+            return self._histograms[name]
+
     def snapshot(self) -> Dict[str, float]:
-        """Flat dict of every counter value and timer total."""
+        """Flat dict of every counter value, timer total, gauge value, and
+        histogram count/mean/quantiles."""
         with self._lock:
             counters = dict(self._counters)
             timers = dict(self._timers)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
         out: Dict[str, float] = {}
         for name, c in counters.items():
             out[name] = c.value
         for name, t in timers.items():
             out[name + ".seconds"] = t.seconds
+        for name, g in gauges.items():
+            out[name] = g.value
+        for name, h in histograms.items():
+            count = h.count
+            if not count:
+                continue
+            out[name + ".count"] = float(count)
+            mean = h.mean
+            if mean is not None:
+                out[name + ".mean"] = mean
+            for q, label in _SNAPSHOT_QUANTILES:
+                v = h.quantile(q)
+                if v is not None:
+                    out[f"{name}.{label}"] = v
         return out
 
     def images_per_sec(self) -> Optional[float]:
@@ -128,6 +244,8 @@ class MetricsRegistry:
         with self._lock:
             self._counters.clear()
             self._timers.clear()
+            self._gauges.clear()
+            self._histograms.clear()
 
 
 #: the process-wide registry

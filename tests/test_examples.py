@@ -72,6 +72,13 @@ def test_distributed_finetune_example(tmp_path):
 
 
 @pytest.mark.slow
+def test_online_serving_example():
+    out = _run_example("online_serving.py")
+    assert "online serving OK" in out
+    assert "served 24 requests" in out
+
+
+@pytest.mark.slow
 def test_sql_analytics_example():
     out = _run_example("sql_analytics.py")
     assert "sql analytics OK" in out

@@ -1,4 +1,6 @@
-"""Fault injection: SIGKILL a training run mid-job, restart, resume.
+"""Fault injection: SIGKILL a training run mid-job, restart, resume —
+plus the online-serving failure modes (worker crash, deadline expiry,
+queue-full shedding).
 
 SURVEY.md §5.3: the reference had *no* training recovery at all (driver-local
 ``model.fit``); Spark only protected inference jobs.  Here mid-training
@@ -9,6 +11,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -139,3 +142,132 @@ def test_sigkill_mid_training_then_resume(tmp_path, caplog):
     assert any(
         "resuming from checkpoint" in r.message for r in caplog.records
     ), "restart did not resume from the killed run's checkpoint"
+
+
+# ---------------------------------------------------------------------------
+# online serving faults: every failure mode must surface as a TYPED error
+# on the affected request's future, leave the worker serving, and keep the
+# serving.* metrics coherent.  compile=False registration runs the forward
+# as plain Python, which is what makes blocking/raising forwards
+# deterministic here.
+# ---------------------------------------------------------------------------
+
+
+class TestServingFaults:
+    @pytest.fixture(autouse=True)
+    def fresh_metrics(self):
+        from sparkdl_tpu.utils.metrics import metrics
+
+        metrics.reset()
+        yield
+        metrics.reset()
+
+    def _blocked_server(self, **config_kw):
+        """A server whose worker parks inside the forward until released:
+        the deterministic way to hold requests in the queue."""
+        from sparkdl_tpu.serving import ModelServer, ServingConfig
+
+        started = threading.Event()
+        release = threading.Event()
+
+        def blocking_forward(x):
+            started.set()
+            assert release.wait(timeout=30.0), "test never released worker"
+            return x
+
+        cfg = ServingConfig(**{
+            "max_batch": 1, "max_wait_ms": 0.0, "queue_capacity": 2,
+            **config_kw,
+        })
+        server = ModelServer(cfg)
+        server.register(
+            "blocky", blocking_forward, item_shape=(2,), compile=False
+        )
+        return server, started, release
+
+    def test_worker_survives_forward_crash(self):
+        from sparkdl_tpu.serving import ModelServer, ServingConfig
+        from sparkdl_tpu.utils.metrics import metrics
+
+        boom = {"on": True}
+
+        def flaky_forward(x):
+            if boom["on"]:
+                raise RuntimeError("injected model crash")
+            return x * 2.0
+
+        with ModelServer(ServingConfig(max_wait_ms=1.0)) as server:
+            server.register(
+                "flaky", flaky_forward, item_shape=(2,), compile=False
+            )
+            fut = server.submit(np.ones((2,), np.float32))
+            # the crash lands on the request's future, not the worker
+            with pytest.raises(RuntimeError, match="injected model crash"):
+                fut.result(timeout=30.0)
+            assert metrics.counter("serving.errors").value == 1
+
+            # the worker survived and the endpoint keeps serving
+            boom["on"] = False
+            out = server.predict(np.ones((2,), np.float32), timeout=30.0)
+            np.testing.assert_allclose(out, 2.0)
+            ep = server.status()["endpoints"]["flaky"]
+            assert ep["worker_alive"]
+        snap = metrics.snapshot()
+        assert snap["serving.requests"] == 2
+        assert snap["serving.batches"] == 1  # only the good batch counts
+
+    def test_deadline_expiry_mid_queue(self):
+        from sparkdl_tpu.serving import DeadlineExceeded
+        from sparkdl_tpu.utils.metrics import metrics
+
+        server, started, release = self._blocked_server()
+        try:
+            first = server.submit(np.zeros((2,), np.float32))
+            assert started.wait(timeout=30.0)
+            # worker is parked inside request 1; request 2 waits behind it
+            # with a deadline that expires before the worker frees up
+            doomed = server.submit(
+                np.zeros((2,), np.float32), deadline_ms=20.0
+            )
+            time.sleep(0.05)
+            release.set()
+            first.result(timeout=30.0)
+            with pytest.raises(DeadlineExceeded, match="expired"):
+                doomed.result(timeout=30.0)
+            assert metrics.counter("serving.expired").value == 1
+            # expired requests never reach the model: no error counted
+            assert metrics.counter("serving.errors").value == 0
+        finally:
+            release.set()
+            server.close()
+
+    def test_queue_full_sheds_with_typed_error(self):
+        from sparkdl_tpu.serving import ServerOverloaded
+        from sparkdl_tpu.utils.metrics import metrics
+
+        server, started, release = self._blocked_server(queue_capacity=2)
+        try:
+            first = server.submit(np.zeros((2,), np.float32))
+            assert started.wait(timeout=30.0)
+            # worker busy; the bounded queue admits exactly its capacity
+            queued = [
+                server.submit(np.full((2,), float(i), np.float32))
+                for i in range(2)
+            ]
+            with pytest.raises(ServerOverloaded, match="load-shedding"):
+                server.submit(np.zeros((2,), np.float32))
+            assert metrics.counter("serving.shed").value == 1
+            assert metrics.gauge("serving.queue_depth.blocky").value == 2
+
+            # shedding didn't corrupt anything: release and drain
+            release.set()
+            first.result(timeout=30.0)
+            for i, f in enumerate(queued):
+                np.testing.assert_allclose(f.result(timeout=30.0), float(i))
+            snap = metrics.snapshot()
+            # the shed request still counted as admitted traffic pressure
+            assert snap["serving.requests"] == 4
+            assert snap["serving.queue_depth.blocky"] == 0
+        finally:
+            release.set()
+            server.close()

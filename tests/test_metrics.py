@@ -33,6 +33,52 @@ def test_counter_and_timer_accumulate():
     assert reg.snapshot() == {}
 
 
+def test_gauge_set_add_and_snapshot():
+    reg = MetricsRegistry()
+    g = reg.gauge("depth")
+    g.set(5)
+    g.add(-2)
+    assert reg.gauge("depth").value == 3
+    assert reg.snapshot()["depth"] == 3
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+def test_histogram_quantiles_and_lifetime_stats():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat")
+    for v in range(1, 101):  # 1..100
+        h.observe(float(v))
+    assert h.count == 100
+    assert h.total == sum(range(1, 101))
+    assert h.mean == pytest.approx(50.5)
+    assert h.quantile(0.0) == 1.0
+    assert h.quantile(1.0) == 100.0
+    assert h.quantile(0.5) == pytest.approx(50.5)
+    assert h.quantile(0.95) == pytest.approx(95.05)
+    snap = reg.snapshot()
+    assert snap["lat.count"] == 100
+    assert snap["lat.p50"] == pytest.approx(50.5)
+    assert snap["lat.p95"] <= snap["lat.p99"]
+
+
+def test_histogram_sliding_window_vs_lifetime():
+    # quantiles reflect the recent window; count/mean are lifetime
+    reg = MetricsRegistry()
+    h = reg.histogram("w", window=4)
+    for v in (1000.0, 1000.0, 1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    assert h.count == 6  # lifetime
+    assert h.quantile(1.0) == 4.0  # the 1000s rolled out of the window
+
+
+def test_empty_histogram_not_exported():
+    reg = MetricsRegistry()
+    h = reg.histogram("never")
+    assert h.quantile(0.5) is None
+    assert "never.count" not in reg.snapshot()
+
+
 def test_counters_thread_safe():
     reg = MetricsRegistry()
 

@@ -1,0 +1,360 @@
+"""Online serving tests: dynamic micro-batching, warm program cache,
+admission control, and ``serving.*`` metrics.
+
+Acceptance shape (ISSUE): N concurrent single-item submissions coalesce
+into far fewer forward calls (proved via ``serving.batches``); a warmed
+endpoint serves a burst with zero new compiles (``serving.compiles``);
+latency quantiles and batch occupancy export through
+:mod:`sparkdl_tpu.utils.metrics`.  Load-shedding / deadline / crash
+behavior lives in ``test_fault_injection.py``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.serving import (
+    ModelServer,
+    ServerClosed,
+    ServingConfig,
+)
+from sparkdl_tpu.transformers.utils import (
+    bucket_ladder,
+    pad_to_batch,
+    shape_bucket,
+)
+from sparkdl_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    """Serving assertions count metric deltas from zero."""
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def make_server(**config_kw):
+    cfg = ServingConfig(**{
+        "max_batch": 16, "max_wait_ms": 25.0, "queue_capacity": 64,
+        **config_kw,
+    })
+    server = ModelServer(cfg)
+    server.register("double", lambda x: x * 2.0, item_shape=(4,))
+    return server
+
+
+# ----------------------------------------------------------------------
+# batching core (factored out of transformers/utils.py's run loops)
+# ----------------------------------------------------------------------
+class TestBatchingCore:
+    def test_shape_bucket_rounds_to_power_of_two(self):
+        assert [shape_bucket(n, 32) for n in (1, 2, 3, 5, 8, 9, 31)] == [
+            1, 2, 4, 8, 8, 16, 32,
+        ]
+
+    def test_shape_bucket_caps_at_max_batch(self):
+        assert shape_bucket(33, 32) == 32
+        assert shape_bucket(6, 6) == 6
+
+    def test_shape_bucket_rejects_non_positive(self):
+        with pytest.raises(ValueError):
+            shape_bucket(0, 32)
+
+    def test_bucket_ladder(self):
+        assert bucket_ladder(32) == (1, 2, 4, 8, 16, 32)
+        assert bucket_ladder(6) == (1, 2, 4, 6)
+        assert bucket_ladder(1) == (1,)
+
+    def test_pad_to_batch_repeats_last_row(self):
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        padded = pad_to_batch(x, 5)
+        assert padded.shape == (5, 2)
+        np.testing.assert_array_equal(padded[:3], x)
+        np.testing.assert_array_equal(padded[3], x[-1])
+        np.testing.assert_array_equal(padded[4], x[-1])
+
+    def test_pad_to_batch_noop_when_full(self):
+        x = np.zeros((4, 2), np.float32)
+        assert pad_to_batch(x, 4) is x
+        assert pad_to_batch(x, 2) is x
+
+
+# ----------------------------------------------------------------------
+# coalescing + warm cache (the tentpole acceptance tests)
+# ----------------------------------------------------------------------
+class TestCoalescing:
+    def test_concurrent_submissions_coalesce(self):
+        """N concurrent single-item submissions land in ≪ N forward
+        calls — the whole point of the micro-batcher."""
+        n = 16
+        with make_server(max_wait_ms=50.0) as server:
+            server.warmup()
+            batches_before = metrics.counter("serving.batches").value
+
+            barrier = threading.Barrier(n)
+            results = [None] * n
+
+            def one(i):
+                barrier.wait()
+                results[i] = server.predict(
+                    np.full((4,), float(i), np.float32), timeout=30.0
+                )
+
+            threads = [
+                threading.Thread(target=one, args=(i,)) for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+            for i in range(n):
+                np.testing.assert_allclose(results[i], 2.0 * i)
+            batches = metrics.counter("serving.batches").value - batches_before
+            assert metrics.counter("serving.requests").value == n
+            # all n arrive within one 50ms linger window; typical is 1-3
+            # batches, and anything ≥ n/2 means no coalescing happened
+            assert 1 <= batches < n / 2, f"{n} requests took {batches} batches"
+
+    def test_zero_recompiles_after_warmup(self):
+        with make_server() as server:
+            assert server.warmup() == {"double": (1, 2, 4, 8, 16)}
+            compiles = metrics.counter("serving.compiles").value
+            assert compiles == 5  # one program per ladder bucket
+            # bursts of every size bucket differently; none may retrace
+            for burst in (1, 3, 7, 16):
+                futs = [
+                    server.submit(np.full((4,), float(i), np.float32))
+                    for i in range(burst)
+                ]
+                for i, f in enumerate(futs):
+                    np.testing.assert_allclose(f.result(30.0), 2.0 * i)
+            assert metrics.counter("serving.compiles").value == compiles
+
+    def test_results_unscrambled_across_batches(self):
+        """Padding and bucketing must never leak a neighbor's row."""
+        with make_server(max_batch=4, max_wait_ms=5.0) as server:
+            futs = [
+                server.submit(np.full((4,), float(i), np.float32))
+                for i in range(23)
+            ]
+            for i, f in enumerate(futs):
+                np.testing.assert_allclose(f.result(30.0), 2.0 * i)
+
+
+class TestMetricsExport:
+    def test_latency_quantiles_and_occupancy_exported(self):
+        with make_server() as server:
+            server.warmup()
+            futs = [
+                server.submit(np.ones((4,), np.float32)) for _ in range(12)
+            ]
+            for f in futs:
+                f.result(30.0)
+            snap = server.status()["metrics"]
+        for q in ("p50", "p95", "p99", "mean", "count"):
+            assert f"serving.latency_ms.{q}" in snap
+        assert snap["serving.latency_ms.count"] == 12
+        assert (
+            snap["serving.latency_ms.p50"]
+            <= snap["serving.latency_ms.p95"]
+            <= snap["serving.latency_ms.p99"]
+        )
+        assert 0.0 < snap["serving.batch_occupancy.mean"] <= 1.0
+        assert snap["serving.queue_depth.double"] == 0
+        assert snap["serving.requests"] == 12
+
+    def test_status_shape(self):
+        server = make_server()
+        try:
+            st = server.status()
+            assert st["healthy"] and not st["closed"]
+            assert st["uptime_s"] >= 0
+            ep = st["endpoints"]["double"]
+            assert ep["item_shape"] == [4] and ep["dtype"] == "float32"
+            assert st["program_cache"]["programs"] == 0  # nothing traced
+        finally:
+            server.close()
+        assert server.status()["closed"]
+
+    @pytest.mark.slow
+    def test_status_probe_device(self):
+        """probe_device=True runs the bounded out-of-process liveness
+        probe (utils/probes.py) — healthy on a working backend."""
+        with make_server() as server:
+            st = server.status(probe_device=True, probe_timeout_s=120)
+        assert st["device"]["ok"], st["device"]
+        assert st["healthy"]
+
+
+@pytest.mark.slow
+def test_sustained_soak_no_recompiles_no_leaks():
+    """~6s of sustained concurrent traffic: zero post-warmup compiles,
+    zero sheds at a sane queue size, queue drains to empty, and lifetime
+    counters stay coherent (requests == latency observations)."""
+    import time
+
+    with make_server(max_batch=8, max_wait_ms=2.0,
+                     queue_capacity=256) as server:
+        server.warmup()
+        compiles = metrics.counter("serving.compiles").value
+        stop = threading.Event()
+        served = [0] * 8
+
+        def client(i):
+            x = np.full((4,), float(i), np.float32)
+            while not stop.is_set():
+                np.testing.assert_allclose(
+                    server.predict(x, timeout=30.0), 2.0 * i
+                )
+                served[i] += 1
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(6.0)
+        stop.set()
+        for t in threads:
+            t.join()
+
+        snap = server.status()["metrics"]
+        total = sum(served)
+        assert total > 100
+        assert metrics.counter("serving.compiles").value == compiles
+        assert snap["serving.requests"] == total
+        assert snap["serving.latency_ms.count"] == total
+        assert snap["serving.shed"] == 0
+        assert snap["serving.queue_depth.double"] == 0
+
+
+# ----------------------------------------------------------------------
+# endpoint contract / lifecycle
+# ----------------------------------------------------------------------
+class TestEndpointContract:
+    def test_duplicate_register_rejected(self):
+        with make_server() as server:
+            with pytest.raises(ValueError, match="already registered"):
+                server.register("double", lambda x: x)
+
+    def test_item_shape_is_enforced(self):
+        with make_server() as server:
+            server.predict(np.ones((4,), np.float32), timeout=30.0)
+            with pytest.raises(ValueError, match="shape"):
+                server.submit(np.ones((5,), np.float32))
+
+    def test_first_request_binds_shape(self):
+        with ModelServer(ServingConfig(max_wait_ms=1.0)) as server:
+            server.register("id", lambda x: x)  # no item_shape
+            with pytest.raises(ValueError, match="no item shape"):
+                server.warmup()
+            out = server.predict(np.ones((3,), np.float32), timeout=30.0)
+            np.testing.assert_allclose(out, 1.0)
+            with pytest.raises(ValueError, match="shape"):
+                server.submit(np.ones((7,), np.float32))
+
+    def test_model_id_routing(self):
+        with make_server() as server:
+            server.register("triple", lambda x: x * 3.0, item_shape=(4,))
+            with pytest.raises(ValueError, match="model_id is required"):
+                server.submit(np.ones((4,), np.float32))
+            out = server.predict(
+                np.ones((4,), np.float32), model_id="triple", timeout=30.0
+            )
+            np.testing.assert_allclose(out, 3.0)
+            with pytest.raises(KeyError, match="nope"):
+                server.submit(np.ones((4,), np.float32), model_id="nope")
+
+    def test_submit_after_close_raises(self):
+        server = make_server()
+        server.close()
+        with pytest.raises(ServerClosed):
+            server.submit(np.ones((4,), np.float32))
+
+    def test_program_cache_lru_eviction(self):
+        # cache_size=2 with a 3-bucket ladder: warmup itself evicts, and
+        # the evicted bucket retraces on demand (bounded memory, still
+        # correct)
+        with ModelServer(
+            ServingConfig(max_batch=4, max_wait_ms=1.0, cache_size=2)
+        ) as server:
+            server.register("d", lambda x: x * 2.0, item_shape=(2,))
+            server.warmup()  # traces buckets 1, 2, 4 through a 2-slot LRU
+            assert server.status()["program_cache"]["programs"] == 2
+            out = server.predict(np.ones((2,), np.float32), timeout=30.0)
+            np.testing.assert_allclose(out, 2.0)
+
+
+# ----------------------------------------------------------------------
+# constructors: XlaFunction / registered-UDF round trips
+# ----------------------------------------------------------------------
+class TestConstructors:
+    def test_from_xla_function(self):
+        from sparkdl_tpu.graph.function import XlaFunction
+
+        w = np.arange(12, dtype=np.float32).reshape(4, 3)
+        fn = XlaFunction(
+            lambda p, x: x @ p["w"], params={"w": w}, name="linear"
+        )
+        fn.input_specs = [((8, 4), np.float32)]
+        with ModelServer.from_xla_function(
+            fn, config=ServingConfig(max_wait_ms=1.0)
+        ) as server:
+            assert server.warmup() == {"linear": (1, 2, 4, 8, 16, 32)}
+            x = np.ones((4,), np.float32)
+            np.testing.assert_allclose(
+                server.predict(x, timeout=30.0), x @ w, rtol=1e-6
+            )
+
+    def test_from_registered_udf_serves_model_udf(self, tpu_session):
+        keras = pytest.importorskip("keras")
+
+        rng = np.random.RandomState(3)
+        model = keras.Sequential(
+            [
+                keras.layers.Input((8, 8, 3)),
+                keras.layers.Conv2D(2, 3, activation="relu"),
+                keras.layers.GlobalAveragePooling2D(),
+                keras.layers.Dense(3),
+            ]
+        )
+        model.set_weights(
+            [
+                rng.randn(*w.shape).astype(np.float32) * 0.1
+                for w in model.get_weights()
+            ]
+        )
+        from sparkdl_tpu.udf import registerKerasImageUDF
+
+        udf = registerKerasImageUDF(
+            "serving_rt_udf", model, session=tpu_session
+        )
+        # the serving hook survives the registry's re-wrap
+        meta = tpu_session.udf.get("serving_rt_udf")._serving_endpoint
+        assert meta["model_id"] == "serving_rt_udf"
+        assert meta["item_shape"] == (8, 8, 3)
+        assert udf._serving_endpoint["item_shape"] == (8, 8, 3)
+
+        with ModelServer.from_registered_udf(
+            "serving_rt_udf",
+            session=tpu_session,
+            config=ServingConfig(max_batch=4, max_wait_ms=1.0),
+        ) as server:
+            server.warmup(buckets=(1, 2))
+            x = rng.rand(8, 8, 3).astype(np.float32) * 255.0
+            got = server.predict(x, timeout=60.0)
+            want = np.asarray(model(x[None].astype(np.float32)))[0]
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_from_registered_udf_rejects_plain_udf(self, tpu_session):
+        tpu_session.udf.register("plain_py_udf", lambda x: x)
+        try:
+            with pytest.raises(ValueError, match="registerKerasImageUDF"):
+                ModelServer.from_registered_udf(
+                    "plain_py_udf", session=tpu_session
+                )
+        finally:
+            del tpu_session.udf._udfs["plain_py_udf"]

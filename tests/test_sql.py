@@ -361,9 +361,22 @@ class TestGroupByAggregates:
         self, gdf, tpu_session
     ):
         tpu_session.udf.register("min", lambda x: x * 10)
-        out = tpu_session.sql("SELECT min(score) AS m FROM agg_t LIMIT 3")
-        assert [r.m for r in out.collect()] == [0.0, 10.0, 20.0]
-        # inside GROUP BY, SQL aggregate semantics win
+        try:
+            out = tpu_session.sql(
+                "SELECT min(score) AS m FROM agg_t LIMIT 3"
+            )
+            assert [r.m for r in out.collect()] == [0.0, 10.0, 20.0]
+            # inside GROUP BY the call is ambiguous (SQL aggregate vs the
+            # registered per-row UDF) — it used to silently resolve to the
+            # aggregate; now it must refuse
+            with pytest.raises(ValueError, match="ambiguous"):
+                tpu_session.sql(
+                    "SELECT MIN(score) AS m FROM agg_t "
+                    "GROUP BY label ORDER BY m"
+                )
+        finally:
+            del tpu_session.udf._udfs["min"]
+        # with the UDF gone the aggregate resolves again
         out2 = tpu_session.sql(
             "SELECT MIN(score) AS m FROM agg_t GROUP BY label ORDER BY m"
         ).collect()
@@ -1764,6 +1777,25 @@ class TestAggregateWindows:
             4: (None, -1.0),
         }
 
+    def test_lag_lead_default_type_checked(self, tpu_session, view):
+        # a default literal that cannot live in the value column's
+        # declared type must be rejected up front, not silently mixed in
+        with pytest.raises(ValueError, match="not compatible"):
+            tpu_session.sql(
+                "SELECT LEAD(score, 1, 'oops') OVER (ORDER BY i) AS nxt "
+                "FROM aw_t"
+            )
+        with pytest.raises(ValueError, match="not compatible"):
+            tpu_session.sql(
+                "SELECT LAG(i, 1, 2.5) OVER (ORDER BY i) AS p FROM aw_t"
+            )
+        # int literal into a DOUBLE column widens fine
+        rows = tpu_session.sql(
+            "SELECT i, LEAD(score, 1, -1) OVER (ORDER BY i) AS nxt "
+            "FROM aw_t"
+        ).collect()
+        assert {r.nxt for r in rows if r.i == 4} == {-1}
+
     def test_lag_offset_two(self, tpu_session, view):
         rows = tpu_session.sql(
             "SELECT i, LAG(score, 2) OVER (ORDER BY i) AS p2 FROM aw_t"
@@ -2480,6 +2512,42 @@ class TestRankFamilyAndExists:
             "SELECT FIRST(x) AS f FROM fn_t GROUP BY k"
         ).collect()[0]
         assert row.f == 2.0  # ignorenulls semantics, documented
+
+    def test_first_last_ignorenulls_argument(self, tpu_session, view):
+        # Spark's two-arg spelling: true matches engine semantics and is
+        # accepted; false (Spark's default!) cannot be honoured — the
+        # engine pre-filters NULLs — so it must fail loudly
+        rows = tpu_session.sql(
+            "SELECT k, FIRST(x, true) AS f, LAST(x, TRUE) AS l "
+            "FROM rf_t GROUP BY k ORDER BY k"
+        ).collect()
+        assert [(r.k, r.f, r.l) for r in rows] == [
+            ("a", 1.0, 6.0), ("b", 1.0, 1.0),
+        ]
+        with pytest.raises(NotImplementedError, match="ignoreNulls"):
+            tpu_session.sql(
+                "SELECT FIRST(x, false) AS f FROM rf_t GROUP BY k"
+            )
+        with pytest.raises(NotImplementedError, match="ignoreNulls"):
+            tpu_session.sql(
+                "SELECT LAST(x, false) AS f FROM rf_t GROUP BY k"
+            )
+
+    def test_first_last_ignorenulls_python_api(self, tpu_session, view):
+        import sparkdl_tpu.sql.functions as F
+
+        df = tpu_session.table("rf_t")
+        row = (
+            df.groupBy("k")
+            .agg(F.first("x", ignorenulls=True))
+            .orderBy("k")
+            .collect()[0]
+        )
+        assert row["first(x)"] == 1.0
+        with pytest.raises(NotImplementedError, match="ignorenulls"):
+            F.first("x", ignorenulls=False)
+        with pytest.raises(NotImplementedError, match="ignorenulls"):
+            F.last("x", ignorenulls=False)
 
     def test_exists_and_not_exists(self, tpu_session, view):
         assert tpu_session.sql(
