@@ -42,6 +42,9 @@ _EXPORTS = {
     "registerKerasImageUDF": "sparkdl_tpu.udf.keras_image_model",
     "makeGraphUDF": "sparkdl_tpu.graph.tensorframes_udf",
     "TPUSession": "sparkdl_tpu.sql.session",
+    "Batch": "sparkdl_tpu.data",
+    "Dataset": "sparkdl_tpu.data",
+    "ImageDecodeError": "sparkdl_tpu.image.imageIO",
     "ModelServer": "sparkdl_tpu.serving",
     "ServingConfig": "sparkdl_tpu.serving",
     "ServerOverloaded": "sparkdl_tpu.serving",

@@ -1,0 +1,32 @@
+"""sparkdl_tpu.data — composable async input pipelines (prefetch-to-device).
+
+Every consumer in the engine used to hand-roll its own ingest: the
+estimators' ``StreamingShardLoader`` producer thread, the transformer run
+loops' inline partition load/group/resize, ``imageIO``'s silent corrupt-row
+drops.  This package is the one implementation (the tf.data idea — arxiv
+2101.12127 — applied to this engine): a lazy :class:`Dataset` graph of
+sources (:meth:`Dataset.from_uris` / :meth:`Dataset.from_dataframe` /
+:meth:`Dataset.from_arrays`) and operators —
+
+- ``map`` — per-item transform, optionally threaded (ordered, bounded);
+- ``shuffle`` — seeded, reproducing the estimators' permutation stream;
+- ``shard`` — per-host strided split (GSPMD-style first-class stage);
+- ``batch`` — fixed-size with the estimators' cyclic-pad policy;
+- ``prefetch`` — bounded background queue, clean shutdown on close;
+- ``prefetch_to_device`` — double-buffered ``device_put`` overlapping
+  host→device transfer with the previous step's compute.
+
+Instrumented with ``data.*`` metrics (rows/sec, queue depth, device-stall
+histogram) via :mod:`sparkdl_tpu.utils.metrics`.
+"""
+
+from sparkdl_tpu.data.dataset import Batch, Dataset
+from sparkdl_tpu.data.prefetch import PrefetchIterator
+from sparkdl_tpu.data.device import default_device_placer
+
+__all__ = [
+    "Batch",
+    "Dataset",
+    "PrefetchIterator",
+    "default_device_placer",
+]

@@ -1,0 +1,85 @@
+"""Host→device placement for the pipeline's terminal stage.
+
+``Dataset.prefetch_to_device`` needs one callable that moves a host batch
+(arrays, or pytrees of arrays — the estimator ``{"x", "y", "w"}`` dicts)
+onto the accelerator and returns immediately (jax dispatch is async), so
+the next batch's transfer overlaps the consumer's compute on the current
+one.  :func:`default_device_placer` builds that callable:
+
+- under a live inference mesh (:func:`transformers.utils.data_parallel_mesh`
+  with >1 device), batches are sharded along their leading dim with
+  ``NamedSharding(mesh, P("data"))`` — the same placement the transformer
+  run loops use;
+- single-device (or explicitly meshless), a plain ``jax.device_put``.
+
+Training paths that already hold a mesh pass
+``place=partial(shard_batch, mesh=mesh)`` (see ``parallel.trainer``)
+instead of relying on the default.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from sparkdl_tpu.data.dataset import Batch
+
+
+def _tree_place(batch, put: Callable[[Any], Any]):
+    """Apply ``put`` to every array leaf; ``Batch`` wrappers keep their
+    ``n_real`` on the host (it drives masking math, not device compute)."""
+    import jax
+
+    if isinstance(batch, Batch):
+        return Batch(_tree_place(batch.items, put), batch.n_real)
+    return jax.tree_util.tree_map(put, batch)
+
+
+def default_device_placer(
+    mesh: Optional[Any] = None, axis: str = "data"
+) -> Callable[[Any], Any]:
+    """Build ``place(batch) -> batch_on_device``.
+
+    ``mesh=None`` resolves the process inference mesh once, at build time
+    (not per batch): :func:`transformers.utils.data_parallel_mesh`.  Any
+    resolved mesh with more than one device shards the leading dim along
+    ``axis``; otherwise plain ``device_put``.
+    """
+    import jax
+
+    if mesh is None:
+        from sparkdl_tpu.transformers.utils import data_parallel_mesh
+
+        mesh = data_parallel_mesh()
+
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n_dev = int(mesh.size)
+
+        def put(x):
+            arr = _as_array(x)
+            ndim = getattr(arr, "ndim", 0)
+            # leading dim must split evenly across the mesh; callers that
+            # didn't mesh-round their batch (small eval sets, ragged last
+            # chunks) still get on device, just unsharded
+            if not ndim or arr.shape[0] % n_dev:
+                return jax.device_put(arr)
+            return jax.device_put(
+                arr,
+                NamedSharding(mesh, P(*([axis] + [None] * (ndim - 1)))),
+            )
+
+    else:
+
+        def put(x):
+            return jax.device_put(_as_array(x))
+
+    return lambda batch: _tree_place(batch, put)
+
+
+def _as_array(x):
+    import numpy as np
+
+    if isinstance(x, np.ndarray) or hasattr(x, "ndim"):
+        return x
+    return np.asarray(x)

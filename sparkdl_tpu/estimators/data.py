@@ -50,7 +50,8 @@ class StreamingShardLoader:
     ``_getNumpyFeaturesAndLabels``† behavior); for datasets that don't
     fit in host RAM this loader materializes one batch at a time, with a
     background thread prefetching the next batches while the device
-    steps.
+    steps.  Built on :mod:`sparkdl_tpu.data` — see :meth:`dataset` for
+    the pipeline (``from_arrays → batch → load → prefetch``).
 
     Determinism contract: given the same (seed, epoch) it reproduces the
     exact batch composition of the in-memory path — same permutation
@@ -87,70 +88,94 @@ class StreamingShardLoader:
             batch["w"] = w
         return batch
 
+    def dataset(self, order: np.ndarray, steps: int) -> "Dataset":
+        """The epoch as a :class:`sparkdl_tpu.data.Dataset` pipeline:
+        ``from_arrays(order).batch(local_bs, pad="cyclic",
+        min_batches=steps)`` — bit-identical batch composition to the
+        in-memory ``_fit`` loop — then a load stage owning the intra-batch
+        thread pool, then ``prefetch``.
+
+        The pool lives exactly one iteration: it is created when the
+        pipeline starts and shut down when the load stage closes (the
+        ``prefetch`` producer closes its upstream chain on cancel or
+        exhaustion), so an abandoned epoch leaks neither threads nor the
+        pool."""
+        from sparkdl_tpu.data import Dataset
+
+        batches = Dataset.from_arrays(np.asarray(order)).batch(
+            self.local_bs, pad="cyclic", min_batches=steps
+        )
+
+        def loaded():
+            it = iter(batches)
+            with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+                try:
+                    for b in it:
+                        idx = np.asarray(b.items, dtype=np.int64)
+                        yield self._load_batch(pool, idx, b.n_real)
+                finally:
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        close()
+
+        return Dataset(loaded, length=steps, name="load").prefetch(
+            self.prefetch
+        )
+
     def epoch(self, order: np.ndarray, steps: int):
         """Yield ``steps`` batches following ``order`` (the epoch
-        permutation), cyclically padded exactly like the in-memory path."""
-        import queue
-        import threading
+        permutation), cyclically padded exactly like the in-memory path.
 
-        plan = []
-        for step_i in range(steps):
-            idx = order[step_i * self.local_bs:(step_i + 1) * self.local_bs]
-            k = len(idx)
-            if k < self.local_bs:
-                idx = np.concatenate(
-                    [idx, np.resize(order, self.local_bs - k)]
-                )
-            plan.append((idx, k))
-
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        err: List[BaseException] = []
-        stop = threading.Event()
-
-        def put(item) -> bool:
-            # bounded put that gives up when the consumer is gone, so an
-            # abandoned epoch (step error / generator close) can't leave
-            # the producer blocked forever holding its pool and batches
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def producer():
-            try:
-                with ThreadPoolExecutor(
-                    max_workers=self.max_workers
-                ) as pool:
-                    for idx, k in plan:
-                        if not put(self._load_batch(pool, idx, k)):
-                            return
-            except BaseException as e:  # surfaced on the consumer side
-                err.append(e)
-            finally:
-                put(None)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
+        The background queue that used to live here (0.1 s spin-poll put,
+        droppable ``None`` sentinel) is now the ``prefetch`` operator of
+        :mod:`sparkdl_tpu.data` — closing this generator early cancels the
+        producer and joins its thread (pinned by
+        ``tests/test_data_pipeline.py``)."""
         produced = 0
+        it = iter(self.dataset(order, steps))
         try:
-            while True:
-                item = q.get()
-                if item is None:
-                    break
+            for batch in it:
                 produced += 1
-                yield item
+                yield batch
+                if produced == steps:
+                    break
         finally:
-            stop.set()
-            t.join()
-        if err:
-            raise err[0]
+            it.close()
         if produced != steps:
             raise RuntimeError(
                 f"streaming loader produced {produced}/{steps} batches"
             )
+
+
+def in_memory_epoch_dataset(
+    order: np.ndarray,
+    x: np.ndarray,
+    y: np.ndarray,
+    local_bs: int,
+    steps: int,
+    weighted: bool,
+):
+    """One in-memory ``_fit`` epoch as a :class:`sparkdl_tpu.data.Dataset`:
+    the epoch permutation batched with the cyclic-pad policy (identical
+    composition to :class:`StreamingShardLoader` — the determinism
+    contract), then a gather stage materializing ``{"x", "y"[, "w"]}`` from
+    the preloaded shard.  Pad rows carry zero weight when ``weighted``."""
+    from sparkdl_tpu.data import Dataset
+
+    def gather(b):
+        idx = np.asarray(b.items, dtype=np.int64)
+        batch = {"x": x[idx], "y": y[idx]}
+        if weighted:
+            w = np.zeros(int(local_bs), np.float32)
+            w[: b.n_real] = 1.0
+            batch["w"] = w
+        return batch
+
+    return (
+        Dataset.from_arrays(np.asarray(order))
+        .batch(int(local_bs), pad="cyclic", min_batches=steps)
+        .map(gather)
+    )
 
 
 def labels_to_array(labels: List[Any]) -> np.ndarray:

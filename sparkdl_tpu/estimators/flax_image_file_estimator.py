@@ -27,7 +27,10 @@ import jax
 import jax.numpy as jnp
 
 from sparkdl_tpu.estimators import checkpointing
-from sparkdl_tpu.estimators.data import load_host_shard
+from sparkdl_tpu.estimators.data import (
+    in_memory_epoch_dataset,
+    load_host_shard,
+)
 from sparkdl_tpu.estimators.losses import (
     get_optimizer,
     get_per_sample_loss_fn,
@@ -452,20 +455,14 @@ class FlaxImageFileEstimator(
         try:
             for epoch in range(start_epoch, epochs):
                 order = rng.permutation(n)
-                for step_i in range(steps_per_epoch):
-                    idx = order[step_i * local_bs : (step_i + 1) * local_bs]
-                    k = len(idx)
-                    if k < local_bs:
-                        # pad cyclically; pad rows carry zero weight, so the
-                        # update is the exact mean over the k real rows
-                        idx = np.concatenate(
-                            [idx, np.resize(order, local_bs - k)]
-                        )
-                    w = np.zeros(local_bs, np.float32)
-                    w[:k] = 1.0
-                    state, loss = step_fn(
-                        state, place_batch({"x": x[idx], "y": y[idx], "w": w})
-                    )
+                # the epoch as a sparkdl_tpu.data Dataset (cyclic-pad batch
+                # composition; pad rows carry zero weight, so the update is
+                # the exact mean over the real rows)
+                epoch_ds = in_memory_epoch_dataset(
+                    order, x, y, local_bs, steps_per_epoch, weighted=True
+                )
+                for batch in epoch_ds:
+                    state, loss = step_fn(state, place_batch(batch))
                 last_loss = float(loss)
                 logger.info(
                     "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss

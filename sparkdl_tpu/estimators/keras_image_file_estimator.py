@@ -33,6 +33,7 @@ from sparkdl_tpu.estimators import checkpointing
 from sparkdl_tpu.estimators.data import (
     StreamingShardLoader,
     collect_host_shard_rows,
+    in_memory_epoch_dataset,
     labels_to_array,
     load_host_shard,
 )
@@ -247,28 +248,20 @@ class KerasImageFileEstimator(
         try:
             for epoch in range(start_epoch, epochs):
                 order = rng.permutation(n)
-                if streaming:
-                    for batch in stream.epoch(order, steps_per_epoch):
-                        state, loss = step_fn(state, place(batch))
-                else:
-                    for step_i in range(steps_per_epoch):
-                        idx = order[step_i * local_bs : (step_i + 1) * local_bs]
-                        k = len(idx)
-                        if k < local_bs:
-                            # pad cyclically to the full local batch so every
-                            # host contributes the same shape (even when n <
-                            # local_bs); with a known loss the pad rows carry
-                            # zero weight, so the update is the exact mean
-                            # over the real rows
-                            idx = np.concatenate(
-                                [idx, np.resize(order, local_bs - k)]
-                            )
-                        batch = {"x": x[idx], "y": y[idx]}
-                        if weighted:
-                            w = np.zeros(local_bs, np.float32)
-                            w[:k] = 1.0
-                            batch["w"] = w
-                        state, loss = step_fn(state, place(batch))
+                # both arms iterate a sparkdl_tpu.data Dataset with the same
+                # batch(pad="cyclic") composition — every host contributes
+                # the same shapes (even when n < local_bs), and with a known
+                # loss the pad rows carry zero weight, so the update is the
+                # exact mean over the real rows
+                epoch_ds = (
+                    stream.dataset(order, steps_per_epoch)
+                    if streaming
+                    else in_memory_epoch_dataset(
+                        order, x, y, local_bs, steps_per_epoch, weighted
+                    )
+                )
+                for batch in epoch_ds:
+                    state, loss = step_fn(state, place(batch))
                 last_loss = float(loss)
                 logger.info(
                     "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss

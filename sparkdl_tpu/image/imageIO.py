@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import glob
 import io
+import logging
 import os
 from collections import namedtuple
 from typing import Callable, List, Optional
@@ -27,6 +28,8 @@ from sparkdl_tpu.sql.types import (
     StructField,
     StructType,
 )
+
+logger = logging.getLogger(__name__)
 
 # ---------------------------------------------------------------------------
 # Schema (Spark ImageSchema-compatible)
@@ -147,6 +150,20 @@ def imageStructToRGBArray(imageRow: Row) -> np.ndarray:
     return arr
 
 
+class ImageDecodeError(ValueError):
+    """A file's bytes could not be decoded into an image.
+
+    Carries ``origin`` (the file path / URI) and the underlying ``cause``
+    so ``on_error="raise"`` callers see *which* input was corrupt, not
+    just a bare PIL traceback."""
+
+    def __init__(self, origin: str, cause: Optional[BaseException] = None):
+        self.origin = origin
+        self.cause = cause
+        detail = f": {cause}" if cause is not None else ""
+        super().__init__(f"cannot decode image {origin!r}{detail}")
+
+
 def _decode_image_bytes(raw: bytes, origin: str = "") -> Optional[Row]:
     """Decode compressed image bytes (PNG/JPEG/...) → image struct, or None
     if undecodable (matching the reference's null-tolerant decode)."""
@@ -228,12 +245,27 @@ def filesToDF(session, path: str, numPartitions: int = 4):
     )
 
 
-def readImages(path: str, session=None, numPartitions: int = 4):
+def readImages(
+    path: str,
+    session=None,
+    numPartitions: int = 4,
+    on_error: str = "skip",
+):
     """Read images from a directory/glob → DataFrame with an ``image``
-    struct column (Spark ``ImageSchema.readImages`` analog; undecodable
-    files are dropped)."""
+    struct column (Spark ``ImageSchema.readImages`` analog).
+
+    ``on_error="skip"`` (the reference's null-tolerant behavior) drops
+    undecodable files — but no longer silently: each drop advances the
+    ``data.decode_errors`` counter and logs the origin.
+    ``on_error="raise"`` fails the read with :class:`ImageDecodeError`
+    naming the corrupt file — for pipelines where a dropped row means a
+    silently wrong join downstream."""
     return readImagesWithCustomFn(
-        path, decode_f=_decode_image_bytes, numPartitions=numPartitions, session=session
+        path,
+        decode_f=_decode_image_bytes,
+        numPartitions=numPartitions,
+        session=session,
+        on_error=on_error,
     )
 
 
@@ -242,19 +274,42 @@ def readImagesWithCustomFn(
     decode_f: Callable[[bytes, str], Optional[Row]],
     numPartitions: int = 4,
     session=None,
+    on_error: str = "skip",
 ):
+    """Like :func:`readImages` with a custom ``decode_f(bytes, origin) ->
+    Optional[Row]``; a None return (or a raise) from ``decode_f`` is a
+    decode failure, handled per ``on_error`` ("skip" counts it in
+    ``data.decode_errors`` and drops the row, "raise" aborts with
+    :class:`ImageDecodeError`)."""
+    if on_error not in ("skip", "raise"):
+        raise ValueError(
+            f'on_error must be "skip" or "raise", got {on_error!r}'
+        )
     from sparkdl_tpu.sql.session import TPUSession
 
     session = session or TPUSession.getActiveSession()
     files_df = filesToDF(session, path, numPartitions=numPartitions)
 
     def decode_partition(part):
+        from sparkdl_tpu.utils.metrics import metrics
+
+        decode_errors = metrics.counter("data.decode_errors")
         images, origins = [], []
         for fp, raw in zip(part["filePath"], part["fileData"]):
-            struct = decode_f(raw, fp)
-            if struct is not None:
-                images.append(struct)
-                origins.append(fp)
+            try:
+                struct = decode_f(raw, fp)
+            except Exception as exc:
+                if on_error == "raise":
+                    raise ImageDecodeError(fp, exc) from exc
+                struct = None
+            if struct is None:
+                if on_error == "raise":
+                    raise ImageDecodeError(fp)
+                decode_errors.add(1)
+                logger.warning("dropping undecodable image %s", fp)
+                continue
+            images.append(struct)
+            origins.append(fp)
         return {"filePath": origins, "image": images}
 
     schema = StructType(

@@ -1,0 +1,38 @@
+"""``shard_map`` across jax versions, one import for the whole package.
+
+jax >= 0.4.38 re-exports ``shard_map`` at top level and (later) renamed the
+replication-check kwarg ``check_rep`` -> ``check_vma``; 0.4.x ships it under
+``jax.experimental.shard_map``.  Every caller in :mod:`sparkdl_tpu.parallel`
+goes through :func:`shard_map` here so the version probe happens once.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the replication-check kwarg spelled whichever
+    way the installed jax expects (``check_vma`` new / ``check_rep`` old).
+
+    On the old API the check defaults OFF: 0.4.x's ``check_rep`` cannot
+    infer replication through ``lax.pmean`` over pytrees (fixed in the
+    ``check_vma`` rewrite), and the check is a static verification only —
+    disabling it changes no numerics.
+    """
+    kwargs = {}
+    if "check_vma" in _PARAMS:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+    else:
+        kwargs["check_rep"] = False if check_vma is None else check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 
 import optax
 
+from sparkdl_tpu.parallel._shard_map import shard_map
 from sparkdl_tpu.parallel.trainer import Mesh
 
 
@@ -63,8 +64,6 @@ def make_keras_train_step(
     (They still pass through the forward, so BN moving stats see them; that
     bias is one padded batch per epoch and vanishes in the average.)
     """
-    n_shards = int(mesh.shape[data_axis])
-
     def step(state: KerasTrainState, batch):
         def sharded(trainable, non_trainable, local_batch):
             def local_loss(tr):
@@ -81,18 +80,22 @@ def make_keras_train_step(
             (loss, new_nt), grads = jax.value_and_grad(
                 local_loss, has_aux=True
             )(trainable)
+            # value_and_grad runs inside the shard_map body, so grads are
+            # shard-local and the cross-device allreduce must be explicit
+            # (see trainer.make_train_step)
             if weighted:
-                # each shard's loss is its share of the global weighted mean;
-                # the replicated-param transpose psums grads over the data
-                # axis, which together with the global w_total normalization
-                # is already the exact weighted-mean gradient
+                # each shard's loss is its share of the global weighted
+                # mean; psum of loss and grads, with the global w_total
+                # normalization, is the exact weighted-mean gradient
                 loss = jax.lax.psum(loss, axis_name=data_axis)
-            else:
-                # replicated-param transpose already psum'd the grads over
-                # the data axis (see trainer.make_train_step); normalize to
-                # the mean
                 grads = jax.tree_util.tree_map(
-                    lambda g: g / n_shards, grads
+                    lambda g: jax.lax.psum(g, axis_name=data_axis), grads
+                )
+            else:
+                # equal-sized shards: mean of per-shard mean-loss grads ==
+                # the global-mean gradient
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, axis_name=data_axis), grads
                 )
                 loss = jax.lax.pmean(loss, axis_name=data_axis)
             # float stats (BN moving averages) averaged across shards;
@@ -108,7 +111,7 @@ def make_keras_train_step(
         batch_spec = jax.tree_util.tree_map(
             lambda x: P(*([data_axis] + [None] * (x.ndim - 1))), batch
         )
-        loss, new_nt, grads = jax.shard_map(
+        loss, new_nt, grads = shard_map(
             sharded,
             mesh=mesh,
             in_specs=(P(), P(), batch_spec),

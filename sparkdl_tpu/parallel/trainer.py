@@ -22,8 +22,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkdl_tpu.parallel._shard_map import shard_map
 
 import optax
 
@@ -156,17 +157,13 @@ def make_train_step(
     (ragged-batch padding) contribute nothing to loss or gradient.
     """
 
-    n_shards = int(mesh.shape[data_axis])
-
     def step(state: TrainState, batch):
         def sharded_grads(params, local_batch):
-            # params enter replicated (in_spec P()), so shard_map's AD
-            # transposes the implicit broadcast into a psum over the data
-            # axis: ``grads`` already carries the cross-device allreduce
-            # (the NCCL-allreduce analog, riding ICI).  Dividing by the
-            # shard count turns the summed per-shard mean-loss grads into
-            # the global-mean gradient.  (Do NOT add lax.pmean here — that
-            # is the pmap-era pattern and double-counts by n_shards.)
+            # value_and_grad runs INSIDE the shard_map body, so ``grads``
+            # are shard-local; the cross-device allreduce (the
+            # NCCL-allreduce analog, riding ICI) must be explicit.  (The
+            # implicit transpose-psum of replicated params only appears
+            # when differentiating *through* a shard_map from outside.)
             if weighted:
 
                 def local_weighted(p):
@@ -176,13 +173,20 @@ def make_train_step(
                     return (per * w).sum() / w_total
 
                 # each shard's loss is its share of the global weighted
-                # mean; the replicated-param transpose psums the grads, so
-                # together with the global w_total this is already exact
+                # mean; psum of both loss and grads, together with the
+                # global w_total normalization, is the exact weighted mean
                 loss, grads = jax.value_and_grad(local_weighted)(params)
                 loss = jax.lax.psum(loss, axis_name=data_axis)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, axis_name=data_axis), grads
+                )
                 return loss, grads
             loss, grads = jax.value_and_grad(loss_fn)(params, local_batch)
-            grads = jax.tree_util.tree_map(lambda g: g / n_shards, grads)
+            # equal-sized shards: mean of per-shard mean-loss grads == the
+            # global-mean gradient
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, axis_name=data_axis), grads
+            )
             loss = jax.lax.pmean(loss, axis_name=data_axis)
             return loss, grads
 

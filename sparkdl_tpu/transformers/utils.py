@@ -17,6 +17,10 @@ rules applied here:
   sharded across it, so the one jitted program runs SPMD over ICI — the
   analog of the reference fanning inference out across Spark executors
   (SURVEY.md §2 "Data-parallel inference").
+
+The load/decode side of the loop — chunking, background prefetch, clean
+shutdown — is :mod:`sparkdl_tpu.data` (see :func:`run_batched_rows`);
+this module owns what happens once a batch is decoded.
 """
 
 from __future__ import annotations
@@ -590,10 +594,12 @@ def run_batched_rows(
     :func:`run_batched_multi`); the ragged final chunk pads by repeating
     its last row, so exactly one batch shape is ever compiled per decode
     shape.  ``SPARKDL_SERIAL_INFERENCE=1`` disables both overlaps.
-    """
-    import queue as queue_mod
-    import threading
 
+    The load/decode prefix is a :mod:`sparkdl_tpu.data` pipeline
+    (``from_items(chunk bounds) → map(decode) → prefetch(2)``), so the
+    background decode thread follows the package's clean-shutdown protocol
+    and feeds the ``data.*`` metrics.
+    """
     from sparkdl_tpu.utils.metrics import metrics
     from sparkdl_tpu.utils.profiler import maybe_trace
 
@@ -620,46 +626,19 @@ def run_batched_rows(
         k = batch.shape[0]
         return pad_to_batch(batch, batch_size), k
 
-    cancel = threading.Event()
     if serial:
         chunk_iter = (decode_chunk(lo, hi) for lo, hi in bounds)
     else:
-        # prefetch thread: maxsize=2 bounds host memory at ~2 extra
-        # chunks; `cancel` (set when the consumer aborts) unblocks the
-        # bounded put so a failed call doesn't leak the thread plus its
-        # decoded chunks
-        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=2)
+        # prefetch(2) bounds host memory at ~2 extra decoded chunks; the
+        # pipeline's close protocol (cancel -> drain -> join) means a
+        # failed call can't leak the decode thread plus its chunks
+        from sparkdl_tpu.data import Dataset
 
-        def _put(item) -> bool:
-            while not cancel.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue_mod.Full:
-                    continue
-            return False
-
-        def producer():
-            try:
-                for lo, hi in bounds:
-                    if not _put(decode_chunk(lo, hi)):
-                        return
-                _put(None)
-            except BaseException as e:  # surfaced in the consumer
-                _put(e)
-
-        threading.Thread(target=producer, daemon=True).start()
-
-        def drain():
-            while True:
-                item = q.get()
-                if item is None:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-
-        chunk_iter = drain()
+        chunk_iter = iter(
+            Dataset.from_items(bounds, name="chunk_bounds")
+            .map(lambda b: decode_chunk(*b))
+            .prefetch(2)
+        )
 
     # (images_processed is advanced by the decode layer — e.g.
     # decode_image_batch — not here, to avoid double counting)
@@ -704,7 +683,9 @@ def run_batched_rows(
                         np.asarray(jax.device_get(r_prev))[:k_prev]
                     )
     finally:
-        cancel.set()
+        close = getattr(chunk_iter, "close", None)
+        if close is not None:
+            close()
     metrics.counter("sparkdl.rows_processed").add(n)
     metrics.counter("sparkdl.batches_run").add(len(bounds))
     return np.concatenate(collected, axis=0)

@@ -69,6 +69,13 @@ class Timer:
                 self._seconds += elapsed
                 self._entries += 1
 
+    def add_seconds(self, elapsed: float) -> None:
+        """Record an externally-measured span (producer threads time their
+        own work and report here; ``time()`` can't wrap a foreign thread)."""
+        with self._lock:
+            self._seconds += elapsed
+            self._entries += 1
+
     @property
     def seconds(self) -> float:
         with self._lock:

@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sparkdl_tpu.parallel._shard_map import shard_map
 from sparkdl_tpu.parallel.context import (
     full_attention,
     make_sp_attention,
@@ -65,7 +66,7 @@ def test_ring_attention_grads_match(seq_mesh):
 
     @jax.jit
     def loss_ring(q, k, v):
-        out = jax.shard_map(
+        out = shard_map(
             lambda a, b, c: ring_attention(a, b, c, axis_name="seq"),
             mesh=seq_mesh,
             in_specs=(spec, spec, spec),
@@ -96,7 +97,7 @@ def test_ulysses_rejects_indivisible_heads(seq_mesh):
     q = jnp.asarray(rng.randn(*shape).astype(np.float32))
     spec = P(None, "seq", None, None)
     with pytest.raises(ValueError, match="divisible"):
-        jax.shard_map(
+        shard_map(
             lambda a, b, c: ulysses_attention(a, b, c, axis_name="seq"),
             mesh=seq_mesh,
             in_specs=(spec, spec, spec),

@@ -1,0 +1,348 @@
+"""sparkdl_tpu.data — the async input-pipeline subsystem.
+
+Pins the three contracts the package exists for:
+
+- operator semantics (ordering, seeded shuffle stream, strided shard,
+  cyclic-pad batching identical to the estimator path);
+- clean shutdown (closing a pipeline mid-stream joins every background
+  thread and shuts worker pools — no leaks, no dropped sentinels);
+- instrumentation (``data.*`` metrics advance).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.data import Batch, Dataset, PrefetchIterator
+from sparkdl_tpu.utils.metrics import metrics
+
+
+def _thread_count():
+    # settle momentarily: dying threads unwind off the active list
+    for _ in range(50):
+        time.sleep(0.01)
+        stable = threading.active_count()
+        time.sleep(0.01)
+        if threading.active_count() == stable:
+            return stable
+    return threading.active_count()
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+
+def test_from_uris_is_lazy_and_reiterable():
+    ds = Dataset.from_uris([f"file:///img_{i}.png" for i in range(5)])
+    assert len(ds) == 5
+    assert list(ds) == list(ds)  # re-iteration replays the source
+
+
+def test_from_arrays_rows_and_tuples():
+    x = np.arange(6).reshape(3, 2)
+    y = np.array([10, 11, 12])
+    rows = list(Dataset.from_arrays(x))
+    assert len(rows) == 3 and np.array_equal(rows[1], [2, 3])
+    pairs = list(Dataset.from_arrays(x, y))
+    assert np.array_equal(pairs[2][0], [4, 5]) and pairs[2][1] == 12
+
+
+def test_from_arrays_rejects_misaligned():
+    with pytest.raises(ValueError, match="aligned"):
+        Dataset.from_arrays(np.zeros(3), np.zeros(4))
+
+
+def test_from_dataframe_columns():
+    from sparkdl_tpu.sql.session import TPUSession
+
+    session = TPUSession.builder.getOrCreate()
+    df = session.createDataFrame(
+        [("a.png", 0), ("b.png", 1), ("c.png", 2)], ["uri", "label"]
+    )
+    ds = Dataset.from_dataframe(df, "uri", "label")
+    assert len(ds) == 3
+    assert list(ds) == [("a.png", 0), ("b.png", 1), ("c.png", 2)]
+    assert list(Dataset.from_dataframe(df, "label")) == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# map
+# ---------------------------------------------------------------------------
+
+
+def test_map_threaded_preserves_order():
+    """Worker latency inversions must not reorder the stream."""
+
+    def slow_when_even(i):
+        time.sleep(0.02 if i % 2 == 0 else 0.0)
+        return i * i
+
+    ds = Dataset.from_items(list(range(16))).map(slow_when_even, num_workers=4)
+    assert list(ds) == [i * i for i in range(16)]
+
+
+def test_map_threaded_shuts_pool_down():
+    before = _thread_count()
+    ds = Dataset.from_items(list(range(64))).map(
+        lambda i: i, num_workers=4
+    )
+    it = iter(ds)
+    next(it)
+    it.close()
+    assert _thread_count() <= before
+
+
+def test_map_propagates_errors():
+    def boom(i):
+        if i == 3:
+            raise RuntimeError("decode failed")
+        return i
+
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(Dataset.from_items(list(range(8))).map(boom, num_workers=2))
+
+
+# ---------------------------------------------------------------------------
+# shuffle — the estimator permutation stream, reproduced
+# ---------------------------------------------------------------------------
+
+
+def test_shuffle_reproduces_estimator_rng_stream():
+    """Epoch e of the dataset == the e-th ``rng.permutation`` draw of a
+    ``RandomState(seed % 2**32)`` — the estimators' exact stream."""
+    seed, n = 1234, 11
+    ds = Dataset.from_arrays(np.arange(n)).shuffle(seed)
+    rng = np.random.RandomState(seed % 2**32)
+    for _ in range(3):  # three epochs, three consecutive draws
+        expect = [int(v) for v in rng.permutation(n)]
+        assert [int(v) for v in ds] == expect
+
+
+# ---------------------------------------------------------------------------
+# shard
+# ---------------------------------------------------------------------------
+
+
+def test_shard_strided_split_partitions_everything():
+    items = list(range(10))
+    shards = [
+        list(Dataset.from_items(items).shard(index=i, count=3))
+        for i in range(3)
+    ]
+    assert shards == [[0, 3, 6, 9], [1, 4, 7], [2, 5, 8]]
+    assert len(Dataset.from_items(items).shard(index=1, count=3)) == 3
+
+
+def test_shard_default_is_identity_when_single_process():
+    assert list(Dataset.from_items([1, 2, 3]).shard()) == [1, 2, 3]
+
+
+def test_shard_rejects_bad_index():
+    with pytest.raises(ValueError, match="outside"):
+        list(Dataset.from_items([1]).shard(index=3, count=2))
+
+
+# ---------------------------------------------------------------------------
+# batch — cyclic pad identical to the estimator path
+# ---------------------------------------------------------------------------
+
+
+def test_batch_cyclic_pad_matches_estimator_policy():
+    order = np.random.RandomState(0).permutation(7)
+    got = list(Dataset.from_arrays(order).batch(3, pad="cyclic"))
+    assert [b.n_real for b in got] == [3, 3, 1]
+    # the estimator's padding: np.concatenate([idx, np.resize(order, pad)])
+    expect_last = np.concatenate([order[6:], np.resize(order, 2)])
+    assert np.array_equal(got[-1].items, expect_last)
+
+
+def test_batch_min_batches_emits_all_pad_batches():
+    order = np.arange(3)
+    got = list(
+        Dataset.from_arrays(order).batch(2, pad="cyclic", min_batches=4)
+    )
+    assert [b.n_real for b in got] == [2, 1, 0, 0]
+    # the n_real=0 batches are np.resize(order, bs) — estimator policy for
+    # hosts whose shard ran out before the common step count
+    assert np.array_equal(got[2].items, np.resize(order, 2))
+
+
+def test_batch_without_pad_keeps_ragged_tail():
+    got = list(Dataset.from_items([1, 2, 3]).batch(2))
+    assert got[-1].n_real == 1 and list(got[-1].items) == [3]
+
+
+# ---------------------------------------------------------------------------
+# prefetch — thread hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_yields_everything_in_order():
+    ds = Dataset.from_items(list(range(20))).prefetch(3)
+    assert list(ds) == list(range(20))
+
+
+def test_prefetch_early_close_joins_producer_thread():
+    """Closing a pipeline mid-stream must leave no background threads —
+    the regression the old spin-poll queues could not guarantee."""
+    before = _thread_count()
+    it = iter(Dataset.from_items(list(range(1000))).prefetch(2))
+    assert next(it) == 0
+    it.close()
+    assert _thread_count() <= before
+
+
+def test_prefetch_propagates_producer_error_and_joins():
+    def explode(i):
+        if i == 5:
+            raise ValueError("bad row")
+        return i
+
+    before = _thread_count()
+    with pytest.raises(ValueError, match="bad row"):
+        list(Dataset.from_items(list(range(10))).map(explode).prefetch(2))
+    assert _thread_count() <= before
+
+
+def test_prefetch_iterator_close_is_idempotent():
+    it = PrefetchIterator(lambda: iter(range(100)), size=2)
+    next(it)
+    it.close()
+    it.close()
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_prefetch_closes_upstream_pools():
+    """The prefetch producer closes its upstream chain, so a threaded map
+    under a prefetch sheds its pool when the consumer walks away."""
+    before = _thread_count()
+    ds = (
+        Dataset.from_items(list(range(500)))
+        .map(lambda i: i + 1, num_workers=4)
+        .prefetch(2)
+    )
+    it = iter(ds)
+    next(it)
+    it.close()
+    assert _thread_count() <= before
+
+
+# ---------------------------------------------------------------------------
+# prefetch_to_device
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_to_device_places_and_preserves_values():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    out = list(Dataset.from_arrays(x).batch(2).prefetch_to_device())
+    assert len(out) == 3
+    assert all(isinstance(b, Batch) for b in out)
+    import jax
+
+    assert isinstance(out[0].items, jax.Array)
+    assert np.array_equal(np.asarray(out[1].items), x[2:4])
+
+
+def test_prefetch_to_device_counts_real_rows():
+    metrics.reset()
+    x = np.arange(10, dtype=np.float32).reshape(5, 2)
+    list(Dataset.from_arrays(x).batch(2, pad="cyclic").prefetch_to_device())
+    assert metrics.counter("data.rows_out").value == 5  # pad row not counted
+
+
+def test_prefetch_to_device_custom_placer():
+    seen = []
+
+    def spy(batch):
+        seen.append(batch)
+        return batch
+
+    out = list(
+        Dataset.from_items([1, 2, 3]).prefetch_to_device(place=spy)
+    )
+    assert out == [1, 2, 3] and seen == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# metrics instrumentation
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_advances_data_metrics():
+    metrics.reset()
+    list(
+        Dataset.from_items(list(range(8)))
+        .map(lambda i: np.full((2,), i, np.float32))
+        .prefetch(2)
+    )
+    snap = metrics.snapshot()
+    assert snap.get("data.device_stall_ms.count", 0) > 0
+    assert metrics.timer("data.producer_busy").entries > 0
+
+
+# ---------------------------------------------------------------------------
+# StreamingShardLoader on the new machinery
+# ---------------------------------------------------------------------------
+
+
+def _loader_for(values):
+    return lambda uri: np.full((2, 2), values[uri], np.float32)
+
+
+def test_streaming_loader_epoch_matches_plan():
+    uris = [f"u{i}" for i in range(5)]
+    values = {u: float(i) for i, u in enumerate(uris)}
+    y = np.arange(5, dtype=np.int32)
+    from sparkdl_tpu.estimators.data import StreamingShardLoader
+
+    loader = StreamingShardLoader(
+        uris, y, _loader_for(values), local_bs=2, weighted=True
+    )
+    order = np.random.RandomState(3).permutation(5)
+    batches = list(loader.epoch(order, steps=3))
+    assert len(batches) == 3
+    # final batch: 1 real row + cyclic pad, zero-weighted
+    assert batches[-1]["w"].tolist() == [1.0, 0.0]
+    expect_idx = np.concatenate([order[4:], np.resize(order, 1)])
+    assert np.array_equal(batches[-1]["y"], y[expect_idx])
+
+
+def test_streaming_loader_early_close_leaks_no_threads():
+    """Abandoning an epoch mid-stream (a step error, a break) must join
+    the prefetch producer AND shut the intra-batch pool down."""
+    uris = [f"u{i}" for i in range(64)]
+    values = {u: float(i) for i, u in enumerate(uris)}
+    y = np.arange(64, dtype=np.int32)
+    from sparkdl_tpu.estimators.data import StreamingShardLoader
+
+    loader = StreamingShardLoader(
+        uris, y, _loader_for(values), local_bs=4, weighted=False,
+        max_workers=4,
+    )
+    before = _thread_count()
+    gen = loader.epoch(np.arange(64), steps=16)
+    next(gen)
+    gen.close()
+    assert _thread_count() <= before
+
+
+def test_in_memory_epoch_dataset_matches_hand_loop():
+    from sparkdl_tpu.estimators.data import in_memory_epoch_dataset
+
+    x = np.arange(14, dtype=np.float32).reshape(7, 2)
+    y = np.arange(7, dtype=np.int32)
+    order = np.random.RandomState(1).permutation(7)
+    local_bs, steps = 3, 3
+    got = list(in_memory_epoch_dataset(order, x, y, local_bs, steps, True))
+    for step_i in range(steps):
+        idx = order[step_i * local_bs:(step_i + 1) * local_bs]
+        k = len(idx)
+        if k < local_bs:
+            idx = np.concatenate([idx, np.resize(order, local_bs - k)])
+        assert np.array_equal(got[step_i]["x"], x[idx])
+        assert np.array_equal(got[step_i]["y"], y[idx])
+        assert got[step_i]["w"].tolist() == [1.0] * k + [0.0] * (local_bs - k)

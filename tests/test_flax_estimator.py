@@ -4,6 +4,10 @@
 import numpy as np
 import pytest
 
+# Sharded ViT fine-tunes compile for minutes on the 8-device CPU mesh;
+# keep the whole module out of the quick tier (deselect with -m 'not slow').
+pytestmark = pytest.mark.slow
+
 from sparkdl_tpu.estimators import (
     FlaxImageFileEstimator,
     FlaxImageFileTransformer,

@@ -90,3 +90,57 @@ def test_resize_udf():
         Image.fromarray(arr, "RGB").resize((4, 5), Image.BILINEAR)
     )
     np.testing.assert_array_equal(out, ref)
+
+
+def test_read_images_skip_counts_decode_errors(tpu_session, tmp_path):
+    """on_error="skip" (default) drops corrupt files but advances the
+    data.decode_errors counter — drops are observable, never silent."""
+    from sparkdl_tpu.utils.metrics import metrics
+
+    (tmp_path / "bad1.png").write_bytes(b"not an image")
+    (tmp_path / "bad2.png").write_bytes(b"\x89PNG\r\n but truncated")
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(tmp_path / "ok.png")
+    before = metrics.counter("data.decode_errors").value
+    df = readImages(str(tmp_path), session=tpu_session)
+    assert df.count() == 1
+    assert metrics.counter("data.decode_errors").value == before + 2
+
+
+def test_read_images_raise_names_corrupt_file(tpu_session, tmp_path):
+    from sparkdl_tpu.image.imageIO import ImageDecodeError
+
+    (tmp_path / "corrupt.png").write_bytes(b"nope")
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(tmp_path / "ok.png")
+    # this engine's mapPartitions evaluates eagerly, so the read itself
+    # raises (on Spark it would surface at the first action)
+    with pytest.raises(ImageDecodeError, match="corrupt.png"):
+        readImages(str(tmp_path), session=tpu_session, on_error="raise")
+
+
+def test_read_images_rejects_bad_on_error(tpu_session, image_dir):
+    with pytest.raises(ValueError, match="on_error"):
+        readImages(image_dir, session=tpu_session, on_error="ignore")
+
+
+def test_custom_decode_fn_exception_is_wrapped(tpu_session, tmp_path):
+    """A decode_f that raises (instead of returning None) follows the same
+    policy: counted+skipped by default, ImageDecodeError with the origin
+    and cause under on_error="raise"."""
+    from sparkdl_tpu.image.imageIO import ImageDecodeError, readImagesWithCustomFn
+
+    Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(tmp_path / "a.png")
+
+    def angry_decode(raw, origin):
+        raise RuntimeError("decoder exploded")
+
+    df = readImagesWithCustomFn(
+        str(tmp_path), decode_f=angry_decode, session=tpu_session
+    )
+    assert df.count() == 0  # skipped, not raised
+
+    with pytest.raises(ImageDecodeError, match="a.png") as ei:
+        readImagesWithCustomFn(
+            str(tmp_path), decode_f=angry_decode, session=tpu_session,
+            on_error="raise",
+        )
+    assert isinstance(ei.value.cause, RuntimeError)

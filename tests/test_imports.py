@@ -46,3 +46,13 @@ def test_every_advertised_export_resolves():
 
 def test_dir_covers_exports():
     assert set(sparkdl_tpu.__all__) <= set(dir(sparkdl_tpu))
+
+
+def test_data_package_public_api():
+    """The input-pipeline package exports its full surface, and the
+    top-level façade re-exports the Dataset entry point."""
+    from sparkdl_tpu import data
+
+    for name in data.__all__:
+        assert getattr(data, name) is not None, name
+    assert sparkdl_tpu.Dataset is data.Dataset

@@ -221,3 +221,49 @@ def test_paired_trials_interleaves_and_summarizes():
     assert trials["a"]["median"] == 3.0 and trials["b"]["median"] == 4.0
     lo, hi = trials["a"]["iqr"]
     assert lo <= trials["a"]["median"] <= hi
+
+
+# ---------------------------------------------------------------------------
+# Histogram — empty sliding window must not fabricate quantiles
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_quantiles_none_when_empty():
+    from sparkdl_tpu.utils.metrics import Histogram
+
+    h = Histogram("t.empty")
+    assert h.quantile(0.5) is None
+    assert h.quantile(0.95) is None
+    assert h.quantile(0.99) is None
+    assert h.mean is None and h.count == 0
+
+
+def test_snapshot_skips_empty_histogram():
+    """An empty histogram contributes nothing — no p50/p95/p99 keys, no
+    zero-count placeholders (a dashboard reading 0ms p99 would be a lie)."""
+    r = MetricsRegistry()
+    r.histogram("t.lat")
+    snap = r.snapshot()
+    assert not any(k.startswith("t.lat") for k in snap)
+    r.histogram("t.lat").observe(5.0)
+    snap = r.snapshot()
+    assert snap["t.lat.count"] == 1.0
+    for q in ("p50", "p95", "p99"):
+        assert snap[f"t.lat.{q}"] == 5.0
+
+
+def test_histogram_quantile_rejects_out_of_range():
+    from sparkdl_tpu.utils.metrics import Histogram
+
+    h = Histogram("t.range")
+    with pytest.raises(ValueError, match="quantile"):
+        h.quantile(1.5)
+
+
+def test_timer_add_seconds_accumulates():
+    from sparkdl_tpu.utils.metrics import Timer
+
+    t = Timer("t.ext")
+    t.add_seconds(0.25)
+    t.add_seconds(0.75)
+    assert t.seconds == 1.0 and t.entries == 2

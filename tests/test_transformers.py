@@ -479,3 +479,39 @@ def test_keras_image_transformer_ragged_loader_raises(
     )
     with pytest.raises(ValueError, match="imageLoader"):
         t.transform(df).collect()
+
+
+# ---------------------------------------------------------------------------
+# LRUCache — eviction order (the process-lifetime program/model caches)
+# ---------------------------------------------------------------------------
+
+
+def test_lru_cache_evicts_least_recently_used():
+    from sparkdl_tpu.transformers.utils import LRUCache
+
+    c = LRUCache(maxsize=2)
+    c["a"], c["b"] = 1, 2
+    _ = c["a"]  # touch: "a" is now most recent
+    c["c"] = 3  # evicts "b", not "a"
+    assert "a" in c and "c" in c and "b" not in c
+
+
+def test_lru_cache_setitem_refreshes_recency():
+    from sparkdl_tpu.transformers.utils import LRUCache
+
+    c = LRUCache(maxsize=2)
+    c["a"], c["b"] = 1, 2
+    c["a"] = 10  # overwrite counts as use
+    c["c"] = 3
+    assert c.get("a") == 10 and "b" not in c
+    # iteration runs LRU -> MRU; the get("a") above refreshed "a"
+    assert list(c) == ["c", "a"]
+
+
+def test_lru_cache_eviction_is_fifo_without_touches():
+    from sparkdl_tpu.transformers.utils import LRUCache
+
+    c = LRUCache(maxsize=3)
+    for i, k in enumerate("abcde"):
+        c[k] = i
+    assert list(c) == ["c", "d", "e"]  # a then b evicted, in order
