@@ -20,10 +20,12 @@ uses 1000 img/s — the commonly cited TF-fp32 InceptionV3 V100 batch-inference
 figure — so ``vs_baseline = measured / 1000``.
 
 Prints exactly one JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N}``
-— or, when the device is unreachable (bounded probe, no hang), the same
-shape with ``value``/``vs_baseline``/``mfu`` null plus an ``"error"``
-field, exit code 2.
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N,
+"ok": true}`` — or, when the device is unreachable (watchdogged bounded
+probe — ``sparkdl_tpu.resilience.watchdog`` — no hang), the same shape
+with ``value``/``vs_baseline``/``mfu`` null plus ``"ok": false``,
+``"error_class"`` (the typed resilience classification) and ``"error"``
+fields, exit code 2.
 """
 
 import json
@@ -41,12 +43,10 @@ REPEATS = 3
 
 
 def main():
-    from sparkdl_tpu.utils.probes import bounded_subprocess_probe
+    from sparkdl_tpu.resilience.watchdog import check_device
 
-    ok, msg = bounded_subprocess_probe(
-        "import jax; print(jax.devices()[0].platform)", timeout_s=300
-    )
-    if not ok:
+    probe = check_device(timeout_s=300)
+    if not probe["ok"]:
         print(
             json.dumps(
                 {
@@ -56,7 +56,9 @@ def main():
                     "unit": "images/sec/chip",
                     "vs_baseline": None,
                     "mfu": None,
-                    "error": f"device unreachable: {msg}",
+                    "ok": False,
+                    "error_class": probe["error_class"],
+                    "error": f"device unreachable: {probe['detail']}",
                 }
             )
         )
@@ -77,6 +79,7 @@ def main():
                 ),
                 "mfu": round(out["mfu"], 4) if out["mfu"] is not None
                 else None,
+                "ok": True,
             }
         )
     )

@@ -31,6 +31,14 @@ STEPS = 10
 
 
 def main():
+    from sparkdl_tpu.resilience.watchdog import guard_device
+
+    if not guard_device(
+        "KerasImageFileEstimator(ResNet50->5cls) DP fine-tune step time",
+        unit=f"ms/step (batch {BATCH})",
+    ):
+        return 2
+
     import jax
     import jax.numpy as jnp
     import keras

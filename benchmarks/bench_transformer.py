@@ -29,6 +29,14 @@ IMAGE = 299
 
 
 def main():
+    from sparkdl_tpu.resilience.watchdog import guard_device
+
+    if not guard_device(
+        "KerasImageFileTransformer(InceptionV3 .keras) bf16 batch "
+        "inference throughput"
+    ):
+        return 2
+
     import jax
     import jax.numpy as jnp
     import keras

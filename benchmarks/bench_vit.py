@@ -38,6 +38,14 @@ STEPS = 10
 
 
 def main():
+    from sparkdl_tpu.resilience.watchdog import guard_device
+
+    if not guard_device(
+        "FlaxImageFileEstimator(ViT-B/16->5cls) DP fine-tune step time",
+        unit=f"ms/step (batch {BATCH})",
+    ):
+        return 2
+
     import jax.numpy as jnp
     import optax
 

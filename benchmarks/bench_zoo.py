@@ -25,6 +25,11 @@ os.environ.setdefault("KERAS_BACKEND", "jax")
 
 
 def main():
+    from sparkdl_tpu.resilience.watchdog import guard_device
+
+    if not guard_device("model-zoo bf16 featurize throughput"):
+        return 2
+
     from sparkdl_tpu.models.registry import SUPPORTED_MODELS
     from sparkdl_tpu.utils.benchlib import measure_featurizer
 

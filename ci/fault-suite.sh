@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Fault-injection suite: run the resilience + fault-injection tests on
+# the CPU backend (JAX_PLATFORMS=cpu — deterministic, no TPU needed),
+# then the no-ad-hoc-sleep-retry lint.  Tier-1: wired into the `tests`
+# job of .github/workflows/ci.yml.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
+  -q -m 'not slow' -p no:cacheprovider
+
+python ci/lint_no_sleep_retry.py .

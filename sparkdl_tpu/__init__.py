@@ -48,6 +48,14 @@ _EXPORTS = {
     "ModelServer": "sparkdl_tpu.serving",
     "ServingConfig": "sparkdl_tpu.serving",
     "ServerOverloaded": "sparkdl_tpu.serving",
+    "RetryPolicy": "sparkdl_tpu.resilience",
+    "Deadline": "sparkdl_tpu.resilience",
+    "CircuitBreaker": "sparkdl_tpu.resilience",
+    "TransientError": "sparkdl_tpu.resilience",
+    "PermanentError": "sparkdl_tpu.resilience",
+    "DeviceUnresponsive": "sparkdl_tpu.resilience",
+    "Preempted": "sparkdl_tpu.resilience",
+    "FaultPlan": "sparkdl_tpu.resilience",
 }
 
 __all__ = ["VERSION", *sorted(_EXPORTS)]

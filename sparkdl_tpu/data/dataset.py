@@ -40,6 +40,8 @@ from typing import (
 
 import numpy as np
 
+from sparkdl_tpu.resilience import inject
+
 
 class Batch(NamedTuple):
     """One fixed-size batch: ``items`` (list or stacked array, length =
@@ -112,6 +114,28 @@ class Dataset:
         )
 
     @staticmethod
+    def from_files(paths: Sequence[str], retry=None) -> "Dataset":
+        """Dataset of ``(path, bytes)`` pairs read lazily at iteration
+        time — the source-read stage.  ``retry`` (a
+        :class:`~sparkdl_tpu.resilience.policy.RetryPolicy`) re-attempts
+        reads that fail transiently (``OSError`` I/O hiccups, flaky
+        network filesystems); ``FileNotFoundError`` / ``PermissionError``
+        are classified permanent and fail immediately."""
+        paths = list(paths)
+
+        def read_one(path: str) -> bytes:
+            inject.fire("data.source")
+            with open(path, "rb") as fh:
+                return fh.read()
+
+        reader = retry.wrap(read_one) if retry is not None else read_one
+
+        def rows():
+            return ((p, reader(p)) for p in paths)
+
+        return Dataset(rows, length=len(paths), name="from_files")
+
+    @staticmethod
     def from_dataframe(df, *cols: str) -> "Dataset":
         """Dataset over a :class:`sparkdl_tpu.sql.dataframe.DataFrame`'s
         rows.  With ``cols``, yields tuples of those columns (one column
@@ -142,13 +166,27 @@ class Dataset:
         fn: Callable[[Any], Any],
         num_workers: int = 0,
         buffer: Optional[int] = None,
+        retry=None,
     ) -> "Dataset":
         """Apply ``fn`` per item.  ``num_workers > 0`` runs ``fn`` on a
         thread pool with a bounded in-flight window (``buffer``, default
         ``2 * num_workers``) while **preserving order** — results are
         yielded in submission order, so downstream determinism contracts
-        hold regardless of per-item latency."""
+        hold regardless of per-item latency.
+
+        ``retry`` (a :class:`~sparkdl_tpu.resilience.policy.RetryPolicy`)
+        re-attempts per-item transient failures with backoff; permanent
+        failures (e.g. :class:`~sparkdl_tpu.image.imageIO.ImageDecodeError`
+        — corrupt bytes don't heal on retry) propagate immediately.  The
+        classification is ``isinstance`` against the resilience taxonomy,
+        no string matching."""
         src = self
+
+        def apply(item):
+            inject.fire("data.map")
+            return fn(item)
+
+        item_fn = retry.wrap(apply) if retry is not None else apply
 
         if num_workers <= 0:
 
@@ -156,7 +194,7 @@ class Dataset:
                 it = iter(src)
                 try:
                     for item in it:
-                        yield fn(item)
+                        yield item_fn(item)
                 finally:
                     _close_iter(it)
 
@@ -177,7 +215,7 @@ class Dataset:
             )
             try:
                 for item in it:
-                    pending.append(pool.submit(fn, item))
+                    pending.append(pool.submit(item_fn, item))
                     if len(pending) >= window:
                         yield pending.popleft().result()
                 while pending:

@@ -16,6 +16,7 @@ import numpy as np
 import jax
 
 from sparkdl_tpu.parallel import runner
+from sparkdl_tpu.resilience import inject
 
 
 def collect_host_shard_rows(
@@ -68,6 +69,7 @@ class StreamingShardLoader:
         weighted: bool,
         max_workers: int = 16,
         prefetch: int = 2,
+        retry=None,
     ):
         self.uris = uris
         self.y = y
@@ -76,11 +78,19 @@ class StreamingShardLoader:
         self.weighted = bool(weighted)
         self.max_workers = max_workers
         self.prefetch = max(1, int(prefetch))
+        # retry: a resilience.RetryPolicy re-attempting transient per-URI
+        # load failures (flaky network FS); permanent ones (decode errors)
+        # still fail the epoch immediately.
+        self._load_one = (
+            retry.wrap(self._load_uri) if retry is not None else self._load_uri
+        )
+
+    def _load_uri(self, uri: str) -> np.ndarray:
+        inject.fire("data.source")
+        return np.asarray(self.loader(uri), np.float32)
 
     def _load_batch(self, pool, idx, k):
-        xs = list(pool.map(
-            lambda i: np.asarray(self.loader(self.uris[i]), np.float32), idx
-        ))
+        xs = list(pool.map(lambda i: self._load_one(self.uris[i]), idx))
         batch = {"x": np.stack(xs), "y": self.y[idx]}
         if self.weighted:
             w = np.zeros(self.local_bs, np.float32)

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 
 from sparkdl_tpu.estimators import checkpointing
+from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.resilience.preempt import preemption_scope
 from sparkdl_tpu.estimators.data import (
     in_memory_epoch_dataset,
     load_host_shard,
@@ -452,26 +454,36 @@ class FlaxImageFileEstimator(
             rng.permutation(n)
         last_loss = None
         ckptr = self._make_checkpointer() if ckpt_dir else None
+        # preemption contract: SIGTERM flags the token, the loop raises the
+        # typed Preempted at the next step boundary, the finally flush
+        # commits the last completed epoch, and a re-fit resumes
+        # bit-identically (permutation replay above) — same as
+        # KerasImageFileEstimator
         try:
-            for epoch in range(start_epoch, epochs):
-                order = rng.permutation(n)
-                # the epoch as a sparkdl_tpu.data Dataset (cyclic-pad batch
-                # composition; pad rows carry zero weight, so the update is
-                # the exact mean over the real rows)
-                epoch_ds = in_memory_epoch_dataset(
-                    order, x, y, local_bs, steps_per_epoch, weighted=True
-                )
-                for batch in epoch_ds:
-                    state, loss = step_fn(state, place_batch(batch))
-                last_loss = float(loss)
-                logger.info(
-                    "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
-                )
-                if ckptr is not None:
-                    checkpointing.save_epoch(
-                        ckptr, ckpt_dir, namespace, epoch + 1,
-                        self._ckpt_payload(state),
+            with preemption_scope() as ptoken:
+                for epoch in range(start_epoch, epochs):
+                    order = rng.permutation(n)
+                    # the epoch as a sparkdl_tpu.data Dataset (cyclic-pad
+                    # batch composition; pad rows carry zero weight, so the
+                    # update is the exact mean over the real rows)
+                    epoch_ds = in_memory_epoch_dataset(
+                        order, x, y, local_bs, steps_per_epoch, weighted=True
                     )
+                    for batch in epoch_ds:
+                        ptoken.check()
+                        inject.fire("estimator.step")
+                        state, loss = step_fn(state, place_batch(batch))
+                    inject.fire("estimator.epoch")
+                    last_loss = float(loss)
+                    logger.info(
+                        "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
+                    )
+                    if ckptr is not None:
+                        checkpointing.save_epoch(
+                            ckptr, ckpt_dir, namespace, epoch + 1,
+                            self._ckpt_payload(state),
+                        )
+                        inject.fire("estimator.checkpoint_saved")
         finally:
             if ckptr is not None:
                 ckptr.wait_until_finished()

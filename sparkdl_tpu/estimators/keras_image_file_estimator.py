@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 
 from sparkdl_tpu.estimators import checkpointing
+from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.resilience.preempt import preemption_scope
 from sparkdl_tpu.estimators.data import (
     StreamingShardLoader,
     collect_host_shard_rows,
@@ -245,42 +247,54 @@ class KerasImageFileEstimator(
             return shard_batch(batch, mesh)
 
         ckptr = self._make_checkpointer() if ckpt_dir else None
+        # SIGTERM (scheduler preemption) flags the token; the step loop
+        # polls it at step boundaries and raises the typed Preempted there
+        # — never from inside the signal handler.  The finally flush below
+        # then commits the last completed epoch before the process yields,
+        # and a re-fit resumes bit-identically (permutation replay above).
         try:
-            for epoch in range(start_epoch, epochs):
-                order = rng.permutation(n)
-                # both arms iterate a sparkdl_tpu.data Dataset with the same
-                # batch(pad="cyclic") composition — every host contributes
-                # the same shapes (even when n < local_bs), and with a known
-                # loss the pad rows carry zero weight, so the update is the
-                # exact mean over the real rows
-                epoch_ds = (
-                    stream.dataset(order, steps_per_epoch)
-                    if streaming
-                    else in_memory_epoch_dataset(
-                        order, x, y, local_bs, steps_per_epoch, weighted
+            with preemption_scope() as ptoken:
+                for epoch in range(start_epoch, epochs):
+                    order = rng.permutation(n)
+                    # both arms iterate a sparkdl_tpu.data Dataset with the
+                    # same batch(pad="cyclic") composition — every host
+                    # contributes the same shapes (even when n < local_bs),
+                    # and with a known loss the pad rows carry zero weight,
+                    # so the update is the exact mean over the real rows
+                    epoch_ds = (
+                        stream.dataset(order, steps_per_epoch)
+                        if streaming
+                        else in_memory_epoch_dataset(
+                            order, x, y, local_bs, steps_per_epoch, weighted
+                        )
                     )
-                )
-                for batch in epoch_ds:
-                    state, loss = step_fn(state, place(batch))
-                last_loss = float(loss)
-                logger.info(
-                    "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
-                )
-                if ckptr is not None:
-                    # every process calls save: under jax.distributed orbax
-                    # saves are collective (primary writes, peers barrier) —
-                    # gating on process 0 would wedge the job in orbax's
-                    # internal sync.  The save is async (SURVEY.md §5.4):
-                    # arrays are snapshotted to host synchronously, disk
-                    # commit happens behind the next epoch's steps
-                    checkpointing.save_epoch(
-                        ckptr, ckpt_dir, namespace, epoch + 1,
-                        self._ckpt_payload(state),
+                    for batch in epoch_ds:
+                        ptoken.check()
+                        inject.fire("estimator.step")
+                        state, loss = step_fn(state, place(batch))
+                    inject.fire("estimator.epoch")
+                    last_loss = float(loss)
+                    logger.info(
+                        "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
                     )
+                    if ckptr is not None:
+                        # every process calls save: under jax.distributed
+                        # orbax saves are collective (primary writes, peers
+                        # barrier) — gating on process 0 would wedge the job
+                        # in orbax's internal sync.  The save is async
+                        # (SURVEY.md §5.4): arrays are snapshotted to host
+                        # synchronously, disk commit happens behind the next
+                        # epoch's steps
+                        checkpointing.save_epoch(
+                            ckptr, ckpt_dir, namespace, epoch + 1,
+                            self._ckpt_payload(state),
+                        )
+                        inject.fire("estimator.checkpoint_saved")
         finally:
             if ckptr is not None:
                 # the final epoch's write must commit before fit returns
-                # (a crash right after fit must find a resumable ckpt)
+                # (a crash right after fit — or a preemption — must find a
+                # resumable ckpt)
                 ckptr.wait_until_finished()
                 ckptr.close()
 

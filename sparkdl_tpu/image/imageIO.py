@@ -20,6 +20,7 @@ from typing import Callable, List, Optional
 import numpy as np
 from PIL import Image
 
+from sparkdl_tpu.resilience.errors import PermanentError as _PermanentError
 from sparkdl_tpu.sql.types import (
     BinaryType,
     IntegerType,
@@ -150,12 +151,14 @@ def imageStructToRGBArray(imageRow: Row) -> np.ndarray:
     return arr
 
 
-class ImageDecodeError(ValueError):
+class ImageDecodeError(ValueError, _PermanentError):
     """A file's bytes could not be decoded into an image.
 
     Carries ``origin`` (the file path / URI) and the underlying ``cause``
     so ``on_error="raise"`` callers see *which* input was corrupt, not
-    just a bare PIL traceback."""
+    just a bare PIL traceback.  Classified :class:`PermanentError` in the
+    resilience taxonomy: corrupt bytes do not heal on retry — skip the
+    row (``on_error="skip"``) or fail fast, never back off."""
 
     def __init__(self, origin: str, cause: Optional[BaseException] = None):
         self.origin = origin

@@ -1,0 +1,251 @@
+"""Deterministic fault injection: seeded plans fired at named sites.
+
+Production code is instrumented with cheap :func:`fire` calls at the
+places faults actually happen — ``data.map`` / ``data.source`` items,
+``serving.forward`` batches, ``estimator.step`` / ``estimator.epoch``
+boundaries, ``estimator.checkpoint_saved`` right after an async save
+dispatch, ``watchdog.<name>`` inside watchdogged calls.  With no plan
+active, :func:`fire` is one global read — the hot loops pay nothing.
+
+A :class:`FaultPlan` is a list of rules keyed by site with a
+deterministic trigger: ``at`` = the Nth call to that site (1-based),
+``times`` = how many consecutive calls fire, or ``p`` = seeded
+probability.  Actions:
+
+- ``error`` — raise (shorthands ``"transient"`` / ``"permanent"`` /
+  ``"decode"`` / ``"device"``, or any exception instance);
+- ``stall_s`` — block the call (what trips the watchdog);
+- ``preempt`` — simulate SIGTERM through
+  :mod:`sparkdl_tpu.resilience.preempt`;
+- ``kill`` — ``os._exit(9)``: die NOW, no atexit, no finally — the
+  deterministic stand-in for SIGKILL (used to prove a death between
+  checkpoint payload write and commit marker never resumes).
+
+Tests install plans with :func:`active_plan`; whole processes get them
+from the ``SPARKDL_FAULT_PLAN`` env var (a JSON list of rule dicts),
+which is how subprocess workers are made to fail on cue.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Union
+
+from sparkdl_tpu.resilience.errors import (
+    PermanentError,
+    TransientError,
+)
+
+ENV_VAR = "SPARKDL_FAULT_PLAN"
+
+
+class InjectedTransientError(TransientError):
+    """A planned transient fault (distinguishable from real ones)."""
+
+
+class InjectedPermanentError(PermanentError):
+    """A planned permanent fault."""
+
+
+class InjectedDeviceError(TransientError):
+    """A planned transient *device* fault — stands in for the
+    UNAVAILABLE/ABORTED class of PJRT runtime errors."""
+
+
+_ERROR_SHORTHANDS = {
+    "transient": InjectedTransientError,
+    "permanent": InjectedPermanentError,
+    "device": InjectedDeviceError,
+}
+
+
+class Rule:
+    """One (site, trigger, action) entry of a plan."""
+
+    def __init__(
+        self,
+        site: str,
+        error: Union[None, str, BaseException, type] = None,
+        stall_s: Optional[float] = None,
+        preempt: bool = False,
+        kill: bool = False,
+        at: Optional[int] = None,
+        times: int = 1,
+        p: Optional[float] = None,
+    ):
+        actions = sum(
+            1 for a in (error, stall_s) if a is not None
+        ) + int(preempt) + int(kill)
+        if actions != 1:
+            raise ValueError(
+                "a rule needs exactly one action "
+                "(error= / stall_s= / preempt= / kill=)"
+            )
+        if (at is None) == (p is None):
+            raise ValueError("a rule needs exactly one trigger (at= or p=)")
+        self.site = site
+        self.error = error
+        self.stall_s = stall_s
+        self.preempt = bool(preempt)
+        self.kill = bool(kill)
+        self.at = int(at) if at is not None else None
+        self.times = int(times)
+        self.p = float(p) if p is not None else None
+
+    def triggered(self, count: int, rng: random.Random) -> bool:
+        if self.at is not None:
+            return self.at <= count < self.at + self.times
+        return rng.random() < self.p
+
+    def make_error(self) -> BaseException:
+        err = self.error
+        if isinstance(err, BaseException):
+            return err
+        if isinstance(err, type) and issubclass(err, BaseException):
+            return err(f"injected fault at {self.site!r}")
+        if err in _ERROR_SHORTHANDS:
+            return _ERROR_SHORTHANDS[err](
+                f"injected {err} fault at {self.site!r}"
+            )
+        if err == "decode":
+            from sparkdl_tpu.image.imageIO import ImageDecodeError
+
+            return ImageDecodeError(f"<injected:{self.site}>")
+        raise ValueError(f"unknown error shorthand {err!r}")
+
+    def describe(self) -> dict:
+        trigger = (
+            {"at": self.at, "times": self.times}
+            if self.at is not None
+            else {"p": self.p}
+        )
+        action = (
+            "kill" if self.kill
+            else "preempt" if self.preempt
+            else f"stall {self.stall_s}s" if self.stall_s is not None
+            else f"error {self.error!r}"
+        )
+        return {"site": self.site, "action": action, **trigger}
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of faults.
+
+    Per-site call counters live in the plan, so two runs with the same
+    plan and the same workload fire identically; ``seed`` pins the
+    probabilistic (``p=``) rules too."""
+
+    def __init__(self, seed: int = 0):
+        self._rules: List[Rule] = []
+        self._counts: Dict[str, int] = {}
+        self._rng = random.Random(int(seed))
+        self._lock = threading.Lock()
+
+    def add(self, site: str, **rule_kw: Any) -> "FaultPlan":
+        """Append a rule (see :class:`Rule`); returns ``self``."""
+        self._rules.append(Rule(site, **rule_kw))
+        return self
+
+    def count(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+    def describe(self) -> List[dict]:
+        return [r.describe() for r in self._rules]
+
+    @classmethod
+    def from_json(cls, text: str, seed: int = 0) -> "FaultPlan":
+        """Plan from a JSON rule list — the ``SPARKDL_FAULT_PLAN``
+        format, e.g.::
+
+            [{"site": "serving.forward", "error": "transient", "at": 1,
+              "times": 2},
+             {"site": "estimator.checkpoint_saved", "kill": true,
+              "at": 2}]
+        """
+        rules = json.loads(text)
+        if not isinstance(rules, list):
+            raise ValueError(
+                f"{ENV_VAR} must be a JSON list of rule objects"
+            )
+        plan = cls(seed=seed)
+        for r in rules:
+            plan.add(**r)
+        return plan
+
+    # -- firing --------------------------------------------------------
+    def _fire(self, site: str) -> None:
+        with self._lock:
+            count = self._counts.get(site, 0) + 1
+            self._counts[site] = count
+            hits = [
+                r for r in self._rules
+                if r.site == site and r.triggered(count, self._rng)
+            ]
+        for rule in hits:
+            from sparkdl_tpu.utils.metrics import metrics
+
+            metrics.counter("resilience.injected_faults").add(1)
+            if rule.kill:
+                os._exit(9)
+            if rule.preempt:
+                from sparkdl_tpu.resilience import preempt
+
+                preempt.request_preemption(
+                    f"injected preemption at {site!r}"
+                )
+                continue
+            if rule.stall_s is not None:
+                time.sleep(rule.stall_s)
+                continue
+            raise rule.make_error()
+
+
+#: the installed plan (env-supplied plans install at import time, so a
+#: subprocess worker needs no code changes to run under a plan)
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def fire(site: str) -> None:
+    """Fault-injection hook: no-op unless a plan is active and has a
+    matching, triggered rule for ``site``."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan._fire(site)
+
+
+@contextmanager
+def active_plan(plan: FaultPlan):
+    """Install ``plan`` for the duration of the block (counters reset on
+    entry so a reused plan refires deterministically)."""
+    global _ACTIVE
+    previous = _ACTIVE
+    plan.reset()
+    _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        _ACTIVE = previous
+
+
+def plan_from_env() -> Optional[FaultPlan]:
+    text = os.environ.get(ENV_VAR)
+    if not text:
+        return None
+    return FaultPlan.from_json(
+        text, seed=int(os.environ.get(ENV_VAR + "_SEED", "0"))
+    )
+
+
+_env_plan = plan_from_env()
+if _env_plan is not None:
+    _ACTIVE = _env_plan

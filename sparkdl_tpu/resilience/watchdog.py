@@ -1,0 +1,165 @@
+"""Watchdogged device calls: turn unbounded hangs into typed failures.
+
+The round-5 failure mode this bounds: a wedged PJRT tunnel makes any
+device-touching call block FOREVER — ``jax.devices()``, a dispatch, a
+fetch.  :func:`watchdogged` runs the call on a worker thread and watches
+it from the caller's thread:
+
+- **soft timeout** — the call is slow but may still land: run the
+  bounded out-of-process diagnostic
+  (:func:`~sparkdl_tpu.utils.probes.bounded_subprocess_probe`), log what
+  it says, keep waiting;
+- **hard timeout** — give up: raise the typed
+  :class:`~sparkdl_tpu.resilience.errors.DeviceUnresponsive` carrying
+  the diagnostic.  The worker thread cannot be killed (CPython), so it
+  is abandoned as a daemon — the POINT is that the caller's thread, and
+  therefore the job, stays in control instead of hanging with it.
+
+:func:`check_device` is the reachability front door bench.py and the
+benchmark scripts route through (one structured
+``{"ok": ..., "error_class": ...}`` shape instead of per-script ad-hoc
+probe handling).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.resilience.errors import DeviceUnresponsive, error_class
+from sparkdl_tpu.utils.metrics import metrics
+from sparkdl_tpu.utils.probes import bounded_subprocess_probe
+
+logger = logging.getLogger(__name__)
+
+#: the canonical liveness probe: create a client in a fresh interpreter
+DEFAULT_PROBE_CODE = "import jax; print(jax.devices()[0].platform)"
+
+
+def watchdogged(
+    fn: Callable[..., Any],
+    *args: Any,
+    soft_timeout_s: float = 30.0,
+    hard_timeout_s: float = 120.0,
+    name: str = "device_call",
+    diagnostic_code: str = DEFAULT_PROBE_CODE,
+    diagnostic_timeout_s: float = 60.0,
+    **kwargs: Any,
+) -> Any:
+    """Run ``fn(*args, **kwargs)`` bounded by a two-stage watchdog.
+
+    Returns ``fn``'s result, re-raises its exception, or raises
+    :class:`DeviceUnresponsive` after ``hard_timeout_s``.  The
+    fault-injection site ``watchdog.<name>`` fires inside the worker, so
+    an injected stall exercises the real timeout path."""
+    if hard_timeout_s <= 0:
+        raise ValueError(f"hard_timeout_s must be > 0, got {hard_timeout_s}")
+    soft_timeout_s = min(soft_timeout_s, hard_timeout_s)
+    done = threading.Event()
+    box: dict = {}
+
+    def run():
+        try:
+            inject.fire(f"watchdog.{name}")
+            box["result"] = fn(*args, **kwargs)
+        except BaseException as exc:  # noqa: BLE001 - relayed to caller
+            box["error"] = exc
+        finally:
+            done.set()
+
+    worker = threading.Thread(
+        target=run, name=f"sparkdl-watchdog-{name}", daemon=True
+    )
+    start = time.monotonic()
+    worker.start()
+    diagnostic = None
+    if not done.wait(soft_timeout_s):
+        metrics.counter("resilience.watchdog_soft_timeouts").add(1)
+        ok, msg = bounded_subprocess_probe(
+            diagnostic_code, timeout_s=int(diagnostic_timeout_s)
+        )
+        diagnostic = f"probe {'ok' if ok else 'FAILED'}: {msg}"
+        logger.warning(
+            "%s exceeded soft timeout (%.1fs); out-of-process %s",
+            name, soft_timeout_s, diagnostic,
+        )
+        remaining = hard_timeout_s - (time.monotonic() - start)
+        if remaining > 0:
+            done.wait(remaining)
+    if not done.is_set():
+        metrics.counter("resilience.watchdog_hard_timeouts").add(1)
+        detail = f"; {diagnostic}" if diagnostic else ""
+        raise DeviceUnresponsive(
+            f"{name} still running after hard timeout "
+            f"{hard_timeout_s:.1f}s (wedged tunnel?){detail}"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+def check_device(
+    timeout_s: int = 300, probe_code: str = DEFAULT_PROBE_CODE
+) -> dict:
+    """Bounded device-reachability check as a structured record:
+    ``{"ok": bool, "error_class": str|None, "detail": str}`` — ``detail``
+    is the probe's stdout (the platform name) on success, the diagnostic
+    on failure.  The record shape is what bench.py and benchmarks/*
+    merge into their JSON output, so an unreachable device is one
+    uniform machine-readable row everywhere."""
+    try:
+        ok, msg = watchdogged(
+            bounded_subprocess_probe,
+            probe_code,
+            int(timeout_s),
+            # the probe already bounds itself via subprocess timeout; the
+            # watchdog's hard stop is the backstop for a wedged fork/exec
+            soft_timeout_s=timeout_s,
+            hard_timeout_s=timeout_s + 30.0,
+            name="device_probe",
+            diagnostic_code=probe_code,
+        )
+    except DeviceUnresponsive as exc:
+        return {
+            "ok": False,
+            "error_class": error_class(exc),
+            "detail": str(exc),
+        }
+    if ok:
+        return {"ok": True, "error_class": None, "detail": msg}
+    return {
+        "ok": False,
+        "error_class": DeviceUnresponsive.__name__,
+        "detail": msg,
+    }
+
+
+def guard_device(
+    metric: str, timeout_s: int = 300, unit: str = "images/sec/chip"
+) -> bool:
+    """Benchmark-entry guard: True when the device answers; otherwise
+    print the canonical unreachable record —
+    ``{"metric", "value": null, "ok": false, "error_class", "error"}`` —
+    and return False so the script can exit 2.  One implementation so
+    benchmark scripts cannot drift in how they report a dead device."""
+    record = check_device(timeout_s=timeout_s)
+    if record["ok"]:
+        return True
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": None,
+                "unit": unit,
+                "ok": False,
+                "error_class": record["error_class"],
+                "error": f"device unreachable: {record['detail']}",
+            }
+        ),
+        flush=True,
+    )
+    return False

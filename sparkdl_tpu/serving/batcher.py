@@ -28,6 +28,9 @@ import numpy as np
 
 import jax
 
+from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.resilience.errors import CircuitOpen
+from sparkdl_tpu.resilience.policy import CircuitBreaker, Deadline, RetryPolicy
 from sparkdl_tpu.serving.admission import AdmissionQueue, Request
 from sparkdl_tpu.serving.cache import ProgramCache
 from sparkdl_tpu.serving.errors import DeadlineExceeded, ServerClosed
@@ -48,6 +51,9 @@ class ServingConfig:
         queue_capacity: int = 256,
         cache_size: int = 32,
         default_deadline_ms: Optional[float] = None,
+        retry: Optional[RetryPolicy] = None,
+        breaker_threshold: int = 5,
+        breaker_recovery_s: float = 30.0,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -58,6 +64,14 @@ class ServingConfig:
         self.queue_capacity = int(queue_capacity)
         self.cache_size = int(cache_size)
         self.default_deadline_ms = default_deadline_ms
+        # resilience knobs: `retry` re-attempts *transient* forward
+        # failures (resilience taxonomy) within the batch's deadline;
+        # `breaker_threshold` consecutive forward failures trip the
+        # endpoint's circuit breaker into degraded mode (visible in
+        # ModelServer.status()) for `breaker_recovery_s`.
+        self.retry = retry
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_recovery_s = float(breaker_recovery_s)
 
     def __repr__(self):
         return (
@@ -65,7 +79,10 @@ class ServingConfig:
             f"max_wait_ms={self.max_wait_ms}, "
             f"queue_capacity={self.queue_capacity}, "
             f"cache_size={self.cache_size}, "
-            f"default_deadline_ms={self.default_deadline_ms})"
+            f"default_deadline_ms={self.default_deadline_ms}, "
+            f"retry={self.retry}, "
+            f"breaker_threshold={self.breaker_threshold}, "
+            f"breaker_recovery_s={self.breaker_recovery_s})"
         )
 
 
@@ -102,6 +119,11 @@ class MicroBatcher:
             config.queue_capacity,
             depth_gauge=metrics.gauge(f"serving.queue_depth.{model_id}"),
             shed_counter=metrics.counter("serving.shed"),
+        )
+        self._breaker = CircuitBreaker(
+            name=f"serving.{model_id}",
+            failure_threshold=config.breaker_threshold,
+            recovery_s=config.breaker_recovery_s,
         )
         self._closed = False
         self._worker_lock = threading.Lock()
@@ -228,20 +250,49 @@ class MicroBatcher:
             return
         bucket = shape_bucket(len(live), self._config.max_batch)
         x = pad_to_batch(np.stack([r.value for r in live]), bucket)
-        try:
+
+        def forward_once():
+            inject.fire("serving.forward")
             if self._compile:
                 fn = self._cache.program(
                     self.model_id, self._forward, bucket,
                     self._item_shape, self._dtype,
                 )
-                out = np.asarray(jax.device_get(fn(x)))
+                return np.asarray(jax.device_get(fn(x)))
+            return np.asarray(self._forward(x))
+
+        try:
+            # breaker first: while open, fail the batch fast with the
+            # typed (transient) CircuitOpen instead of hammering a dead
+            # forward path — callers may retry elsewhere / later
+            self._breaker.check()
+            retry = self._config.retry
+            if retry is not None:
+                # retries must fit inside the batch's tightest request
+                # deadline — backing off past it would compute an answer
+                # nobody reads
+                dls = [r.deadline for r in live if r.deadline is not None]
+                # request deadlines are absolute time.monotonic stamps —
+                # Deadline's clock — so wrap the tightest one directly
+                deadline = (
+                    Deadline(min(dls), what=f"batch to {self.model_id!r}")
+                    if dls
+                    else None
+                )
+                out = retry.call(forward_once, deadline=deadline)
             else:
-                out = np.asarray(self._forward(x))
+                out = forward_once()
+        except CircuitOpen as e:
+            for r in live:
+                r.future.set_exception(e)
+            return
         except Exception as e:
+            self._breaker.record_failure()
             metrics.counter("serving.errors").add(1)
             for r in live:
                 r.future.set_exception(e)
             return
+        self._breaker.record_success()
         done = time.monotonic()
         latency = metrics.histogram("serving.latency_ms")
         for i, r in enumerate(live):
@@ -277,6 +328,16 @@ class MicroBatcher:
         with self._worker_lock:
             return self._worker is not None and self._worker.is_alive()
 
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self._breaker
+
+    @property
+    def degraded(self) -> bool:
+        """True while the endpoint's circuit is not closed — new batches
+        fail fast with ``CircuitOpen`` (or are probing, when half-open)."""
+        return self._breaker.state != "closed"
+
     def describe(self) -> dict:
         return {
             "model_id": self.model_id,
@@ -289,4 +350,6 @@ class MicroBatcher:
             "queue_capacity": self._queue.capacity,
             "worker_alive": self.worker_alive,
             "closed": self._closed,
+            "degraded": self.degraded,
+            "breaker": self._breaker.snapshot(),
         }

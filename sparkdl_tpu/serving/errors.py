@@ -5,27 +5,41 @@ Online callers need to distinguish *shed* (retry elsewhere / later),
 genuine model failures, so each is its own exception type rather than a
 string-matched RuntimeError.  All inherit :class:`ServingError` so a
 front-end can catch the whole family at once.
+
+Each also inherits its :mod:`sparkdl_tpu.resilience` classification —
+``isinstance`` against :class:`~sparkdl_tpu.resilience.errors.TransientError`
+/ :class:`~sparkdl_tpu.resilience.errors.PermanentError` IS the retry
+decision, so a ``RetryPolicy`` in front of a server backs off on shed
+requests and fails fast on expired/closed ones with no string matching.
 """
 
 from __future__ import annotations
+
+from sparkdl_tpu.resilience.errors import (
+    DeadlineExceeded as _DeadlineExpired,
+    PermanentError,
+    TransientError,
+)
 
 
 class ServingError(RuntimeError):
     """Base class for all online-serving errors."""
 
 
-class ServerOverloaded(ServingError):
+class ServerOverloaded(ServingError, TransientError):
     """The bounded request queue is full — the request was load-shed at
     admission, before consuming any queue slot or TPU time.  Callers
-    should back off and retry; the server is alive."""
+    should back off and retry; the server is alive.  (Transient.)"""
 
 
-class DeadlineExceeded(ServingError):
+class DeadlineExceeded(ServingError, _DeadlineExpired):
     """The request's deadline expired while it waited in the queue; it was
     dropped before being padded into a batch (an expired answer would
-    waste a TPU slot to compute a result nobody reads)."""
+    waste a TPU slot to compute a result nobody reads).  (Permanent — the
+    resilience ``DeadlineExceeded``: never retried under the same
+    deadline.)"""
 
 
-class ServerClosed(ServingError):
+class ServerClosed(ServingError, PermanentError):
     """The endpoint was closed: submissions are rejected and any requests
-    still queued at close time fail with this error."""
+    still queued at close time fail with this error.  (Permanent.)"""

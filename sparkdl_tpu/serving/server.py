@@ -217,16 +217,28 @@ class ModelServer:
         """A ``/healthz``-style snapshot: endpoints, queue depths, cache
         occupancy, and the ``serving.*`` metrics.
 
+        An endpoint whose circuit breaker is not closed reports as
+        ``degraded`` (its batches fail fast with ``CircuitOpen`` until
+        the recovery window elapses and a probe succeeds); a degraded
+        server stays "healthy" — it is serving, just shedding one
+        endpoint — so orchestrators restart on ``healthy: false`` only.
+
         ``probe_device=True`` additionally checks device liveness through
-        the bounded out-of-process probe (``utils/probes.py``) — a wedged
-        PJRT tunnel reports as unhealthy instead of hanging the health
-        endpoint (the failure mode that motivated the probe helper)."""
+        the watchdogged out-of-process probe
+        (:func:`sparkdl_tpu.resilience.watchdog.check_device`) — a wedged
+        PJRT tunnel reports as unhealthy with a typed ``error_class``
+        instead of hanging the health endpoint (the failure mode that
+        motivated the probe helper)."""
         snap = metrics.snapshot()
+        degraded = sorted(
+            mid for mid, ep in self._endpoints.items() if ep.degraded
+        )
         out: Dict[str, Any] = {
             "healthy": not self._closed and all(
                 ep.worker_alive or ep.queue_depth == 0
                 for ep in self._endpoints.values()
             ),
+            "degraded": degraded,
             "closed": self._closed,
             "uptime_s": round(time.monotonic() - self._started_at, 3),
             "endpoints": {
@@ -238,14 +250,10 @@ class ModelServer:
             },
         }
         if probe_device:
-            from sparkdl_tpu.utils.probes import bounded_subprocess_probe
+            from sparkdl_tpu.resilience.watchdog import check_device
 
-            ok, msg = bounded_subprocess_probe(
-                "import jax; print(jax.devices()[0].platform)",
-                timeout_s=probe_timeout_s,
-            )
-            out["device"] = {"ok": ok, "detail": msg}
-            out["healthy"] = out["healthy"] and ok
+            out["device"] = check_device(timeout_s=probe_timeout_s)
+            out["healthy"] = out["healthy"] and out["device"]["ok"]
         return out
 
     def close(self) -> None:

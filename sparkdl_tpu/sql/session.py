@@ -259,18 +259,33 @@ class TPUSession:
     _ON_COND = (
         rf"(?:\s*(?!(?:{_KEYWORDS})\b)[\w.=]+)+"
     )
-    _SQL_RE = re.compile(
+    # The SELECT head (projections + FROM + joins).  Tail clauses
+    # (WHERE/GROUP BY/HAVING/ORDER BY/LIMIT) are split off FIRST by the
+    # paren- and literal-aware :meth:`_split_clauses` — a lazy
+    # ``(?P<where>.+?)(?:\s+GROUP\s+BY...)`` regex would stop at the
+    # first keyword *textually*, mis-splitting ``WHERE x IN (SELECT ...
+    # GROUP BY k)`` at the subquery's GROUP BY instead of treating the
+    # whole parenthesized predicate as the WHERE clause.
+    _SQL_HEAD_RE = re.compile(
         r"^\s*SELECT\s+(?P<distinct>DISTINCT\s+)?(?P<proj>.+?)\s+FROM\s+(?P<table>\w+)"
         rf"(?:\s+(?:AS\s+)?(?!(?:{_KEYWORDS})\b)(?P<talias>\w+))?"
         r"(?P<joins>(?:\s+(?:INNER\s+|LEFT\s+(?:OUTER\s+)?|RIGHT\s+"
         r"(?:OUTER\s+)?|FULL\s+(?:OUTER\s+)?)?JOIN\s+\w+"
         rf"(?:\s+(?:AS\s+)?(?!ON\b)\w+)?\s+ON\b{_ON_COND})*)"
-        r"(?:\s+WHERE\s+(?P<where>.+?))?"
-        r"(?:\s+GROUP\s+BY\s+(?P<group>.+?))?"
-        r"(?:\s+HAVING\s+(?P<having>.+?))?"
-        r"(?:\s+ORDER\s+BY\s+(?P<order>.+?))?"
-        r"(?:\s+LIMIT\s+(?P<limit>\d+))?\s*;?\s*$",
+        r"\s*$",
         re.IGNORECASE | re.DOTALL,
+    )
+    #: tail clauses in canonical order (keyword regex, clause key)
+    _CLAUSE_KEYWORDS = (
+        (r"WHERE", "where"),
+        (r"GROUP\s+BY", "group"),
+        (r"HAVING", "having"),
+        (r"ORDER\s+BY", "order"),
+        (r"LIMIT", "limit"),
+    )
+    _CLAUSE_RE = re.compile(
+        r"\b(?P<kw>WHERE|GROUP\s+BY|HAVING|ORDER\s+BY|LIMIT)\b",
+        re.IGNORECASE,
     )
     _JOIN_CLAUSE_RE = re.compile(
         r"\s+(?P<how>INNER\s+|LEFT\s+(?:OUTER\s+)?|RIGHT\s+(?:OUTER\s+)?"
@@ -406,6 +421,51 @@ class TPUSession:
         return out
 
     @classmethod
+    def _split_clauses(cls, query: str):
+        """Split a single SELECT into ``(head, clauses)`` at the
+        *top-level* WHERE / GROUP BY / HAVING / ORDER BY / LIMIT
+        keywords — paren-depth- and string-literal-aware (same machinery
+        as :meth:`_split_set_ops`), so the same keywords inside a
+        subquery (``WHERE x IN (SELECT ... GROUP BY k)``) stay part of
+        the enclosing clause text.
+
+        Returns ``None`` when the query is not in the dialect's clause
+        shape (out-of-order or duplicate clauses, non-integer LIMIT) —
+        the caller raises its uniform "Unsupported SQL" error."""
+        query = re.sub(r";\s*$", "", query)
+        spans = cls._literal_spans(query)
+        depth_at = cls._depth_profile(query, spans)
+
+        def in_str(i: int) -> bool:
+            return any(lo <= i < hi for lo, hi in spans)
+
+        keys = [k for _, k in cls._CLAUSE_KEYWORDS]
+        hits = []  # (canonical_index, match)
+        for m in cls._CLAUSE_RE.finditer(query):
+            if in_str(m.start()) or depth_at[m.start()] != 0:
+                continue
+            kw = re.sub(r"\s+", " ", m.group("kw")).upper()
+            canon = {"WHERE": "where", "GROUP BY": "group",
+                     "HAVING": "having", "ORDER BY": "order",
+                     "LIMIT": "limit"}[kw]
+            hits.append((keys.index(canon), m))
+        # canonical order, no duplicates — anything else isn't dialect
+        order = [i for i, _ in hits]
+        if order != sorted(set(order)):
+            return None
+        head = query[: hits[0][1].start()] if hits else query
+        clauses = {}
+        for pos, (i, m) in enumerate(hits):
+            end = hits[pos + 1][1].start() if pos + 1 < len(hits) else len(query)
+            text = query[m.end():end].strip()
+            if not text:
+                return None
+            clauses[keys[i]] = text
+        if "limit" in clauses and not clauses["limit"].isdigit():
+            return None
+        return head, clauses
+
+    @classmethod
     def _parse_order_items(cls, text: str) -> List[tuple]:
         """``(expression_text, ascending)`` per top-level comma item."""
         items = []
@@ -459,7 +519,7 @@ class TPUSession:
     # -- the dialect ----------------------------------------------------
     def sql(self, query: str) -> DataFrame:
         """Evaluate a query in the minimal dialect (see the grammar note
-        above :data:`_SQL_RE`, plus: ``UNION [ALL]`` between SELECTs,
+        above :data:`_SQL_HEAD_RE`, plus: ``UNION [ALL]`` between SELECTs,
         derived tables ``FROM (SELECT ...) t``, uncorrelated
         ``IN (SELECT ...)``, ranking window functions, and expression
         ORDER BY / GROUP BY)."""
@@ -598,9 +658,11 @@ class TPUSession:
 
     def _sql_select(self, query: str, created: List[str]) -> DataFrame:
         query = self._lift_derived_tables(query, created)
-        m = self._SQL_RE.match(query)
+        parts = self._split_clauses(query)
+        m = self._SQL_HEAD_RE.match(parts[0]) if parts is not None else None
         if not m:
             raise ValueError(f"Unsupported SQL (minimal dialect): {query!r}")
+        clauses = parts[1]
         out = self.table(m.group("table"))
         # table names/aliases usable as column qualifiers downstream
         # (WHERE t.score > 1 resolves t.score -> score)
@@ -609,7 +671,7 @@ class TPUSession:
             out, quals = self._apply_joins(
                 out, m.group("table"), m.group("talias"), m.group("joins")
             )
-        where = m.group("where")
+        where = clauses.get("where")
         if where:
             out = out.filter(
                 self._parse_predicate(where.strip(), quals, out.columns)
@@ -618,7 +680,7 @@ class TPUSession:
         proj_raw = [
             raw.strip() for raw in self._split_projections(m.group("proj"))
         ]
-        group = m.group("group")
+        group = clauses.get("group")
 
         def _window_match(p: str):
             text, _ = self._strip_alias(p)
@@ -648,9 +710,9 @@ class TPUSession:
             return group is not None or am.group("fn").lower() not in self.udf
 
         is_agg = group is not None or any(_is_agg_call(p) for p in proj_raw)
-        if m.group("having") and not is_agg:
+        if clauses.get("having") and not is_agg:
             raise ValueError("HAVING requires a GROUP BY / aggregate query")
-        order = m.group("order")
+        order = clauses.get("order")
         order_items = self._parse_order_items(order) if order else []
         distinct = bool(m.group("distinct"))
 
@@ -667,7 +729,7 @@ class TPUSession:
                     "(FROM (SELECT ... GROUP BY ...) t)"
                 )
             out, select_names = self._sql_aggregate(
-                out, proj_raw, group, having=m.group("having"),
+                out, proj_raw, group, having=clauses.get("having"),
                 qualifiers=quals, columns=out.columns,
             )
             if order_items:
@@ -679,8 +741,8 @@ class TPUSession:
                 out, m.group("proj").strip(), proj_raw, order_items,
                 distinct, quals,
             )
-        if m.group("limit"):
-            out = out.limit(int(m.group("limit")))
+        if clauses.get("limit"):
+            out = out.limit(int(clauses["limit"]))
         return out
 
     def _order_aggregated(

@@ -35,7 +35,7 @@ print("WORKER_FINISHED")
 """
 
 
-def build_fixtures(workdir):
+def build_fixtures(workdir, n=8):
     os.makedirs(workdir, exist_ok=True)
     model_path = os.path.join(workdir, "model.keras")
     if not os.path.exists(model_path):
@@ -45,7 +45,7 @@ def build_fixtures(workdir):
         )
         model.save(model_path)
     rng = np.random.RandomState(0)
-    for i in range(8):
+    for i in range(n):
         p = os.path.join(workdir, f"x{i}.npy")
         if not os.path.exists(p):
             np.save(p, rng.rand(4).astype(np.float32))
@@ -55,18 +55,18 @@ def load_vec(uri):
     return np.load(uri)
 
 
-def make_df(workdir):
+def make_df(workdir, n=8):
     from sparkdl_tpu.sql.session import TPUSession
 
     spark = TPUSession.builder.master("local[*]").getOrCreate()
     rows = [
         {"uri": os.path.join(workdir, f"x{i}.npy"), "label": [float(i % 2)]}
-        for i in range(8)
+        for i in range(n)
     ]
     return spark.createDataFrame(rows)
 
 
-def make_estimator(workdir, epochs):
+def make_estimator(workdir, epochs, ckpt="ckpt"):
     from sparkdl_tpu.estimators import KerasImageFileEstimator
 
     return KerasImageFileEstimator(
@@ -83,8 +83,15 @@ def make_estimator(workdir, epochs):
             "learning_rate": 0.05,
             "seed": 0,
         },
-        checkpointDir=os.path.join(workdir, "ckpt"),
+        checkpointDir=os.path.join(workdir, ckpt),
     )
+
+
+def model_weights(transformer):
+    """The fitted transformer's weights, loaded back from its tuned
+    model file (what a bit-identical-resume assertion compares)."""
+    m = keras.saving.load_model(transformer.getModelFile())
+    return [np.asarray(w) for w in m.get_weights()]
 
 
 @pytest.mark.slow
@@ -142,6 +149,144 @@ def test_sigkill_mid_training_then_resume(tmp_path, caplog):
     assert any(
         "resuming from checkpoint" in r.message for r in caplog.records
     ), "restart did not resume from the killed run's checkpoint"
+
+
+# ---------------------------------------------------------------------------
+# deterministic process death at the WORST instant: between the checkpoint
+# payload's async save dispatch and the commit marker.  The SIGKILL test
+# above kills at "some point after a checkpoint appeared"; this one uses
+# the fault-injection harness's `kill` action (os._exit(9), no atexit, no
+# finally) fired at the `estimator.checkpoint_saved` site — after
+# save_epoch(epoch_2) dispatched but before its background commit can
+# finalize — so the commit-marker protocol's "never resume an unfinalized
+# epoch" guarantee is pinned exactly, not probabilistically.
+# ---------------------------------------------------------------------------
+
+KILL_AT_COMMIT_WORKER = """
+import os, sys
+os.environ["KERAS_BACKEND"] = "jax"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from tests.test_fault_injection import build_fixtures, make_df, make_estimator
+workdir = {workdir!r}
+build_fixtures(workdir)
+make_estimator(workdir, epochs=4).fit(make_df(workdir))
+print("WORKER_FINISHED")
+"""
+
+
+def test_kill_between_payload_write_and_commit_marker(tmp_path, caplog):
+    from sparkdl_tpu.estimators import checkpointing
+
+    workdir = str(tmp_path)
+    build_fixtures(workdir)
+
+    env = dict(os.environ)
+    # die on the SECOND save dispatch: epoch_1 is fully committed by then
+    # (orbax serializes async saves), epoch_2's commit is in flight
+    env["SPARKDL_FAULT_PLAN"] = (
+        '[{"site": "estimator.checkpoint_saved", "kill": true, "at": 2}]'
+    )
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            KILL_AT_COMMIT_WORKER.format(repo=_REPO, workdir=workdir),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 9, (
+        f"worker should have died via the injected kill (rc="
+        f"{proc.returncode}):\n{(proc.stdout + proc.stderr)[-3000:]}"
+    )
+    assert "WORKER_FINISHED" not in proc.stdout
+
+    est = make_estimator(workdir, epochs=4)
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    namespace = est._ckpt_namespace()
+    committed = checkpointing.committed_epochs(ckpt_dir, namespace)
+    assert committed == [1], (
+        f"exactly epoch_1 must be committed after the mid-commit death; "
+        f"got {committed}"
+    )
+
+    # restart with the identical configuration: resume must pick epoch 1,
+    # never the unfinalized epoch_2 leftovers
+    import logging
+
+    with caplog.at_level(
+        logging.INFO,
+        logger="sparkdl_tpu.estimators.keras_image_file_estimator",
+    ):
+        model = est.fit(make_df(workdir))
+    assert model is not None and np.isfinite(model._training_loss)
+    resumes = [
+        r.message for r in caplog.records
+        if "resuming from checkpoint" in r.message
+    ]
+    assert resumes and "epoch 1" in resumes[0], (
+        f"restart must resume from the committed epoch 1, got {resumes}"
+    )
+
+
+def test_preemption_mid_epoch_resumes_bit_identical(tmp_path, caplog):
+    """Acceptance (d): a preemption delivered mid-epoch stops at the next
+    safe point, the last COMPLETED epoch's checkpoint is flushed, and a
+    re-fit resumes to weights bit-identical to an uninterrupted run."""
+    from sparkdl_tpu.estimators import checkpointing
+    from sparkdl_tpu.resilience import FaultPlan, Preempted, active_plan
+
+    workdir = str(tmp_path)
+    # 16 rows / batch_size 8 = 2 steps per epoch, so a preemption can
+    # land strictly inside an epoch
+    build_fixtures(workdir, n=16)
+    df = make_df(workdir, n=16)
+
+    # the uninterrupted reference: 3 epochs straight through
+    baseline = make_estimator(workdir, epochs=3, ckpt="ckpt_base").fit(df)
+
+    # preempt at global step 3 = epoch 2, step 1: the flag is set there
+    # and delivered at the NEXT safe point (epoch 2, step 2), so epoch 2
+    # never completes and only epoch_1 may be committed
+    est = make_estimator(workdir, epochs=3, ckpt="ckpt_resume")
+    plan = FaultPlan().add("estimator.step", preempt=True, at=3)
+    with active_plan(plan):
+        with pytest.raises(Preempted, match="injected preemption"):
+            est.fit(df)
+
+    ckpt_dir = os.path.join(workdir, "ckpt_resume")
+    namespace = est._ckpt_namespace()
+    assert checkpointing.committed_epochs(ckpt_dir, namespace) == [1], (
+        "the preempted fit must flush exactly the last completed epoch"
+    )
+
+    import logging
+
+    with caplog.at_level(
+        logging.INFO,
+        logger="sparkdl_tpu.estimators.keras_image_file_estimator",
+    ):
+        resumed = make_estimator(workdir, epochs=3, ckpt="ckpt_resume").fit(
+            df
+        )
+    assert any(
+        "resuming from checkpoint epoch 1" in r.message
+        for r in caplog.records
+    )
+
+    # bit-identical, not allclose: epoch replay + lossless float32
+    # checkpoints make the resumed run reproduce the uninterrupted one
+    # exactly
+    w_base = model_weights(baseline)
+    w_resumed = model_weights(resumed)
+    assert len(w_base) == len(w_resumed)
+    for a, b in zip(w_base, w_resumed):
+        np.testing.assert_array_equal(a, b)
+    assert baseline._training_loss == resumed._training_loss
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,76 @@
+"""The sleep-retry lint gate (``ci/lint_no_sleep_retry.py``): the repo
+itself stays clean, and the lint actually catches what it claims to.
+Running it here puts the gate in tier-1 — a hand-rolled retry loop
+anywhere outside ``sparkdl_tpu/resilience/`` fails the suite, not just
+the CI workflow step."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LINT = os.path.join(_REPO, "ci", "lint_no_sleep_retry.py")
+
+
+def run_lint(root):
+    return subprocess.run(
+        [sys.executable, _LINT, str(root)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_repo_has_no_ad_hoc_sleep_retry_loops():
+    proc = run_lint(_REPO)
+    assert proc.returncode == 0, (
+        f"sleep-retry lint failed:\n{proc.stdout}{proc.stderr}"
+    )
+
+
+def test_lint_flags_planted_violation(tmp_path):
+    pkg = tmp_path / "sparkdl_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        textwrap.dedent(
+            """
+            import time
+
+            def poll(fn):
+                while True:
+                    try:
+                        return fn()
+                    except Exception:
+                        time.sleep(1.0)
+            """
+        )
+    )
+    # an aliased import must not dodge the lint
+    (pkg / "sneaky.py").write_text(
+        textwrap.dedent(
+            """
+            from time import sleep as snooze
+
+            def poll(items):
+                for _ in items:
+                    snooze(0.5)
+            """
+        )
+    )
+    # sanctioned home: same code inside resilience/ is NOT flagged
+    home = pkg / "resilience"
+    home.mkdir()
+    (home / "policy.py").write_text(
+        "import time\nwhile False:\n    time.sleep(1)\n"
+    )
+    # a sleep NOT in a loop is fine anywhere
+    (pkg / "ok.py").write_text("import time\ntime.sleep(0)\n")
+
+    proc = run_lint(tmp_path)
+    assert proc.returncode == 1
+    assert "bad.py:" in proc.stdout
+    assert "sneaky.py:" in proc.stdout
+    assert "resilience/policy.py" not in proc.stdout
+    assert "ok.py" not in proc.stdout
+    assert "RetryPolicy" in proc.stdout  # the diagnostic names the fix

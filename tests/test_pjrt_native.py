@@ -30,18 +30,22 @@ def _plugin_usable() -> bool:
 
 
 def _tunnel_responsive(timeout_s: int = 120) -> "tuple[bool, str]":
-    """Bounded client-creation probe in a SUBPROCESS (shared helper —
-    see :mod:`sparkdl_tpu.utils.probes` for why).  The in-process
-    client is only created after the probe succeeds."""
-    from sparkdl_tpu.utils.probes import bounded_subprocess_probe
+    """Bounded client-creation probe through the resilience watchdog
+    (subprocess probe + hard-timeout backstop + typed error_class — see
+    :mod:`sparkdl_tpu.resilience.watchdog`).  The in-process client is
+    only created after the probe succeeds."""
+    from sparkdl_tpu.resilience.watchdog import check_device
 
-    return bounded_subprocess_probe(
-        "from sparkdl_tpu.native import pjrt\n"
-        "r = pjrt.PjrtRunner()\n"
-        "print('PLATFORM', r.platform())\n"
-        "r.close()\n",
+    record = check_device(
         timeout_s=timeout_s,
+        probe_code=(
+            "from sparkdl_tpu.native import pjrt\n"
+            "r = pjrt.PjrtRunner()\n"
+            "print('PLATFORM', r.platform())\n"
+            "r.close()\n"
+        ),
     )
+    return record["ok"], record["detail"]
 
 
 pytestmark = [
