@@ -43,6 +43,23 @@ REPEATS = 3
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--trace-out", default=None, metavar="PATH",
+        help="append a JSONL span trace of the run to PATH (obs "
+        "subsystem) alongside the one-line JSON result",
+    )
+    args = ap.parse_args()
+
+    from sparkdl_tpu.obs import JsonlTraceSink, tracer
+
+    sink = None
+    if args.trace_out:
+        sink = JsonlTraceSink(path=args.trace_out)
+        tracer.enable(sink)
+
     from sparkdl_tpu.resilience.watchdog import check_device
 
     probe = check_device(timeout_s=300)
@@ -62,11 +79,18 @@ def main():
                 }
             )
         )
+        if sink is not None:
+            sink.flush()
         return 2
 
     from sparkdl_tpu.utils.benchlib import measure_featurizer
 
-    out = measure_featurizer("InceptionV3", BATCH, SCAN_LEN, REPEATS)
+    with tracer.span(
+        "bench.featurizer", batch=BATCH, scan_len=SCAN_LEN, repeats=REPEATS
+    ):
+        out = measure_featurizer("InceptionV3", BATCH, SCAN_LEN, REPEATS)
+    if sink is not None:
+        sink.flush()
     print(
         json.dumps(
             {

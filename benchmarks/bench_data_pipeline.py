@@ -43,13 +43,22 @@ def main():
                     help="simulated per-image decode cost")
     ap.add_argument("--step-ms", type=float, default=2.0,
                     help="simulated extra per-batch consumer work")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="append a JSONL span trace of the measured epoch "
+                    "to PATH (obs subsystem)")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
 
     from sparkdl_tpu.data import Dataset
+    from sparkdl_tpu.obs import JsonlTraceSink, tracer
     from sparkdl_tpu.utils.metrics import metrics
+
+    sink = None
+    if args.trace_out:
+        sink = JsonlTraceSink(path=args.trace_out)
+        tracer.enable(sink)
 
     rng = np.random.RandomState(0)
     seeds = rng.randint(0, 2**31, size=args.rows)
@@ -80,12 +89,18 @@ def main():
     metrics.reset()
     total = 0.0
     t0 = time.perf_counter()
-    for b in pipeline:
-        x = np.stack(b.items) if isinstance(b.items, list) else b.items
-        total += float(step(x))
-        if args.step_ms:
-            time.sleep(args.step_ms / 1000.0)
+    with tracer.span(
+        "bench.data_pipeline", rows=args.rows, batch_size=args.batch_size,
+        workers=args.workers, prefetch=args.prefetch,
+    ):
+        for b in pipeline:
+            x = np.stack(b.items) if isinstance(b.items, list) else b.items
+            total += float(step(x))
+            if args.step_ms:
+                time.sleep(args.step_ms / 1000.0)
     elapsed = time.perf_counter() - t0
+    if sink is not None:
+        sink.flush()
 
     snap = metrics.snapshot()
     stall_ms = snap.get("data.device_stall_ms.mean", 0.0) * snap.get(

@@ -1,14 +1,28 @@
 #!/usr/bin/env bash
 # Fault-injection suite: run the resilience + fault-injection tests on
 # the CPU backend (JAX_PLATFORMS=cpu — deterministic, no TPU needed),
-# then the no-ad-hoc-sleep-retry lint.  Tier-1: wired into the `tests`
-# job of .github/workflows/ci.yml.
+# then the no-ad-hoc-sleep-retry and metric-name lints.  Tier-1: wired
+# into the `tests` job of .github/workflows/ci.yml.
+#
+# The test run captures a span trace (SPARKDL_TRACE_OUT — retry
+# attempts, breaker flips, batch fan-in); on failure the tail of the
+# trace is printed so CI logs show *what the code was doing*, not just
+# the assertion that noticed.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
-  -q -m 'not slow' -p no:cacheprovider
+TRACE_OUT="$(mktemp -t fault-suite-trace.XXXXXX.jsonl)"
+trap 'rm -f "$TRACE_OUT"' EXIT
+export SPARKDL_TRACE_OUT="$TRACE_OUT"
+
+if ! python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
+  -q -m 'not slow' -p no:cacheprovider; then
+  echo "--- captured span trace (last 50 spans, $TRACE_OUT) ---" >&2
+  tail -n 50 "$TRACE_OUT" >&2 || true
+  exit 1
+fi
 
 python ci/lint_no_sleep_retry.py .
+python ci/lint_metric_names.py .

@@ -1,0 +1,192 @@
+"""End-to-end tracing: a fit and a serve round-trip, captured as spans.
+
+The observability subsystem (:mod:`sparkdl_tpu.obs`) answers "where did
+THIS step/request spend its time" — the question the ``metrics.*``
+counters alone cannot.  This example walks the whole surface, offline:
+
+1. ``tracer.enable(JsonlTraceSink(path))`` turns tracing on (off by
+   default — instrumented code paths cost one branch until then);
+2. ``KerasImageFileEstimator.fit`` emits an ``estimator.fit`` root span
+   with per-epoch stall-attribution events, one ``estimator.step`` span
+   per optimizer step, and ``estimator.checkpoint`` spans;
+3. concurrent requests against a :class:`ModelServer` emit one
+   ``serving.request`` span each; every coalesced device batch emits a
+   ``serving.batch`` span that RECORDS ITS MEMBERS' span ids (and each
+   member a ``coalesced`` event) — the fan-in is auditable both ways;
+4. a flaky dependency under :class:`RetryPolicy` + ``CircuitBreaker``
+   shows retry attempts and breaker flips landing as events on the
+   current span — a retry storm and its breaker trip share one trace;
+5. the trace flushes to JSONL, and the same run's metrics render as
+   Prometheus text via ``prometheus_text`` / ``server.metrics_text()``.
+
+Works on the real TPU or the virtual CPU mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/tracing.py
+"""
+
+import collections
+import json
+import os
+import tempfile
+import threading
+
+import numpy as np
+from PIL import Image
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+IMAGE = 32
+CLASSES = 2
+N_REQUESTS = 12
+
+
+def image_loader(uri):
+    return np.asarray(Image.open(uri), dtype=np.float32) / 255.0
+
+
+def main():
+    import keras
+
+    from sparkdl_tpu import ModelServer, ServingConfig
+    from sparkdl_tpu.estimators import KerasImageFileEstimator
+    from sparkdl_tpu.obs import JsonlTraceSink, prometheus_text, tracer
+    from sparkdl_tpu.resilience import (
+        CircuitBreaker,
+        RetryPolicy,
+        TransientError,
+    )
+    from sparkdl_tpu.sql.session import TPUSession
+
+    root = tempfile.mkdtemp(prefix="sparkdl_tracing_")
+    trace_path = os.path.join(root, "trace.jsonl")
+
+    # 1. tracing on — everything below is captured
+    sink = JsonlTraceSink(path=trace_path)
+    tracer.enable(sink)
+
+    spark = TPUSession.builder.master("local[*]").getOrCreate()
+
+    rng = np.random.RandomState(0)
+    rows = []
+    for i in range(32):
+        label = i % CLASSES
+        img = rng.randint(0, 80, (IMAGE, IMAGE, 3), np.uint8)
+        img[..., label] += 120
+        path = os.path.join(root, f"img_{i}.png")
+        Image.fromarray(img).save(path)
+        rows.append({"uri": path, "label": float(label)})
+    df = spark.createDataFrame(rows)
+
+    keras.utils.set_random_seed(0)
+    model = keras.Sequential(
+        [
+            keras.layers.Input(shape=(IMAGE, IMAGE, 3)),
+            keras.layers.Conv2D(8, 3, activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(CLASSES, activation="softmax"),
+        ]
+    )
+    model_path = os.path.join(root, "base.keras")
+    model.save(model_path)
+
+    # 2. traced fit: estimator.fit > estimator.step / estimator.checkpoint
+    est = KerasImageFileEstimator(
+        inputCol="uri",
+        outputCol="preds",
+        labelCol="label",
+        imageLoader=image_loader,
+        modelFile=model_path,
+        kerasOptimizer="adam",
+        kerasLoss="sparse_categorical_crossentropy",
+        kerasFitParams={"epochs": 2, "batch_size": 16,
+                        "learning_rate": 1e-3},
+        checkpointDir=os.path.join(root, "ckpt"),
+    )
+    est.fit(df)
+
+    # 3. traced serving: request spans fan into batch spans
+    server = ModelServer.from_keras(
+        model_path,
+        model_id="cnn",
+        config=ServingConfig(max_batch=8, max_wait_ms=25.0),
+    )
+    server.warmup()
+    images = rng.rand(N_REQUESTS, IMAGE, IMAGE, 3).astype(np.float32)
+    results = [None] * N_REQUESTS
+    barrier = threading.Barrier(N_REQUESTS)
+
+    def client(i):
+        barrier.wait()
+        results[i] = server.predict(images[i], timeout=60.0)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(N_REQUESTS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert np.stack(results).shape == (N_REQUESTS, CLASSES)
+
+    # 4. resilience events land on the current span
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise TransientError("dependency hiccup")
+        return "ok"
+
+    breaker = CircuitBreaker("demo_dep", failure_threshold=2, recovery_s=60)
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                         sleep=lambda s: None)
+    with tracer.span("demo.flaky_dependency") as flaky_span:
+        assert policy.call(flaky) == "ok"
+        for _ in range(2):  # now trip the breaker on a dead dependency
+            try:
+                breaker.call(lambda: (_ for _ in ()).throw(
+                    TransientError("down")))
+            except TransientError:
+                pass
+    event_names = [e["name"] for e in flaky_span.events]
+    assert event_names.count("retry") == 2
+    assert "breaker_state" in event_names
+    print(f"flaky-dependency span events: {event_names}")
+
+    # 5. export: JSONL trace + Prometheus text
+    prom = server.metrics_text(serving_only=True)
+    server.close()
+    spark.stop()
+    n_spans = sink.flush()
+
+    with open(trace_path) as fh:
+        spans = [json.loads(line) for line in fh]
+    by_name = collections.Counter(s["name"] for s in spans)
+    fit_span, = (s for s in spans if s["name"] == "estimator.fit")
+    epochs = [e for e in fit_span["events"] if e["name"] == "epoch"]
+    batches = [s for s in spans if s["name"] == "serving.batch"]
+    requests = [s for s in spans if s["name"] == "serving.request"]
+    member_ids = sorted(
+        sid for b in batches for sid in b["attributes"]["member_span_ids"]
+    )
+    assert member_ids == sorted(r["span_id"] for r in requests)
+
+    print(f"captured {n_spans} spans: "
+          + ", ".join(f"{n}×{name}" for name, n in sorted(by_name.items())))
+    print(f"fit span: {fit_span['duration_ms']:.0f}ms over "
+          f"{len(epochs)} epochs; epoch 1 host stall "
+          f"{epochs[0]['host_stall_ms']:.1f}ms")
+    print(f"{len(requests)} request spans coalesced into "
+          f"{len(batches)} batch spans (member ids recorded both ways)")
+    prom_lines = [ln for ln in prom.splitlines() if not ln.startswith("#")]
+    print(f"prometheus export: {len(prom_lines)} samples, e.g. "
+          + "; ".join(prom_lines[:2]))
+    assert "serving_requests" in prom
+    assert prometheus_text(prefix="estimator.")  # fit metrics exported too
+    print(f"trace written to {trace_path}")
+    print("tracing OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -56,9 +56,24 @@ _EXPORTS = {
     "DeviceUnresponsive": "sparkdl_tpu.resilience",
     "Preempted": "sparkdl_tpu.resilience",
     "FaultPlan": "sparkdl_tpu.resilience",
+    "Span": "sparkdl_tpu.obs",
+    "Tracer": "sparkdl_tpu.obs",
+    "tracer": "sparkdl_tpu.obs",
+    "JsonlTraceSink": "sparkdl_tpu.obs",
+    "prometheus_text": "sparkdl_tpu.obs",
 }
 
 __all__ = ["VERSION", *sorted(_EXPORTS)]
+
+# Zero-code trace capture (mirrors SPARKDL_FAULT_PLAN / profiler's
+# SPARKDL_PROFILE_DIR): SPARKDL_TRACE_OUT=<path.jsonl> enables the
+# tracer with a bounded JSONL sink flushed (append) at interpreter
+# exit, so subprocess workers capture into the same file with no code
+# changes.  No env var -> no obs import -> zero cost.
+if os.environ.get("SPARKDL_TRACE_OUT"):
+    from sparkdl_tpu.obs import enable_from_env as _obs_enable_from_env
+
+    _obs_enable_from_env()
 
 
 def __getattr__(name):

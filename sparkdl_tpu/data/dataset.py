@@ -207,6 +207,21 @@ class Dataset:
             from collections import deque
             from concurrent.futures import ThreadPoolExecutor
 
+            from sparkdl_tpu.obs.trace import tracer
+
+            # explicit trace propagation: capture the current span HERE
+            # (the thread driving the pipeline) and re-attach it around
+            # each pool task — pool threads never inherit context
+            # silently.  With tracing off, capture() is None and the
+            # unwrapped item_fn runs at zero extra cost.
+            span = tracer.capture()
+            if span is None:
+                run = item_fn
+            else:
+                def run(item):
+                    with tracer.use_span(span):
+                        return item_fn(item)
+
             it = iter(src)
             pending: "deque" = deque()
             pool = ThreadPoolExecutor(
@@ -215,7 +230,7 @@ class Dataset:
             )
             try:
                 for item in it:
-                    pending.append(pool.submit(item_fn, item))
+                    pending.append(pool.submit(run, item))
                     if len(pending) >= window:
                         yield pending.popleft().result()
                 while pending:
@@ -362,6 +377,7 @@ class Dataset:
 
         def prefetched():
             from sparkdl_tpu.data.prefetch import PrefetchIterator
+            from sparkdl_tpu.obs.trace import tracer
             from sparkdl_tpu.utils.metrics import metrics
 
             stall = metrics.histogram("data.device_stall_ms")
@@ -373,6 +389,10 @@ class Dataset:
                 on_wait_ms=stall.observe,
                 on_depth=depth.set,
                 on_busy_s=lambda s: busy.add_seconds(s),
+                # consumer-side capture: the producer thread re-attaches
+                # this span, so upstream stages (and their retries) land
+                # in the consumer's trace instead of an orphan context
+                context_span=tracer.capture(),
             )
             try:
                 for item in it:

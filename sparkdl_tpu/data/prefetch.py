@@ -56,6 +56,7 @@ class PrefetchIterator:
         on_wait_ms: Optional[Callable[[float], None]] = None,
         on_depth: Optional[Callable[[int], None]] = None,
         on_busy_s: Optional[Callable[[float], None]] = None,
+        context_span=None,
     ):
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, int(size)))
         self._cancel = threading.Event()
@@ -63,6 +64,11 @@ class PrefetchIterator:
         self._on_wait_ms = on_wait_ms
         self._on_depth = on_depth
         self._on_busy_s = on_busy_s
+        # trace context crosses the queue boundary EXPLICITLY: the
+        # consumer captures its current span (obs.trace) and hands it
+        # over here; the producer thread re-attaches it for its whole
+        # run.  None (tracing off / no open span) costs nothing.
+        self._context_span = context_span
         self._thread = threading.Thread(
             target=self._produce, args=(source_factory,), daemon=True
         )
@@ -70,6 +76,15 @@ class PrefetchIterator:
 
     # ------------------------------------------------------------------
     def _produce(self, source_factory) -> None:
+        if self._context_span is not None:
+            from sparkdl_tpu.obs.trace import tracer
+
+            with tracer.use_span(self._context_span):
+                self._produce_loop(source_factory)
+        else:
+            self._produce_loop(source_factory)
+
+    def _produce_loop(self, source_factory) -> None:
         it = None
         try:
             it = iter(source_factory())

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from sparkdl_tpu.estimators import checkpointing
+from sparkdl_tpu.obs.hooks import fit_profiler
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.resilience.preempt import preemption_scope
 from sparkdl_tpu.estimators.data import (
@@ -460,7 +461,11 @@ class FlaxImageFileEstimator(
         # bit-identically (permutation replay above) — same as
         # KerasImageFileEstimator
         try:
-            with preemption_scope() as ptoken:
+            with preemption_scope() as ptoken, fit_profiler(
+                "FlaxImageFileEstimator",
+                epochs=epochs,
+                steps_per_epoch=steps_per_epoch,
+            ) as prof:
                 for epoch in range(start_epoch, epochs):
                     order = rng.permutation(n)
                     # the epoch as a sparkdl_tpu.data Dataset (cyclic-pad
@@ -472,17 +477,20 @@ class FlaxImageFileEstimator(
                     for batch in epoch_ds:
                         ptoken.check()
                         inject.fire("estimator.step")
-                        state, loss = step_fn(state, place_batch(batch))
+                        with prof.step():
+                            state, loss = step_fn(state, place_batch(batch))
                     inject.fire("estimator.epoch")
                     last_loss = float(loss)
+                    prof.epoch(epoch + 1, last_loss)
                     logger.info(
                         "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
                     )
                     if ckptr is not None:
-                        checkpointing.save_epoch(
-                            ckptr, ckpt_dir, namespace, epoch + 1,
-                            self._ckpt_payload(state),
-                        )
+                        with prof.checkpoint(epoch=epoch + 1):
+                            checkpointing.save_epoch(
+                                ckptr, ckpt_dir, namespace, epoch + 1,
+                                self._ckpt_payload(state),
+                            )
                         inject.fire("estimator.checkpoint_saved")
         finally:
             if ckptr is not None:

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from sparkdl_tpu.estimators import checkpointing
+from sparkdl_tpu.obs.hooks import fit_profiler
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.resilience.preempt import preemption_scope
 from sparkdl_tpu.estimators.data import (
@@ -253,7 +254,11 @@ class KerasImageFileEstimator(
         # then commits the last completed epoch before the process yields,
         # and a re-fit resumes bit-identically (permutation replay above).
         try:
-            with preemption_scope() as ptoken:
+            with preemption_scope() as ptoken, fit_profiler(
+                "KerasImageFileEstimator",
+                epochs=epochs,
+                steps_per_epoch=steps_per_epoch,
+            ) as prof:
                 for epoch in range(start_epoch, epochs):
                     order = rng.permutation(n)
                     # both arms iterate a sparkdl_tpu.data Dataset with the
@@ -271,9 +276,11 @@ class KerasImageFileEstimator(
                     for batch in epoch_ds:
                         ptoken.check()
                         inject.fire("estimator.step")
-                        state, loss = step_fn(state, place(batch))
+                        with prof.step():
+                            state, loss = step_fn(state, place(batch))
                     inject.fire("estimator.epoch")
                     last_loss = float(loss)
+                    prof.epoch(epoch + 1, last_loss)
                     logger.info(
                         "epoch %d/%d loss=%.4f", epoch + 1, epochs, last_loss
                     )
@@ -285,10 +292,11 @@ class KerasImageFileEstimator(
                         # (SURVEY.md §5.4): arrays are snapshotted to host
                         # synchronously, disk commit happens behind the next
                         # epoch's steps
-                        checkpointing.save_epoch(
-                            ckptr, ckpt_dir, namespace, epoch + 1,
-                            self._ckpt_payload(state),
-                        )
+                        with prof.checkpoint(epoch=epoch + 1):
+                            checkpointing.save_epoch(
+                                ckptr, ckpt_dir, namespace, epoch + 1,
+                                self._ckpt_payload(state),
+                            )
                         inject.fire("estimator.checkpoint_saved")
         finally:
             if ckptr is not None:

@@ -1,0 +1,80 @@
+"""sparkdl_tpu.obs — structured tracing, span-correlated metrics, export.
+
+PRs 1–3 left each subsystem emitting ad-hoc ``metrics.*`` counters with
+no way to answer "where did this request/step spend its time" or "which
+retry belongs to which epoch".  This package is the missing tracing
+layer (the tf.data / TensorFlow first-class-instrumentation posture —
+arXiv:2101.12127, arXiv:1605.08695):
+
+- :mod:`trace` — :class:`Span`/:class:`Tracer` with parent/child
+  nesting, attributes, span events, and a context-local current span
+  whose cross-thread propagation is EXPLICIT (``capture()`` +
+  ``use_span()``) through the ``data`` pipeline's worker threads and
+  the serving micro-batcher;
+- :mod:`export` — a bounded :class:`JsonlTraceSink` and
+  :func:`prometheus_text` (counters/gauges/timers/histogram summaries
+  with p50/p95/p99 from the sliding-window ``Histogram``);
+- :mod:`hooks` — :class:`FitProfiler` step/epoch/checkpoint spans and
+  host-stall attribution for both estimator fit loops; retry attempts
+  and breaker state changes surface as span events through
+  ``resilience.policy`` → :func:`trace.record_event`.
+
+Disabled by default: every instrumentation site costs one branch until
+``tracer.enable(...)`` (or the ``SPARKDL_TRACE_OUT`` env var — the
+zero-code hook ``ci/fault-suite.sh`` and subprocess workers use).
+
+Layering: ``obs`` depends only on ``utils`` (metrics).  ``data``,
+``serving`` and the estimators import it; ``resilience`` touches it
+only through a lazy cold-path import in ``policy`` (documented there).
+"""
+
+from sparkdl_tpu.obs.export import JsonlTraceSink, prometheus_text
+from sparkdl_tpu.obs.hooks import FitProfiler, fit_profiler
+from sparkdl_tpu.obs.trace import (
+    Span,
+    Tracer,
+    current_span,
+    record_event,
+    tracer,
+)
+
+ENV_VAR = "SPARKDL_TRACE_OUT"
+
+#: the sink installed by :func:`enable_from_env`, if any
+_env_sink = None
+
+
+def enable_from_env() -> "JsonlTraceSink | None":
+    """Enable tracing when ``SPARKDL_TRACE_OUT`` names a JSONL path.
+
+    Called from ``sparkdl_tpu/__init__`` at import time (mirroring
+    ``SPARKDL_FAULT_PLAN`` / ``SPARKDL_PROFILE_DIR``), so subprocess
+    workers need no code changes to capture traces; the buffer flushes
+    (append) at interpreter exit.  Idempotent.
+    """
+    global _env_sink
+    import atexit
+    import os
+
+    path = os.environ.get(ENV_VAR)
+    if not path or _env_sink is not None:
+        return _env_sink
+    _env_sink = JsonlTraceSink(path=path)
+    tracer.enable(_env_sink)
+    atexit.register(_env_sink.flush)
+    return _env_sink
+
+
+__all__ = [
+    "ENV_VAR",
+    "FitProfiler",
+    "JsonlTraceSink",
+    "Span",
+    "Tracer",
+    "current_span",
+    "enable_from_env",
+    "fit_profiler",
+    "prometheus_text",
+    "record_event",
+    "tracer",
+]

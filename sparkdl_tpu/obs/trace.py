@@ -1,0 +1,268 @@
+"""Structured tracing: spans with parent/child nesting and *explicit*
+cross-thread propagation.
+
+The ``metrics.*`` counters PRs 1–3 grew answer "how much / how fast" but
+not "where did THIS request/step spend its time" — the question the
+tf.data paper's stall attribution (arXiv:2101.12127) and TensorFlow's
+first-class tracing layer (arXiv:1605.08695) exist to answer.  A
+:class:`Span` is one timed region with attributes and point-in-time
+events; a :class:`Tracer` maintains the context-local current span and
+delivers finished spans to sinks (:mod:`sparkdl_tpu.obs.export`).
+
+Design rules:
+
+- **disabled by default, pay-nothing**: every instrumentation site is
+  gated on one attribute read (``tracer.enabled``); with tracing off the
+  hot loops see a single branch, no allocation (acceptance gate: <5%
+  overhead on ``benchmarks/bench_data_pipeline.py``);
+- **explicit propagation across threads**: the current span lives in a
+  ``contextvars.ContextVar``, which deliberately does NOT leak into
+  worker threads — a pipeline stage that moves work across a queue must
+  ``capture()`` the span on the submitting side and re-attach it with
+  :meth:`Tracer.use_span` on the worker (``data.prefetch`` / the
+  threaded ``data.map`` / the serving micro-batcher all do; no ambient
+  thread-local crosses a queue boundary silently);
+- **monotonic timing, wall anchoring**: durations come from
+  ``time.perf_counter`` (immune to clock steps); each span also records
+  one ``time.time`` start so exported traces can be correlated with
+  logs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+#: process-wide id streams (span ids are unique per process; trace ids
+#: group one root span with all its descendants)
+_span_ids = itertools.count(1)
+_trace_ids = itertools.count(1)
+
+
+class Span:
+    """One timed region of work.
+
+    Created through :meth:`Tracer.span` / :meth:`Tracer.start_span` —
+    never directly.  Thread-safe for ``event``/``set_attribute`` (a
+    serving request span is touched by the submitter and the batch
+    worker); ``end()`` is idempotent.
+    """
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "name", "attributes",
+        "events", "start_wall", "_start", "_end", "_tracer", "_lock",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 parent: Optional["Span"], attributes: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.span_id = next(_span_ids)
+        if parent is not None:
+            self.trace_id = parent.trace_id
+            self.parent_id = parent.span_id
+        else:
+            self.trace_id = next(_trace_ids)
+            self.parent_id = None
+        self.attributes = dict(attributes)
+        self.events: List[Dict[str, Any]] = []
+        self.start_wall = time.time()
+        self._start = time.perf_counter()
+        self._end: Optional[float] = None
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def set_attribute(self, key: str, value: Any) -> None:
+        with self._lock:
+            self.attributes[key] = value
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Record a point-in-time event (retry attempt, breaker flip,
+        coalescing decision) with its offset from span start."""
+        evt = {"name": name, "offset_ms": self.offset_ms(), **attrs}
+        with self._lock:
+            self.events.append(evt)
+
+    def offset_ms(self) -> float:
+        return (time.perf_counter() - self._start) * 1000.0
+
+    @property
+    def ended(self) -> bool:
+        return self._end is not None
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self._end is None:
+            return None
+        return (self._end - self._start) * 1000.0
+
+    def end(self) -> None:
+        """Close the span and deliver it to the tracer's sinks.
+        Idempotent — a double end keeps the first timestamp."""
+        with self._lock:
+            if self._end is not None:
+                return
+            self._end = time.perf_counter()
+        self._tracer._deliver(self)
+
+    # ------------------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        """The export form (what :class:`~sparkdl_tpu.obs.export.
+        JsonlTraceSink` writes, one JSON object per line)."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "trace_id": self.trace_id,
+                "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "start_unix_s": round(self.start_wall, 6),
+                "duration_ms": (
+                    round(self.duration_ms, 4) if self.ended else None
+                ),
+                "attributes": dict(self.attributes),
+                "events": list(self.events),
+            }
+
+    def __repr__(self):
+        state = f"{self.duration_ms:.2f}ms" if self.ended else "open"
+        return (
+            f"<Span {self.name!r} id={self.span_id} "
+            f"parent={self.parent_id} {state}>"
+        )
+
+
+class Tracer:
+    """Process-wide span factory + context-local current span.
+
+    Off by default: :meth:`span` returns a no-op context and
+    :meth:`current` returns None until :meth:`enable` installs at least
+    the enabled flag (sinks are optional — spans without a sink still
+    propagate context, e.g. for tests reading ``current()``).
+    """
+
+    def __init__(self):
+        # contextvars (not threading.local): nested spans restore the
+        # previous current on exit, and NEW threads start with no
+        # current span — cross-thread propagation is explicit by design
+        import contextvars
+
+        self._current: "contextvars.ContextVar[Optional[Span]]" = (
+            contextvars.ContextVar("sparkdl_current_span", default=None)
+        )
+        self._lock = threading.Lock()
+        self._sinks: tuple = ()
+        self.enabled = False
+
+    # -- lifecycle -----------------------------------------------------
+    def enable(self, sink: Optional[Callable[[Dict[str, Any]], None]] = None
+               ) -> "Tracer":
+        """Turn tracing on, optionally adding ``sink`` (a callable
+        receiving each finished span's ``to_dict()``)."""
+        with self._lock:
+            if sink is not None and sink not in self._sinks:
+                self._sinks = self._sinks + (sink,)
+            self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        """Turn tracing off and drop all sinks (tests use this to
+        restore the pay-nothing default)."""
+        with self._lock:
+            self.enabled = False
+            self._sinks = ()
+
+    def add_sink(self, sink: Callable[[Dict[str, Any]], None]) -> None:
+        with self._lock:
+            if sink not in self._sinks:
+                self._sinks = self._sinks + (sink,)
+
+    def _deliver(self, span: Span) -> None:
+        for sink in self._sinks:
+            try:
+                sink(span.to_dict())
+            except Exception:  # pragma: no cover - a sink must not
+                pass           # break the traced code path
+
+    # -- context -------------------------------------------------------
+    def current(self) -> Optional[Span]:
+        """The context-local current span (None when tracing is off or
+        no span is open on this thread/context)."""
+        return self._current.get()
+
+    def capture(self) -> Optional[Span]:
+        """Explicit handle for crossing a queue/thread boundary: grab it
+        on the submitting side, re-attach on the worker with
+        :meth:`use_span`.  None when there is nothing to propagate —
+        callers skip their wrapping entirely then (zero overhead)."""
+        if not self.enabled:
+            return None
+        return self._current.get()
+
+    @contextmanager
+    def use_span(self, span: Optional[Span]):
+        """Attach an EXISTING span as current for the block without
+        ending it on exit — the cross-thread propagation primitive."""
+        if span is None:
+            yield None
+            return
+        token = self._current.set(span)
+        try:
+            yield span
+        finally:
+            self._current.reset(token)
+
+    # -- span creation -------------------------------------------------
+    def start_span(self, name: str, parent: Optional[Span] = None,
+                   **attributes: Any) -> Optional[Span]:
+        """A manually-ended span (serving request spans end from a
+        future callback, not a ``with`` block).  Child of ``parent``
+        (explicit) or of the current span; None when disabled."""
+        if not self.enabled:
+            return None
+        if parent is None:
+            parent = self._current.get()
+        return Span(self, name, parent, attributes)
+
+    @contextmanager
+    def span(self, name: str, parent: Optional[Span] = None,
+             **attributes: Any):
+        """Open a child span for the block: becomes the current span,
+        ends (and is delivered) on exit.  With tracing disabled, yields
+        None at the cost of one branch."""
+        if not self.enabled:
+            yield None
+            return
+        sp = self.start_span(name, parent=parent, **attributes)
+        token = self._current.set(sp)
+        try:
+            yield sp
+        finally:
+            self._current.reset(token)
+            sp.end()
+
+
+#: the process-wide tracer (analog of ``utils.metrics.metrics``)
+tracer = Tracer()
+
+
+def current_span() -> Optional[Span]:
+    """Module-level convenience for :meth:`Tracer.current`."""
+    return tracer.current()
+
+
+def record_event(name: str, **attrs: Any) -> None:
+    """Attach an event to the current span, if any.
+
+    The one-line hook low layers (``resilience``) call from cold paths:
+    with tracing off it is a single attribute read, and with no span
+    open it is a no-op — so a retry loop can always call it without
+    knowing whether anyone is watching.
+    """
+    if not tracer.enabled:
+        return
+    span = tracer.current()
+    if span is not None:
+        span.event(name, **attrs)

@@ -16,7 +16,9 @@ per-stage error policies applied to this engine's pipelines.
 Layering: :mod:`resilience` depends only on :mod:`utils` (metrics,
 probes) — never on estimators/serving/data, which all import *it*.  The
 one deliberate exception is ``classify``'s lazy imports of the typed
-errors those layers already define.
+errors those layers already define — plus ``policy``'s lazy cold-path
+import of :func:`sparkdl_tpu.obs.trace.record_event`, so retry attempts
+and breaker state changes surface as span events when tracing is on.
 """
 
 from sparkdl_tpu.resilience.errors import (

@@ -41,6 +41,20 @@ from sparkdl_tpu.utils.metrics import metrics
 logger = logging.getLogger(__name__)
 
 
+def _span_event(name: str, **attrs) -> None:
+    """Attach an event to the current trace span, if tracing is on.
+
+    ``obs`` is a higher layer than ``resilience``; this lazy import on
+    the cold paths only (a retry about to sleep, a breaker flipping
+    state) is the one sanctioned crossing — with tracing off it costs a
+    ``sys.modules`` lookup plus one branch, on paths already paying a
+    backoff sleep or a state transition.
+    """
+    from sparkdl_tpu.obs.trace import record_event
+
+    record_event(name, **attrs)
+
+
 class Deadline:
     """An absolute bound on wall time, passed BY VALUE through call
     chains (unlike per-call timeouts, a deadline shrinks as work
@@ -179,6 +193,12 @@ class RetryPolicy:
                         delay = min(delay, rem)
                 metrics.counter("resilience.retries").add(1)
                 metrics.timer("resilience.backoff").add_seconds(delay)
+                _span_event(
+                    "retry",
+                    attempt=attempt,
+                    error=type(exc).__name__,
+                    delay_s=round(delay, 6),
+                )
                 if on_retry is not None:
                     on_retry(attempt, exc)
                 logger.debug(
@@ -241,8 +261,18 @@ class CircuitBreaker:
 
     # -- transitions (callers hold the lock) ---------------------------
     def _to(self, state: str) -> None:
+        previous = self._state
         self._state = state
         self._gauge.set(_STATE_VALUE[state])
+        # a state flip is rare and diagnostic gold: correlate it with
+        # the request/step span it happened under (a retry storm and
+        # its breaker trip then share one trace)
+        _span_event(
+            "breaker_state",
+            breaker=self.name,
+            state=state,
+            from_state=previous,
+        )
 
     def allow(self) -> bool:
         """May a call proceed right now?  (Half-open admits probes up to

@@ -33,6 +33,10 @@ class Request:
     enqueued_at: float = field(default_factory=time.monotonic)
     #: absolute ``time.monotonic()`` expiry, or None for no deadline
     deadline: Optional[float] = None
+    #: the request's ``obs`` trace span (None when tracing is off) —
+    #: captured at submit, carried EXPLICITLY across the queue so the
+    #: batch worker can record which member spans it coalesced
+    span: Optional[Any] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

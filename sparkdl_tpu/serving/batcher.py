@@ -28,6 +28,7 @@ import numpy as np
 
 import jax
 
+from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.resilience.errors import CircuitOpen
 from sparkdl_tpu.resilience.policy import CircuitBreaker, Deadline, RetryPolicy
@@ -84,6 +85,18 @@ class ServingConfig:
             f"breaker_threshold={self.breaker_threshold}, "
             f"breaker_recovery_s={self.breaker_recovery_s})"
         )
+
+
+def _end_request_span(span):
+    """Future callback closing a request span with its outcome."""
+
+    def done(future):
+        exc = future.exception()
+        if exc is not None:
+            span.set_attribute("error", type(exc).__name__)
+        span.end()
+
+    return done
 
 
 class MicroBatcher:
@@ -163,6 +176,15 @@ class MicroBatcher:
             else None
         )
         req = Request(value=arr, deadline=deadline)
+        if tracer.enabled:
+            # one span per request, child of the caller's current span;
+            # it ends when the future resolves (on the worker thread),
+            # recording queue+batch+forward as one client-visible region
+            rspan = tracer.start_span(
+                "serving.request", model_id=self.model_id
+            )
+            req.span = rspan
+            req.future.add_done_callback(_end_request_span(rspan))
         metrics.counter("serving.requests").add(1)
         self._ensure_worker()
         self._queue.offer(req)
@@ -261,6 +283,30 @@ class MicroBatcher:
                 return np.asarray(jax.device_get(fn(x)))
             return np.asarray(self._forward(x))
 
+        if not tracer.enabled:
+            self._forward_batch(live, bucket, forward_once)
+            return
+        # the span fan-in: one batch span per coalesced device call,
+        # carrying its member requests' span ids (and each member span
+        # gets a "coalesced" event pointing back) — so a trace can walk
+        # request -> batch -> retry events in either direction
+        with tracer.span(
+            "serving.batch",
+            model_id=self.model_id,
+            bucket=bucket,
+            n_real=len(live),
+            member_span_ids=[
+                r.span.span_id for r in live if r.span is not None
+            ],
+        ) as bspan:
+            for r in live:
+                if r.span is not None:
+                    r.span.event(
+                        "coalesced", batch_span=bspan.span_id, bucket=bucket
+                    )
+            self._forward_batch(live, bucket, forward_once)
+
+    def _forward_batch(self, live, bucket, forward_once) -> None:
         try:
             # breaker first: while open, fail the batch fast with the
             # typed (transient) CircuitOpen instead of hammering a dead

@@ -229,7 +229,6 @@ class ModelServer:
         PJRT tunnel reports as unhealthy with a typed ``error_class``
         instead of hanging the health endpoint (the failure mode that
         motivated the probe helper)."""
-        snap = metrics.snapshot()
         degraded = sorted(
             mid for mid, ep in self._endpoints.items() if ep.degraded
         )
@@ -245,9 +244,9 @@ class ModelServer:
                 mid: ep.describe() for mid, ep in self._endpoints.items()
             },
             "program_cache": self._cache.stats(),
-            "metrics": {
-                k: v for k, v in snap.items() if k.startswith("serving.")
-            },
+            # one consistent point-in-time read (registry.snapshot with
+            # a prefix filter), not ad-hoc key picking
+            "metrics": metrics.snapshot(prefix="serving."),
         }
         if probe_device:
             from sparkdl_tpu.resilience.watchdog import check_device
@@ -255,6 +254,18 @@ class ModelServer:
             out["device"] = check_device(timeout_s=probe_timeout_s)
             out["healthy"] = out["healthy"] and out["device"]["ok"]
         return out
+
+    def metrics_text(self, serving_only: bool = False) -> str:
+        """The process metrics in the Prometheus text exposition format
+        — what an HTTP front-end returns from ``/metrics``.  By default
+        the FULL registry (a serving process wants its ``data.*`` /
+        ``resilience.*`` series scraped too); ``serving_only=True``
+        restricts to ``serving.*``."""
+        from sparkdl_tpu.obs.export import prometheus_text
+
+        return prometheus_text(
+            metrics, prefix="serving." if serving_only else None
+        )
 
     def close(self) -> None:
         self._closed = True

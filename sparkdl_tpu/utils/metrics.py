@@ -204,22 +204,43 @@ class MetricsRegistry:
                 self._histograms[name] = Histogram(name, window=window)
             return self._histograms[name]
 
-    def snapshot(self) -> Dict[str, float]:
-        """Flat dict of every counter value, timer total, gauge value, and
-        histogram count/mean/quantiles."""
+    def collect(self) -> Dict[str, Dict[str, object]]:
+        """A consistent point-in-time view of the registry, typed by
+        metric kind: ``{"counters": {name: Counter}, "timers": ...,
+        "gauges": ..., "histograms": ...}``.
+
+        The one sanctioned way for exporters/tests to enumerate metrics
+        (each metric object stays live and thread-safe to read) —
+        nothing outside this module should touch ``_counters`` & co.
+        """
         with self._lock:
-            counters = dict(self._counters)
-            timers = dict(self._timers)
-            gauges = dict(self._gauges)
-            histograms = dict(self._histograms)
+            return {
+                "counters": dict(self._counters),
+                "timers": dict(self._timers),
+                "gauges": dict(self._gauges),
+                "histograms": dict(self._histograms),
+            }
+
+    def snapshot(self, prefix: Optional[str] = None) -> Dict[str, float]:
+        """Flat dict of every counter value, timer total, gauge value, and
+        histogram count/mean/quantiles.  ``prefix`` keeps only metrics
+        whose dotted name starts with it (e.g. ``"serving."`` for the
+        ``ModelServer.status()`` health snapshot)."""
+        view = self.collect()
+
+        def kept(d):
+            if prefix is None:
+                return d.items()
+            return ((n, m) for n, m in d.items() if n.startswith(prefix))
+
         out: Dict[str, float] = {}
-        for name, c in counters.items():
+        for name, c in kept(view["counters"]):
             out[name] = c.value
-        for name, t in timers.items():
+        for name, t in kept(view["timers"]):
             out[name + ".seconds"] = t.seconds
-        for name, g in gauges.items():
+        for name, g in kept(view["gauges"]):
             out[name] = g.value
-        for name, h in histograms.items():
+        for name, h in kept(view["histograms"]):
             count = h.count
             if not count:
                 continue

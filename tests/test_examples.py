@@ -79,6 +79,14 @@ def test_online_serving_example():
 
 
 @pytest.mark.slow
+def test_tracing_example():
+    out = _run_example("tracing.py")
+    assert "tracing OK" in out
+    assert "captured" in out and "estimator.fit" in out
+    assert "request spans coalesced into" in out
+
+
+@pytest.mark.slow
 def test_sql_analytics_example():
     out = _run_example("sql_analytics.py")
     assert "sql analytics OK" in out

@@ -1,8 +1,8 @@
-"""The sleep-retry lint gate (``ci/lint_no_sleep_retry.py``): the repo
-itself stays clean, and the lint actually catches what it claims to.
-Running it here puts the gate in tier-1 — a hand-rolled retry loop
-anywhere outside ``sparkdl_tpu/resilience/`` fails the suite, not just
-the CI workflow step."""
+"""The CI lint gates (``ci/lint_no_sleep_retry.py``,
+``ci/lint_metric_names.py``): the repo itself stays clean, and each
+lint actually catches what it claims to.  Running them here puts the
+gates in tier-1 — a hand-rolled retry loop or an off-convention metric
+name fails the suite, not just the CI workflow step."""
 
 import os
 import subprocess
@@ -11,11 +11,12 @@ import textwrap
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LINT = os.path.join(_REPO, "ci", "lint_no_sleep_retry.py")
+_NAME_LINT = os.path.join(_REPO, "ci", "lint_metric_names.py")
 
 
-def run_lint(root):
+def run_lint(root, lint=_LINT):
     return subprocess.run(
-        [sys.executable, _LINT, str(root)],
+        [sys.executable, lint, str(root)],
         capture_output=True,
         text=True,
         timeout=120,
@@ -74,3 +75,46 @@ def test_lint_flags_planted_violation(tmp_path):
     assert "resilience/policy.py" not in proc.stdout
     assert "ok.py" not in proc.stdout
     assert "RetryPolicy" in proc.stdout  # the diagnostic names the fix
+
+
+def test_repo_metric_names_follow_convention():
+    proc = run_lint(_REPO, lint=_NAME_LINT)
+    assert proc.returncode == 0, (
+        f"metric-name lint failed:\n{proc.stdout}{proc.stderr}"
+    )
+
+
+def test_metric_name_lint_flags_planted_violations(tmp_path):
+    pkg = tmp_path / "sparkdl_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        textwrap.dedent(
+            """
+            from sparkdl_tpu.utils.metrics import metrics
+
+            metrics.counter("batches").add()          # no subsystem prefix
+            metrics.gauge("Serving.Depth").set(1)     # uppercase
+            metrics.timer("kernels.fuse")             # unknown subsystem
+            metrics.histogram(f"{kind}.latency_ms")   # fully dynamic
+            """
+        )
+    )
+    (pkg / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            from sparkdl_tpu.utils.metrics import metrics
+
+            metrics.counter("serving.requests").add()
+            metrics.gauge(f"resilience.breaker_state.{name}").set(0)
+            metrics.histogram("data.device_stall_ms", window=128)
+            other.counter("NotAMetric")  # different receiver: not checked
+            """
+        )
+    )
+
+    proc = run_lint(tmp_path, lint=_NAME_LINT)
+    assert proc.returncode == 1
+    out = proc.stdout
+    assert out.count("bad.py:") == 4
+    assert "ok.py" not in out
+    assert "subsystem prefix" in out  # the diagnostic names the fix

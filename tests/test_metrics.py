@@ -33,6 +33,47 @@ def test_counter_and_timer_accumulate():
     assert reg.snapshot() == {}
 
 
+def test_snapshot_prefix_filters_by_subsystem():
+    reg = MetricsRegistry()
+    reg.counter("serving.requests").add(4)
+    reg.gauge("serving.queue_depth.m").set(1)
+    reg.timer("data.producer_busy").add_seconds(0.5)
+    reg.histogram("data.device_stall_ms").observe(2.0)
+    serving = reg.snapshot(prefix="serving.")
+    assert serving == {
+        "serving.requests": 4.0,
+        "serving.queue_depth.m": 1.0,
+    }
+    data = reg.snapshot(prefix="data.")
+    assert data["data.producer_busy.seconds"] == 0.5
+    assert data["data.device_stall_ms.count"] == 1.0
+    assert "serving.requests" not in data
+    # no prefix -> everything, same keys
+    assert set(reg.snapshot()) == set(serving) | set(data)
+
+
+def test_collect_is_the_typed_registry_view():
+    """collect() is the sanctioned enumeration for exporters: live
+    metric objects keyed by kind, insulated from later registrations."""
+    reg = MetricsRegistry()
+    c = reg.counter("data.rows_out")
+    t = reg.timer("data.producer_busy")
+    g = reg.gauge("data.queue_depth")
+    h = reg.histogram("data.device_stall_ms")
+    view = reg.collect()
+    assert view["counters"]["data.rows_out"] is c
+    assert view["timers"]["data.producer_busy"] is t
+    assert view["gauges"]["data.queue_depth"] is g
+    assert view["histograms"]["data.device_stall_ms"] is h
+    # the view is a copy of the name->metric maps: registering after
+    # collect() must not mutate an exporter's in-flight iteration
+    reg.counter("data.decode_errors")
+    assert "data.decode_errors" not in view["counters"]
+    # but the objects stay live — updates through them are visible
+    c.add(7)
+    assert view["counters"]["data.rows_out"].value == 7
+
+
 def test_gauge_set_add_and_snapshot():
     reg = MetricsRegistry()
     g = reg.gauge("depth")

@@ -1,0 +1,495 @@
+"""Observability subsystem (``sparkdl_tpu/obs``): span nesting,
+explicit cross-thread propagation through the data pipeline, serving
+batch fan-in, resilience span events, and both exporters.
+
+Acceptance shape (ISSUE): nesting/ids/attributes; propagation through
+``prefetch`` survives the queue boundary; each coalesced serving batch
+records its member request span ids; every ``RetryPolicy`` attempt and
+``CircuitBreaker`` flip becomes a span event; Prometheus text renders
+p50/p95/p99 from the sliding-window histograms; the JSONL sink's buffer
+is bounded (drop-oldest + counted).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.data import Dataset
+from sparkdl_tpu.obs import (
+    FitProfiler,
+    JsonlTraceSink,
+    current_span,
+    fit_profiler,
+    prometheus_text,
+    record_event,
+    tracer,
+)
+from sparkdl_tpu.resilience import CircuitBreaker, RetryPolicy, TransientError
+from sparkdl_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def tracing_off_between_tests():
+    """Every test starts and ends at the pay-nothing default."""
+    tracer.disable()
+    metrics.reset()
+    yield
+    tracer.disable()
+    metrics.reset()
+
+
+def enabled_sink(capacity=4096):
+    sink = JsonlTraceSink(capacity=capacity)
+    tracer.enable(sink)
+    return sink
+
+
+# ----------------------------------------------------------------------
+# span model
+# ----------------------------------------------------------------------
+class TestSpanModel:
+    def test_nesting_ids_and_attributes(self):
+        sink = enabled_sink()
+        with tracer.span("root", job="fit") as root:
+            with tracer.span("child") as child:
+                child.event("tick", n=1)
+            assert current_span() is root
+        assert current_span() is None
+
+        r, = sink.find("root")
+        c, = sink.find("child")
+        assert r["parent_id"] is None
+        assert c["parent_id"] == r["span_id"]
+        assert c["trace_id"] == r["trace_id"]
+        assert r["attributes"] == {"job": "fit"}
+        assert c["events"][0]["name"] == "tick"
+        assert c["events"][0]["n"] == 1
+        assert 0.0 <= c["events"][0]["offset_ms"] <= c["duration_ms"]
+        # child finishes (and is delivered) before its parent
+        assert sink.spans()[0]["name"] == "child"
+        assert r["duration_ms"] >= c["duration_ms"] >= 0.0
+
+    def test_sibling_roots_get_distinct_traces(self):
+        sink = enabled_sink()
+        with tracer.span("a"):
+            pass
+        with tracer.span("b"):
+            pass
+        a, = sink.find("a")
+        b, = sink.find("b")
+        assert a["trace_id"] != b["trace_id"]
+        assert a["span_id"] != b["span_id"]
+
+    def test_manual_spans_and_double_end(self):
+        sink = enabled_sink()
+        sp = tracer.start_span("request", model_id="m")
+        sp.set_attribute("bucket", 4)
+        sp.end()
+        first = sink.find("request")[0]["duration_ms"]
+        sp.end()  # idempotent: no second delivery, same timestamp
+        assert len(sink.find("request")) == 1
+        # export rounds to 4 decimals; the live value must match it
+        assert sp.duration_ms == pytest.approx(first, abs=1e-4)
+        assert sink.find("request")[0]["attributes"] == {
+            "model_id": "m", "bucket": 4,
+        }
+
+    def test_disabled_is_a_no_op(self):
+        assert not tracer.enabled
+        with tracer.span("nope", k=1) as sp:
+            assert sp is None
+            assert current_span() is None
+        assert tracer.start_span("nope") is None
+        assert tracer.capture() is None
+        record_event("nothing")  # must not raise with no span either
+
+    def test_record_event_without_open_span_is_dropped(self):
+        sink = enabled_sink()
+        record_event("orphan")  # enabled, but no current span
+        with tracer.span("s"):
+            record_event("kept", x=2)
+        s, = sink.find("s")
+        assert [e["name"] for e in s["events"]] == ["kept"]
+
+    def test_sink_exceptions_do_not_break_traced_code(self):
+        def bad_sink(span_dict):
+            raise RuntimeError("sink died")
+
+        tracer.enable(bad_sink)
+        with tracer.span("still_fine"):
+            pass  # must not raise
+
+
+# ----------------------------------------------------------------------
+# explicit cross-thread propagation (data pipeline)
+# ----------------------------------------------------------------------
+class TestCrossThreadPropagation:
+    def test_contextvar_does_not_leak_into_new_threads(self):
+        enabled_sink()
+        seen = []
+        with tracer.span("outer"):
+            t = threading.Thread(target=lambda: seen.append(tracer.current()))
+            t.start()
+            t.join()
+        assert seen == [None]  # propagation is opt-in, never ambient
+
+    def test_capture_use_span_crosses_a_thread(self):
+        enabled_sink()
+        seen = []
+        with tracer.span("outer") as outer:
+            handle = tracer.capture()
+
+            def worker():
+                with tracer.use_span(handle):
+                    seen.append(tracer.current())
+                seen.append(tracer.current())
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen == [outer, None]
+        assert not outer.ended or outer.ended  # use_span never ends it
+
+    def test_prefetch_worker_sees_the_submitting_span(self):
+        """The prefetch producer thread re-attaches the span captured
+        when iteration began — events recorded inside map/decode land on
+        the consumer's span across the queue boundary."""
+        enabled_sink()
+
+        def decode(x):
+            record_event("decode", item=int(x))
+            return x * 2
+
+        with tracer.span("epoch") as epoch:
+            ds = Dataset.from_arrays(np.arange(6)).map(decode).prefetch(2)
+            assert sorted(int(v) for v in ds) == [0, 2, 4, 6, 8, 10]
+        assert len(epoch.events) == 6
+        assert {e["name"] for e in epoch.events} == {"decode"}
+
+    def test_threaded_map_workers_see_the_submitting_span(self):
+        enabled_sink()
+
+        def decode(x):
+            record_event("decode", item=int(x))
+            return x + 1
+
+        with tracer.span("epoch") as epoch:
+            ds = Dataset.from_arrays(np.arange(8)).map(decode, num_workers=3)
+            assert sorted(int(v) for v in ds) == list(range(1, 9))
+        assert len(epoch.events) == 8
+
+    def test_pipeline_untraced_when_disabled(self):
+        out = list(
+            Dataset.from_arrays(np.arange(4)).map(lambda x: x).prefetch(2)
+        )
+        assert len(out) == 4
+
+
+# ----------------------------------------------------------------------
+# resilience span events
+# ----------------------------------------------------------------------
+class TestResilienceEvents:
+    def test_retry_attempts_become_span_events(self):
+        enabled_sink()
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                             sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise TransientError("transient")
+            return "ok"
+
+        with tracer.span("step") as step:
+            assert policy.call(flaky) == "ok"
+        retries = [e for e in step.events if e["name"] == "retry"]
+        assert [e["attempt"] for e in retries] == [1, 2]
+        assert all(e["error"] == "TransientError" for e in retries)
+        assert all(e["delay_s"] >= 0.0 for e in retries)
+
+    def test_breaker_state_changes_become_span_events(self):
+        enabled_sink()
+        breaker = CircuitBreaker("dep", failure_threshold=2, recovery_s=60.0)
+
+        def boom():
+            raise TransientError("down")
+
+        with tracer.span("request") as req:
+            for _ in range(2):
+                with pytest.raises(TransientError):
+                    breaker.call(boom)
+        flips = [e for e in req.events if e["name"] == "breaker_state"]
+        assert len(flips) == 1
+        assert flips[0]["breaker"] == "dep"
+        assert flips[0]["state"] == "open"
+        assert flips[0]["from_state"] == "closed"
+
+    def test_resilience_works_untraced(self):
+        policy = RetryPolicy(max_attempts=2, sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def once():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise TransientError("x")
+            return 7
+
+        assert policy.call(once) == 7
+
+
+# ----------------------------------------------------------------------
+# serving fan-in
+# ----------------------------------------------------------------------
+class TestServingFanIn:
+    def make_server(self):
+        from sparkdl_tpu.serving import ModelServer, ServingConfig
+
+        server = ModelServer(
+            ServingConfig(max_batch=8, max_wait_ms=25.0, queue_capacity=64)
+        )
+        server.register(
+            "double", lambda x: x * 2.0, item_shape=(4,), compile=False
+        )
+        return server
+
+    def test_batch_span_records_member_request_spans(self):
+        sink = enabled_sink()
+        n = 6
+        with self.make_server() as server:
+            barrier = threading.Barrier(n)
+            results = [None] * n
+
+            def one(i):
+                barrier.wait()
+                results[i] = server.predict(
+                    np.full((4,), float(i), np.float32), timeout=30.0
+                )
+
+            threads = [
+                threading.Thread(target=one, args=(i,)) for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        for i in range(n):
+            np.testing.assert_allclose(results[i], 2.0 * i)
+
+        requests = sink.find("serving.request")
+        batches = sink.find("serving.batch")
+        assert len(requests) == n
+        assert batches, "no serving.batch span captured"
+        # fan-in bookkeeping is exact in both directions: every request
+        # span id appears in exactly one batch's member list, and every
+        # request carries a 'coalesced' event naming its batch span
+        member_ids = [
+            sid for b in batches for sid in b["attributes"]["member_span_ids"]
+        ]
+        assert sorted(member_ids) == sorted(r["span_id"] for r in requests)
+        assert sum(b["attributes"]["n_real"] for b in batches) == n
+        batch_ids = {b["span_id"] for b in batches}
+        for r in requests:
+            coalesced = [
+                e for e in r["events"] if e["name"] == "coalesced"
+            ]
+            assert len(coalesced) == 1
+            assert coalesced[0]["batch_span"] in batch_ids
+
+    def test_request_span_records_error(self):
+        sink = enabled_sink()
+        from sparkdl_tpu.serving import ModelServer, ServingConfig
+
+        def blow_up(x):
+            raise ValueError("bad model")
+
+        with ModelServer(ServingConfig(max_wait_ms=1.0)) as server:
+            server.register("bad", blow_up, item_shape=(4,), compile=False)
+            fut = server.submit(np.ones((4,), np.float32))
+            with pytest.raises(Exception):
+                fut.result(30.0)
+        r, = sink.find("serving.request")
+        assert r["duration_ms"] is not None
+        assert "error" in r["attributes"]
+
+    def test_serving_untraced_when_disabled(self):
+        with self.make_server() as server:
+            np.testing.assert_allclose(
+                server.predict(np.ones((4,), np.float32), timeout=30.0), 2.0
+            )
+
+    def test_server_metrics_text_endpoint(self):
+        with self.make_server() as server:
+            server.predict(np.ones((4,), np.float32), timeout=30.0)
+            text = server.metrics_text(serving_only=True)
+        assert "# TYPE serving_requests counter" in text
+        assert "serving_requests 1" in text
+        assert 'serving_latency_ms{quantile="0.5"}' in text
+        assert "sparkdl_" not in text  # serving_only filters other subsystems
+
+
+# ----------------------------------------------------------------------
+# exporters
+# ----------------------------------------------------------------------
+class TestJsonlSink:
+    def test_buffer_is_bounded_drop_oldest(self):
+        sink = enabled_sink(capacity=4)
+        for i in range(10):
+            with tracer.span(f"s{i}"):
+                pass
+        assert len(sink) == 4
+        assert sink.emitted == 10
+        assert sink.dropped == 6
+        assert [s["name"] for s in sink.spans()] == ["s6", "s7", "s8", "s9"]
+
+    def test_flush_appends_parseable_jsonl(self, tmp_path):
+        path = tmp_path / "trace.jsonl"
+        sink = JsonlTraceSink(path=str(path), capacity=16)
+        tracer.enable(sink)
+        with tracer.span("first", k=1):
+            pass
+        assert sink.flush() == 1
+        assert len(sink) == 0  # flush drains
+        with tracer.span("second"):
+            pass
+        assert sink.flush() == 1  # append mode: first survives
+        lines = path.read_text().splitlines()
+        assert [json.loads(ln)["name"] for ln in lines] == ["first", "second"]
+        parsed = json.loads(lines[0])
+        assert parsed["attributes"] == {"k": 1}
+        assert parsed["duration_ms"] >= 0.0
+        assert sink.flush() == 0  # empty buffer writes nothing
+
+    def test_flush_without_path_raises(self):
+        sink = JsonlTraceSink()
+        sink({"name": "x"})
+        with pytest.raises(ValueError):
+            sink.flush()
+
+    def test_invalid_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            JsonlTraceSink(capacity=0)
+
+
+class TestPrometheusText:
+    def test_all_metric_kinds_render(self):
+        metrics.counter("serving.requests").add(3)
+        metrics.gauge("data.queue_depth").set(2)
+        metrics.timer("estimator.step").add_seconds(0.25)
+        h = metrics.histogram("serving.latency_ms")
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.observe(v)
+        text = prometheus_text(metrics)
+        assert "# TYPE serving_requests counter\nserving_requests 3" in text
+        assert "# TYPE data_queue_depth gauge\ndata_queue_depth 2" in text
+        assert "estimator_step_seconds_total 0.25" in text
+        assert "estimator_step_entries_total 1" in text
+        assert "# TYPE serving_latency_ms summary" in text
+        assert 'serving_latency_ms{quantile="0.5"} 2.5' in text
+        assert 'serving_latency_ms{quantile="0.95"}' in text
+        assert 'serving_latency_ms{quantile="0.99"}' in text
+        assert "serving_latency_ms_sum 10" in text
+        assert "serving_latency_ms_count 4" in text
+        assert text.endswith("\n")
+
+    def test_prefix_filter_and_empty_registry(self):
+        metrics.counter("serving.requests").add()
+        metrics.counter("data.rows_out").add()
+        only = prometheus_text(metrics, prefix="serving.")
+        assert "serving_requests" in only and "data_rows_out" not in only
+        metrics.reset()
+        assert prometheus_text(metrics) == ""
+
+    def test_snapshot_prefix_filter(self):
+        metrics.counter("serving.requests").add(2)
+        metrics.counter("data.rows_out").add(5)
+        snap = metrics.snapshot(prefix="serving.")
+        assert snap == {"serving.requests": 2.0}
+
+
+# ----------------------------------------------------------------------
+# fit profiler
+# ----------------------------------------------------------------------
+class TestFitProfiler:
+    def test_steps_epochs_checkpoints_metered_and_spanned(self):
+        sink = enabled_sink()
+        with fit_profiler("TestEstimator", epochs=2,
+                          steps_per_epoch=3) as prof:
+            assert isinstance(prof, FitProfiler)
+            for epoch in range(1, 3):
+                for _ in range(3):
+                    with prof.step():
+                        pass
+                prof.epoch(epoch, loss=0.5)
+                with prof.checkpoint(epoch=epoch):
+                    pass
+
+        snap = metrics.snapshot(prefix="estimator.")
+        assert snap["estimator.step_ms.count"] == 6
+        assert snap["estimator.checkpoint_ms.count"] == 2
+        assert snap["estimator.host_stall_ms.count"] == 2
+        assert snap["estimator.step.seconds"] >= 0.0
+
+        fit, = sink.find("estimator.fit")
+        assert fit["attributes"]["estimator"] == "TestEstimator"
+        assert fit["attributes"]["epochs"] == 2
+        epochs = [e for e in fit["events"] if e["name"] == "epoch"]
+        assert [e["epoch"] for e in epochs] == [1, 2]
+        assert all(e["loss"] == 0.5 for e in epochs)
+        assert all("host_stall_ms" in e for e in epochs)
+        steps = sink.find("estimator.step")
+        assert len(steps) == 6
+        assert all(s["parent_id"] == fit["span_id"] for s in steps)
+        assert len(sink.find("estimator.checkpoint")) == 2
+
+    def test_epoch_stall_attribution_is_a_delta(self):
+        """Pre-fit pipeline stall must not be billed to the fit."""
+        enabled_sink()
+        metrics.histogram("data.device_stall_ms").observe(500.0)
+        with fit_profiler("E") as prof:
+            metrics.histogram("data.device_stall_ms").observe(40.0)
+            prof.epoch(1)
+            prof.epoch(2)  # nothing new since epoch 1
+        h = metrics.histogram("estimator.host_stall_ms")
+        assert h.count == 2
+        assert h.quantile(1.0) == pytest.approx(40.0)
+        assert h.quantile(0.0) == pytest.approx(0.0)
+
+    def test_profiler_works_untraced(self):
+        with fit_profiler("E") as prof:
+            with prof.step():
+                pass
+            prof.epoch(1)
+        assert metrics.histogram("estimator.step_ms").count == 1
+
+
+# ----------------------------------------------------------------------
+# env auto-enable
+# ----------------------------------------------------------------------
+def test_env_hook_captures_from_a_fresh_process(tmp_path):
+    """SPARKDL_TRACE_OUT=<path> wires the tracer with zero code changes
+    (what ci/fault-suite.sh and subprocess workers rely on)."""
+    import os
+    import subprocess
+    import sys
+
+    path = tmp_path / "env_trace.jsonl"
+    code = (
+        "import sparkdl_tpu\n"
+        "from sparkdl_tpu.obs import tracer\n"
+        "assert tracer.enabled\n"
+        "with tracer.span('env_root', pid=1):\n"
+        "    pass\n"
+    )
+    env = dict(os.environ, SPARKDL_TRACE_OUT=str(path),
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    spans = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [s["name"] for s in spans] == ["env_root"]
+    assert spans[0]["attributes"] == {"pid": 1}
