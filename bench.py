@@ -26,6 +26,15 @@ probe — ``sparkdl_tpu.resilience.watchdog`` — no hang), the same shape
 with ``value``/``vs_baseline``/``mfu`` null plus ``"ok": false``,
 ``"error_class"`` (the typed resilience classification) and ``"error"``
 fields, exit code 2.
+
+``--cold-start`` measures the execution engine's persistent compile
+cache instead of throughput: two fresh interpreter processes share one
+temporary ``SPARKDL_COMPILE_CACHE`` directory and each times its FIRST
+featurizer batch (InceptionV3, batch 1 — the latency-critical serving
+shape).  The first process compiles (cleared cache); the second loads
+the serialized executable (warmed cache).  One JSON line with
+``cold_s`` / ``warm_s`` / ``speedup`` plus the resolve-only split
+(``compile_s`` vs ``cache_load_s``).
 """
 
 import json
@@ -41,6 +50,111 @@ SCAN_LEN = 24  # deeper scan -> the ~40ms host-fetch round trip amortizes.
 # the device-traced pure-program rate (~6.9k); total run stays ~40s.
 REPEATS = 3
 
+#: the per-process probe --cold-start runs twice against one shared
+#: cache dir.  Batch 1 (not BATCH): cold start is a latency story —
+#: "first request after restart" — and the resolve cost is
+#: shape-independent anyway.  Weights are the deterministic "random"
+#: init, so the fingerprint is durable without an imagenet download.
+_COLD_START_CHILD = """
+import json, os, time, warnings
+
+warnings.filterwarnings("ignore")
+import numpy as np
+import jax.numpy as jnp
+
+from sparkdl_tpu.engine import ExecutionEngine
+from sparkdl_tpu.models import get_keras_application_model
+from sparkdl_tpu.transformers.named_image import _resolve_variables
+
+entry = get_keras_application_model("InceptionV3")
+module = entry.make_module(dtype=jnp.bfloat16)
+variables = _resolve_variables("InceptionV3", "random")
+preprocess = entry.preprocess
+
+
+def forward(x):
+    x = preprocess(x.astype(np.float32))
+    out = module.apply(variables, x.astype(jnp.bfloat16),
+                       features_only=True)
+    return out.reshape(out.shape[0], -1).astype(jnp.float32)
+
+
+h, w = entry.input_size
+x = np.random.RandomState(0).rand(1, h, w, 3).astype(np.float32)
+engine = ExecutionEngine()
+t0 = time.perf_counter()
+handle = engine.program(
+    forward, (x,),
+    fingerprint="bench:coldstart:InceptionV3:random:bf16:v1",
+    donate=True, name="bench_coldstart",
+)
+np.asarray(handle(x))
+print(json.dumps({
+    "source": handle.source,
+    "first_batch_s": round(time.perf_counter() - t0, 4),
+    "resolve_s": round(handle.seconds, 4),
+}))
+"""
+
+
+def _cold_start(trace_out=None) -> int:
+    import os
+    import shutil
+    import subprocess
+    import tempfile
+
+    from sparkdl_tpu.resilience.watchdog import check_device
+
+    metric = (
+        "DeepImageFeaturizer(InceptionV3) cold-start first-batch latency"
+    )
+    probe = check_device(timeout_s=300)
+    if not probe["ok"]:
+        print(json.dumps({
+            "metric": metric, "value": None, "unit": "seconds",
+            "ok": False, "error_class": probe["error_class"],
+            "error": f"device unreachable: {probe['detail']}",
+        }))
+        return 2
+
+    cache_dir = tempfile.mkdtemp(prefix="sparkdl-coldstart-")
+    try:
+        runs = []
+        for phase in ("cleared", "warmed"):
+            proc = subprocess.run(
+                [sys.executable, "-c", _COLD_START_CHILD],
+                capture_output=True, text=True, timeout=1800,
+                env={**os.environ, "SPARKDL_COMPILE_CACHE": cache_dir},
+            )
+            if proc.returncode != 0:
+                print(json.dumps({
+                    "metric": metric, "value": None, "unit": "seconds",
+                    "ok": False, "error_class": "ChildFailed",
+                    "error": proc.stderr.strip()[-500:],
+                }))
+                return 2
+            runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+        cold, warm = runs
+        result = {
+            "metric": metric,
+            "value": round(warm["first_batch_s"], 3),
+            "unit": "seconds",
+            "cold_s": round(cold["first_batch_s"], 3),
+            "warm_s": round(warm["first_batch_s"], 3),
+            "speedup": round(
+                cold["first_batch_s"] / max(warm["first_batch_s"], 1e-9), 2
+            ),
+            "compile_s": cold["resolve_s"],
+            "cache_load_s": warm["resolve_s"],
+            "cold_source": cold["source"],
+            "warm_source": warm["source"],
+            "ok": cold["source"] == "compile" and warm["source"] == "disk",
+        }
+        print(json.dumps(result))
+        return 0 if result["ok"] else 1
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
 
 def main():
     import argparse
@@ -51,7 +165,16 @@ def main():
         help="append a JSONL span trace of the run to PATH (obs "
         "subsystem) alongside the one-line JSON result",
     )
+    ap.add_argument(
+        "--cold-start", action="store_true",
+        help="measure first-batch latency with a cleared vs warmed "
+        "persistent compile cache (two fresh processes sharing one "
+        "temporary SPARKDL_COMPILE_CACHE) instead of throughput",
+    )
     args = ap.parse_args()
+
+    if args.cold_start:
+        return _cold_start(trace_out=args.trace_out)
 
     from sparkdl_tpu.obs import JsonlTraceSink, tracer
 

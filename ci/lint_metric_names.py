@@ -35,7 +35,9 @@ import sys
 
 #: one entry per subsystem that owns metrics; grow this list when a new
 #: subsystem earns a namespace, not to whitelist a one-off name.
-ALLOWED_PREFIXES = ("sparkdl", "data", "serving", "resilience", "estimator")
+ALLOWED_PREFIXES = (
+    "sparkdl", "data", "serving", "resilience", "estimator", "engine",
+)
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}
 

@@ -1,0 +1,154 @@
+"""Async dispatch window: keep N batches in flight, fetch without stalls.
+
+jax dispatch is asynchronous — ``fn(batch)`` returns a future-like
+device array immediately — but a naive loop squanders that by calling
+``jax.device_get`` right after dispatch, serializing host transfer
+behind device compute.  The repo grew two partial fixes (the one-deep
+``r_prev`` overlap in ``transformers/utils.py`` and nothing at all on
+the ``run_batched_multi`` / serving paths); this window replaces both
+with one engine-owned discipline:
+
+- ``submit(result, meta)`` enqueues a dispatched device result and
+  immediately starts its **device→host copy in the background**
+  (``copy_to_host_async`` on every array leaf), then pops-and-fetches
+  only what exceeds the window depth;
+- with depth N, batch i's host fetch happens while batches i+1..i+N are
+  still computing, so the transfer fully hides behind device compute;
+- depth 0 degrades to strict dispatch→fetch serialization (the
+  ``SPARKDL_SERIAL_INFERENCE=1`` kill switch).
+
+The window is deliberately not a thread: jax's own runtime provides the
+asynchrony; this class only decides *when* to synchronize.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Any, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+_DEPTH_ENV = "SPARKDL_DISPATCH_DEPTH"
+DEFAULT_DEPTH = 2
+
+
+def dispatch_depth() -> int:
+    """The configured in-flight window depth (``SPARKDL_DISPATCH_DEPTH``,
+    default 2 — one batch computing, one transferring)."""
+    spec = os.environ.get(_DEPTH_ENV, "").strip()
+    if not spec:
+        return DEFAULT_DEPTH
+    try:
+        return max(0, int(spec))
+    except ValueError:
+        raise ValueError(
+            f"{_DEPTH_ENV} must be a non-negative integer, got {spec!r}"
+        )
+
+
+def _start_host_copy(result: Any) -> None:
+    """Kick off the async device→host copy for every array leaf of a
+    dispatched result, so the later blocking fetch finds the bytes
+    already (or nearly) on host."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(result):
+        copy = getattr(leaf, "copy_to_host_async", None)
+        if copy is not None:
+            try:
+                copy()
+            except Exception:
+                # fetch will surface any real error; the async copy is
+                # purely an overlap optimization
+                pass
+
+
+def _fetch_host(result: Any) -> Any:
+    """Blocking device→host materialization of a dispatched result
+    (numpy leaves).  Single arrays come back as one ndarray; pytrees
+    keep their structure."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda leaf: np.asarray(jax.device_get(leaf)), result
+    )
+
+
+class FetchFailure:
+    """A fetch that raised, delivered in-order with its ``meta`` instead of
+    aborting the window (``capture_errors=True`` mode — serving needs the
+    meta back to fail the right requests' futures)."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+    def __repr__(self):
+        return f"FetchFailure({self.error!r})"
+
+
+class DispatchWindow:
+    """A depth-N in-flight executor for dispatched device results.
+
+    Usage::
+
+        window = DispatchWindow(depth=2)
+        for chunk in chunks:
+            for host, meta in window.submit(fn(chunk), meta=k):
+                consume(host, meta)          # arrives depth batches late
+        for host, meta in window.drain():
+            consume(host, meta)
+
+    Results come back strictly in submission order.  ``meta`` rides
+    through untouched (callers pass the unpadded row count).  With
+    ``capture_errors=True`` a failed fetch yields ``(FetchFailure(exc),
+    meta)`` instead of raising, so the caller never loses the meta of a
+    failed batch.  The ``engine.inflight`` gauge tracks the live window
+    depth.
+    """
+
+    def __init__(self, depth: Optional[int] = None,
+                 capture_errors: bool = False):
+        self.depth = dispatch_depth() if depth is None else max(0, int(depth))
+        self.capture_errors = bool(capture_errors)
+        self._inflight: "deque[Tuple[Any, Any]]" = deque()
+        from sparkdl_tpu.utils.metrics import metrics
+
+        self._gauge = metrics.gauge("engine.inflight")
+
+    def __len__(self) -> int:
+        return len(self._inflight)
+
+    def _pop(self) -> Tuple[Any, Any]:
+        result, meta = self._inflight.popleft()
+        self._gauge.set(len(self._inflight))
+        if self.capture_errors:
+            try:
+                return _fetch_host(result), meta
+            except Exception as exc:  # delivered, not raised
+                return FetchFailure(exc), meta
+        return _fetch_host(result), meta
+
+    def submit(self, result: Any, meta: Any = None) -> List[Tuple[Any, Any]]:
+        """Enqueue a dispatched result; returns the (host_result, meta)
+        pairs that just fell out of the window (possibly empty)."""
+        _start_host_copy(result)
+        self._inflight.append((result, meta))
+        self._gauge.set(len(self._inflight))
+        out = []
+        while len(self._inflight) > self.depth:
+            out.append(self._pop())
+        return out
+
+    def drain(self) -> Iterator[Tuple[Any, Any]]:
+        """Fetch everything still in flight, in order."""
+        while self._inflight:
+            yield self._pop()
+
+    def abandon(self) -> None:
+        """Drop in-flight results without fetching (error-path cleanup;
+        the device arrays are released to GC)."""
+        self._inflight.clear()
+        self._gauge.set(0)

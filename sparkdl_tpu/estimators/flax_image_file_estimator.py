@@ -139,7 +139,14 @@ class FlaxImageFileTransformer(
                     out = out[0]
                 return out
 
-            self._jitted = jax.jit(forward)
+            # AOT through the engine with input-batch donation; fine-tuned
+            # in-memory variables have no durable identity, so the program
+            # is LRU-cached in process but never persisted to disk.
+            from sparkdl_tpu.engine import engine as _engine
+
+            self._jitted = _engine.function(
+                forward, donate=True, name="flax_eval_forward"
+            )
         return self._jitted
 
     def _transform(self, dataset):

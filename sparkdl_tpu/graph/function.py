@@ -62,6 +62,11 @@ class XlaFunction:
         # per-input (shape, dtype) with shape[0]=batch, when known — lets
         # save()/persistence export without the caller re-supplying specs
         self.input_specs: Optional[List[Tuple[Tuple[int, ...], Any]]] = None
+        # durable identity of (function, params) when the constructor can
+        # establish one (saved-file path+mtime, StableHLO blob hash) — what
+        # makes programs built from this function eligible for the engine's
+        # persistent compile cache.  None for in-memory/anonymous params.
+        self.fingerprint: Optional[str] = None
 
     # ------------------------------------------------------------------
     # calling
@@ -209,7 +214,14 @@ class XlaFunction:
                 f"unsupported compute_dtype {compute_dtype!r}; expected "
                 "'float32', 'bfloat16', or 'float16'"
             )
+        fingerprint = None
         if isinstance(model_or_path, (str, os.PathLike)):
+            src = os.path.abspath(os.fspath(model_or_path))
+            st = os.stat(src)
+            fingerprint = (
+                f"keras:{src}:{st.st_mtime_ns}:{st.st_size}:"
+                f"{compute_dtype or 'float32'}"
+            )
             model = keras.saving.load_model(model_or_path, compile=False)
             if compute_dtype is not None:
                 # saved models serialize per-layer dtype policies, so the
@@ -248,6 +260,7 @@ class XlaFunction:
             ("output",),
             name or model.name,
         )
+        fn.fingerprint = fingerprint
         # static NHWC spatial input size, when the model declares one —
         # image-serving callers (udf.keras_image_model) use it to resize
         inputs = getattr(model, "inputs", None)
@@ -427,6 +440,8 @@ class XlaFunction:
         name: str = "stablehlo",
     ) -> "XlaFunction":
         """Rehydrate a frozen function from StableHLO bytes."""
+        import hashlib
+
         from jax import export as jax_export
 
         exported = jax_export.deserialize(serialized)
@@ -436,6 +451,11 @@ class XlaFunction:
 
         fn = cls(apply_fn, {}, input_names, output_names, name)
         fn._exported = exported
+        # the blob IS the function (params frozen in at export), so its
+        # hash is a durable identity
+        fn.fingerprint = (
+            f"stablehlo:{hashlib.sha256(serialized).hexdigest()}"
+        )
         return fn
 
     def __repr__(self):

@@ -26,8 +26,7 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
-import jax
-
+from sparkdl_tpu.engine import DispatchWindow, FetchFailure
 from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.resilience.errors import CircuitOpen
@@ -35,7 +34,11 @@ from sparkdl_tpu.resilience.policy import CircuitBreaker, Deadline, RetryPolicy
 from sparkdl_tpu.serving.admission import AdmissionQueue, Request
 from sparkdl_tpu.serving.cache import ProgramCache
 from sparkdl_tpu.serving.errors import DeadlineExceeded, ServerClosed
-from sparkdl_tpu.transformers.utils import pad_to_batch, shape_bucket
+from sparkdl_tpu.transformers.utils import (
+    _serial_inference,
+    pad_to_batch,
+    shape_bucket,
+)
 from sparkdl_tpu.utils.metrics import metrics
 
 logger = logging.getLogger(__name__)
@@ -117,11 +120,21 @@ class MicroBatcher:
         item_shape: Optional[Sequence[int]] = None,
         dtype: Any = np.float32,
         compile: bool = True,
+        fingerprint: Optional[str] = None,
     ):
         self.model_id = model_id
         self._forward = forward
         self._config = config
         self._cache = cache
+        # durable model identity (saved-file path+mtime, blob hash) —
+        # makes this endpoint's per-bucket executables persistable
+        self._fingerprint = fingerprint
+        # batch i's device->host fetch streams while batch i+1 computes;
+        # drained eagerly whenever the queue goes idle so a lone request
+        # never waits on the window
+        self._window = DispatchWindow(
+            depth=0 if _serial_inference() else None, capture_errors=True
+        )
         self._item_shape: Optional[Tuple[int, ...]] = (
             tuple(int(d) for d in item_shape) if item_shape is not None
             else None
@@ -217,6 +230,7 @@ class MicroBatcher:
             self._dtype,
             buckets=buckets,
             max_batch=self._config.max_batch,
+            fingerprint=self._fingerprint,
         )
 
     # ------------------------------------------------------------------
@@ -237,20 +251,39 @@ class MicroBatcher:
                 self._worker.start()
 
     def _worker_loop(self) -> None:
-        while not self._closed:
+        try:
+            while not self._closed:
+                try:
+                    batch = self._queue.take(
+                        self._config.max_batch,
+                        self._config.max_wait_ms / 1000.0,
+                    )
+                    if batch:
+                        self._run_batch(batch)
+                    if len(self._window) and not len(self._queue):
+                        # nothing left to overlap with — complete the
+                        # in-flight batches now rather than holding their
+                        # futures until the next poll
+                        for host, meta in self._window.drain():
+                            self._complete(host, meta)
+                except Exception:  # pragma: no cover - defensive
+                    # the per-batch path already routes model errors to the
+                    # batch's futures; anything landing here is a batcher
+                    # bug — log it and keep serving rather than silently
+                    # dying
+                    logger.exception(
+                        "serving worker for %r survived an internal error",
+                        self.model_id,
+                    )
+        finally:
+            # a closing worker must resolve every in-flight future
             try:
-                batch = self._queue.take(
-                    self._config.max_batch,
-                    self._config.max_wait_ms / 1000.0,
-                )
-                if batch:
-                    self._run_batch(batch)
+                for host, meta in self._window.drain():
+                    self._complete(host, meta)
             except Exception:  # pragma: no cover - defensive
-                # the per-batch path already routes model errors to the
-                # batch's futures; anything landing here is a batcher bug
-                # — log it and keep serving rather than silently dying
                 logger.exception(
-                    "serving worker for %r survived an internal error",
+                    "serving worker for %r failed draining in-flight "
+                    "batches at shutdown",
                     self.model_id,
                 )
 
@@ -273,24 +306,83 @@ class MicroBatcher:
         bucket = shape_bucket(len(live), self._config.max_batch)
         x = pad_to_batch(np.stack([r.value for r in live]), bucket)
 
-        def forward_once():
-            inject.fire("serving.forward")
-            if self._compile:
-                fn = self._cache.program(
-                    self.model_id, self._forward, bucket,
-                    self._item_shape, self._dtype,
-                )
-                return np.asarray(jax.device_get(fn(x)))
-            return np.asarray(self._forward(x))
+        if not self._compile:
+            # plain-Python endpoints stay fully synchronous — the fault-
+            # injection tests rely on deterministic attempt ordering, and
+            # there is no async dispatch to overlap anyway
+            def forward_once():
+                inject.fire("serving.forward")
+                return np.asarray(self._forward(x))
 
-        if not tracer.enabled:
-            self._forward_batch(live, bucket, forward_once)
+            if not tracer.enabled:
+                self._forward_batch(live, bucket, forward_once)
+                return
+            with self._batch_span(live, bucket) as bspan:  # noqa: F841
+                self._forward_batch(live, bucket, forward_once)
             return
-        # the span fan-in: one batch span per coalesced device call,
-        # carrying its member requests' span ids (and each member span
-        # gets a "coalesced" event pointing back) — so a trace can walk
-        # request -> batch -> retry events in either direction
-        with tracer.span(
+
+        # compiled path: dispatch through the engine program now; the
+        # blocking fetch happens when this batch falls out of the dispatch
+        # window (its device->host copy streams while later batches
+        # compute).  Retry wraps the dispatch: injected/trace-time faults
+        # raise here synchronously and re-attempt within the deadline;
+        # device-side async failures surface at fetch and fail the batch.
+        def dispatch_once():
+            inject.fire("serving.forward")
+            fn = self._cache.program(
+                self.model_id, self._forward, bucket,
+                self._item_shape, self._dtype,
+                fingerprint=self._fingerprint,
+            )
+            return fn(x)
+
+        bspan = None
+        if tracer.enabled:
+            bspan = tracer.start_span(
+                "serving.batch",
+                model_id=self.model_id,
+                bucket=bucket,
+                n_real=len(live),
+                member_span_ids=[
+                    r.span.span_id for r in live if r.span is not None
+                ],
+            )
+            for r in live:
+                if r.span is not None:
+                    r.span.event(
+                        "coalesced", batch_span=bspan.span_id, bucket=bucket
+                    )
+        try:
+            self._breaker.check()
+            retry = self._config.retry
+            if retry is not None:
+                dls = [r.deadline for r in live if r.deadline is not None]
+                deadline = (
+                    Deadline(min(dls), what=f"batch to {self.model_id!r}")
+                    if dls
+                    else None
+                )
+                out_dev = retry.call(dispatch_once, deadline=deadline)
+            else:
+                out_dev = dispatch_once()
+        except CircuitOpen as e:
+            self._fail_batch(live, bspan, e, record=False)
+            return
+        except Exception as e:
+            metrics.counter("serving.errors").add(1)
+            self._fail_batch(live, bspan, e, record=True)
+            return
+        for host, meta in self._window.submit(
+            out_dev, meta=(live, bucket, bspan)
+        ):
+            self._complete(host, meta)
+
+    def _batch_span(self, live, bucket):
+        """The span fan-in: one batch span per coalesced device call,
+        carrying its member requests' span ids (and each member span gets
+        a "coalesced" event pointing back) — so a trace can walk
+        request -> batch -> retry events in either direction."""
+        span_cm = tracer.span(
             "serving.batch",
             model_id=self.model_id,
             bucket=bucket,
@@ -298,13 +390,52 @@ class MicroBatcher:
             member_span_ids=[
                 r.span.span_id for r in live if r.span is not None
             ],
-        ) as bspan:
-            for r in live:
-                if r.span is not None:
-                    r.span.event(
-                        "coalesced", batch_span=bspan.span_id, bucket=bucket
-                    )
-            self._forward_batch(live, bucket, forward_once)
+        )
+
+        class _WithEvents:
+            def __enter__(self_inner):
+                bspan = span_cm.__enter__()
+                for r in live:
+                    if r.span is not None:
+                        r.span.event(
+                            "coalesced", batch_span=bspan.span_id,
+                            bucket=bucket,
+                        )
+                return bspan
+
+            def __exit__(self_inner, *exc):
+                return span_cm.__exit__(*exc)
+
+        return _WithEvents()
+
+    def _fail_batch(self, live, bspan, exc, record: bool) -> None:
+        if record:
+            self._breaker.record_failure()
+        if bspan is not None:
+            bspan.set_attribute("error", type(exc).__name__)
+            bspan.end()
+        for r in live:
+            r.future.set_exception(exc)
+
+    def _complete(self, host, meta) -> None:
+        """Resolve one batch that fell out of the dispatch window."""
+        live, bucket, bspan = meta
+        if isinstance(host, FetchFailure):
+            metrics.counter("serving.errors").add(1)
+            self._fail_batch(live, bspan, host.error, record=True)
+            return
+        self._breaker.record_success()
+        done = time.monotonic()
+        latency = metrics.histogram("serving.latency_ms")
+        for i, r in enumerate(live):
+            r.future.set_result(host[i])
+            latency.observe((done - r.enqueued_at) * 1000.0)
+        metrics.counter("serving.batches").add(1)
+        metrics.histogram("serving.batch_occupancy").observe(
+            len(live) / bucket
+        )
+        if bspan is not None:
+            bspan.end()
 
     def _forward_batch(self, live, bucket, forward_once) -> None:
         try:

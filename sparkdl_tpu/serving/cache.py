@@ -1,39 +1,52 @@
-"""Warm program cache: (model id, shape bucket) -> jitted executable.
+"""Warm program cache: (model id, shape bucket) -> engine-compiled executable.
 
 First-request latency on a cold endpoint is dominated by XLA compilation
-(seconds to tens of seconds on TPU — transformers/utils.py measured
-10-40s per program), so the serving layer keeps one ``jax.jit`` wrapper
-*per (model, bucket) key* in a bounded LRU and exposes an explicit
-:meth:`ProgramCache.warmup` that pre-traces the hot buckets before
-traffic arrives.
+(seconds to tens of seconds on TPU), so the serving layer keeps one
+AOT-compiled program *per (model, bucket) key* in a bounded LRU and
+exposes an explicit :meth:`ProgramCache.warmup` that pre-compiles the hot
+buckets before traffic arrives.
 
-One jit wrapper per key — rather than one shared wrapper whose internal
-cache holds every shape — is deliberate: it makes LRU eviction actually
-drop the compiled executable (hundreds of MB for big CNNs), and it makes
-compile activity observable (each wrapper traces exactly once, counted in
-``serving.compiles``).
+Programs resolve through a private
+:class:`~sparkdl_tpu.engine.ExecutionEngine` (private so this cache's
+``cache_size`` eviction contract is real: evicting a slot actually
+releases the executable, hundreds of MB for big CNNs).  Endpoints whose
+model carries a durable fingerprint (saved-file UDFs, StableHLO
+functions) get their per-bucket executables persisted to the engine's
+on-disk cache — a restarted server's ``warmup()`` *loads* instead of
+recompiling, counted in ``serving.cache_load`` (vs ``serving.compiles``)
+and reported per bucket in :meth:`stats` for ``ModelServer.status()``.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax
 
+from sparkdl_tpu.engine import ExecutionEngine
 from sparkdl_tpu.transformers.utils import LRUCache, bucket_ladder
+from sparkdl_tpu.utils.metrics import metrics
 
 
 class ProgramCache:
-    """Bounded LRU of jitted programs keyed by
+    """Bounded LRU of engine-compiled programs keyed by
     ``(model_id, bucket, item_shape, dtype)``."""
 
     def __init__(self, maxsize: int = 32, compile_counter=None):
         self._lock = threading.Lock()
+        # serving key -> {"callable", "engine_key", "source", "seconds"}
         self._programs = LRUCache(maxsize)
         self._compile_counter = compile_counter
+        # private engine: nobody else inserts, and eviction below keeps it
+        # in lockstep with the serving LRU
+        self._engine = ExecutionEngine(maxsize=maxsize)
+        # model_id -> {bucket: {"source": ..., "seconds": ...}} from the
+        # last warmup — the compile-vs-cache-load breakdown status() shows
+        self._warmup_report: Dict[str, Dict[int, Dict[str, Any]]] = {}
 
     @staticmethod
     def _key(model_id: str, bucket: int, item_shape, dtype) -> Tuple:
@@ -51,26 +64,53 @@ class ProgramCache:
         bucket: int,
         item_shape: Sequence[int],
         dtype: Any,
+        fingerprint: Optional[str] = None,
     ) -> Callable:
-        """The jitted program for one (model, bucket) slot, compiling (and
-        counting the compile) on first use.  ``forward`` must be the *raw*
-        python callable — this cache owns the jit."""
+        """The compiled program for one (model, bucket) slot, resolving
+        through the engine (memory → persistent cache → AOT compile) on
+        first use.  ``forward`` must be the *raw* python callable — this
+        cache owns compilation.  ``fingerprint`` (durable model identity)
+        makes the slot's executable eligible for the persistent cache.
+        """
         key = self._key(model_id, bucket, item_shape, dtype)
         with self._lock:
             hit = self._programs.get(key)
             if hit is not None:
-                return hit
-            counter = self._compile_counter
+                return hit["callable"]
+            # evict the LRU slot from BOTH maps before resolving a new
+            # program, so the engine cannot hold an executable the
+            # serving-level stats no longer admit to
+            while len(self._programs) >= self._programs.maxsize:
+                oldest = next(iter(self._programs))
+                self._engine.evict(self._programs[oldest]["engine_key"])
+                del self._programs[oldest]
 
-            def counted(x, _forward=forward, _counter=counter):
-                # body runs only while jax traces — i.e. once per compile
-                if _counter is not None:
-                    _counter.add(1)
-                return _forward(x)
-
-            jitted = jax.jit(counted)
-            self._programs[key] = jitted
-            return jitted
+            spec = jax.ShapeDtypeStruct(
+                (int(bucket), *(int(d) for d in item_shape)), np.dtype(dtype)
+            )
+            start = time.perf_counter()
+            handle = self._engine.program(
+                forward,
+                (spec,),
+                fingerprint=(
+                    f"serving:{fingerprint}" if fingerprint else None
+                ),
+                donate=True,
+                name=f"serving_{model_id}_b{bucket}",
+            )
+            seconds = time.perf_counter() - start
+            if handle.source == "compile":
+                if self._compile_counter is not None:
+                    self._compile_counter.add(1)
+            elif handle.source == "disk":
+                metrics.counter("serving.cache_load").add(1)
+            self._programs[key] = {
+                "callable": handle.callable,
+                "engine_key": handle.key,
+                "source": handle.source,
+                "seconds": seconds,
+            }
+            return handle.callable
 
     def warmup(
         self,
@@ -80,16 +120,34 @@ class ProgramCache:
         dtype: Any,
         buckets: Optional[Sequence[int]] = None,
         max_batch: int = 32,
+        fingerprint: Optional[str] = None,
     ) -> Tuple[int, ...]:
-        """Pre-trace ``buckets`` (default: the full :func:`bucket_ladder`
-        of ``max_batch``) by running zeros through each program, so no
+        """Pre-compile ``buckets`` (default: the full :func:`bucket_ladder`
+        of ``max_batch``) and run zeros through each program, so no
         steady-state request shape compiles at request time.  Returns the
-        buckets traced."""
+        buckets warmed; per-bucket source (compile vs persistent-cache
+        load) and wall time land in :meth:`stats`."""
         buckets = tuple(buckets) if buckets else bucket_ladder(max_batch)
+        report: Dict[int, Dict[str, Any]] = {}
         for b in buckets:
-            fn = self.program(model_id, forward, b, item_shape, dtype)
+            start = time.perf_counter()
+            fn = self.program(
+                model_id, forward, b, item_shape, dtype,
+                fingerprint=fingerprint,
+            )
+            with self._lock:
+                entry = self._programs.get(
+                    self._key(model_id, b, item_shape, dtype)
+                )
+                source = entry["source"] if entry else "memory"
             x = np.zeros((int(b), *item_shape), dtype=np.dtype(dtype))
             jax.block_until_ready(fn(x))
+            report[int(b)] = {
+                "source": source,
+                "seconds": round(time.perf_counter() - start, 4),
+            }
+        with self._lock:
+            self._warmup_report.setdefault(model_id, {}).update(report)
         return buckets
 
     def evict_model(self, model_id: str) -> int:
@@ -97,18 +155,30 @@ class ProgramCache:
         with self._lock:
             doomed = [k for k in self._programs if k[0] == model_id]
             for k in doomed:
+                self._engine.evict(self._programs[k]["engine_key"])
                 del self._programs[k]
+            self._warmup_report.pop(model_id, None)
             return len(doomed)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             keys = list(self._programs)
+            sources = {k: self._programs[k]["source"] for k in keys}
+            warmup = {
+                m: dict(report) for m, report in self._warmup_report.items()
+            }
         return {
             "programs": len(keys),
             "maxsize": self._programs.maxsize,
             "keys": [
                 {"model": k[0], "bucket": k[1], "item_shape": list(k[2]),
-                 "dtype": k[3]}
+                 "dtype": k[3], "source": sources[k]}
                 for k in keys
             ],
+            "warmup": warmup,
+            "persistent": (
+                self._engine.cache.stats()
+                if self._engine.cache is not None
+                else {"enabled": False}
+            ),
         }

@@ -55,11 +55,16 @@ class ModelServer:
         item_shape: Optional[Sequence[int]] = None,
         dtype: Any = np.float32,
         compile: bool = True,
+        fingerprint: Optional[str] = None,
     ) -> "ModelServer":
         """Register ``forward(batch) -> batch`` as endpoint ``model_id``.
 
         ``item_shape`` (one item, no leading batch dim) enables cold
         :meth:`warmup`; without it the first request binds the shape.
+        ``fingerprint`` — a durable identity of the model and its weights
+        (e.g. a saved-file path+mtime) — lets the program cache persist
+        this endpoint's compiled executables to disk, so a restarted
+        server's :meth:`warmup` loads instead of recompiling.
         Returns ``self`` for chaining."""
         if model_id in self._endpoints:
             raise ValueError(f"endpoint {model_id!r} already registered")
@@ -71,6 +76,7 @@ class ModelServer:
             item_shape=item_shape,
             dtype=dtype,
             compile=compile,
+            fingerprint=fingerprint,
         )
         if self._default is None:
             self._default = model_id
@@ -103,7 +109,10 @@ class ModelServer:
             item_shape = tuple(shape[1:])
         server = cls(config=config)
         server.register(
-            model_id or fn.name, forward, item_shape=item_shape
+            model_id or fn.name,
+            forward,
+            item_shape=item_shape,
+            fingerprint=getattr(fn, "fingerprint", None),
         )
         return server
 
@@ -152,6 +161,7 @@ class ModelServer:
             meta["forward"],
             item_shape=meta["item_shape"],
             dtype=meta["dtype"],
+            fingerprint=meta.get("fingerprint"),
         )
         return server
 

@@ -200,7 +200,8 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
         entry = get_keras_application_model(name)
         dtype_name = self.getOrDefault(self.computeDtype)
         dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
-        resolved = _resolve_variables(name, self.getOrDefault(self.modelWeights))
+        spec = self.getOrDefault(self.modelWeights)
+        resolved = _resolve_variables(name, spec)
         cache_key = (name, dtype_name, self._featurize, id(resolved))
         if cache_key in _FORWARD_CACHE:
             # value holds (jitted, resolved): the strong ref to ``resolved``
@@ -232,7 +233,31 @@ class _NamedImageTransformer(Transformer, HasInputCol, HasOutputCol):
             )
             return out.astype(jnp.float32)
 
-        jitted = jax.jit(forward)
+        # AOT-compile through the engine.  Named weight specs ("imagenet",
+        # "random" — deterministic by construction) identify the closed-over
+        # variables durably, so those programs persist to the on-disk
+        # executable cache; caller-supplied pytrees/models get no
+        # fingerprint and stay memory-only.  The input batch buffer is
+        # donated: each padded chunk is built fresh per dispatch and never
+        # read again, so XLA may alias it with the activations.
+        named_spec = (
+            "imagenet" if spec is None or spec == "imagenet"
+            else ("random" if spec == "random" else None)
+        )
+        fingerprint = (
+            f"named_image:{name}:{named_spec}:{dtype_name}:"
+            f"featurize={featurize}"
+            if named_spec is not None
+            else None
+        )
+        from sparkdl_tpu.engine import engine as _engine
+
+        jitted = _engine.function(
+            forward,
+            fingerprint=fingerprint,
+            donate=True,
+            name=f"{name}_{'featurize' if featurize else 'predict'}",
+        )
         _FORWARD_CACHE[cache_key] = (jitted, resolved)
         return jitted, entry
 

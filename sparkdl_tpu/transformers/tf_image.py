@@ -142,7 +142,20 @@ class TFImageTransformer(Transformer, HasInputCol, HasOutputCol, HasOutputMode):
                 x = x[..., ::-1]
             return fn.apply(params, x)[0]
 
-        jitted = jax.jit(model_fn)
+        # AOT through the engine: persistable when the XlaFunction carries a
+        # durable fingerprint (saved file / StableHLO blob).  No donation —
+        # outputMode="image" hands the output back row-by-row and the fn is
+        # caller-supplied, so aliasing input with output is not provably safe.
+        from sparkdl_tpu.engine import engine as _engine
+
+        base_fp = getattr(fn, "fingerprint", None)
+        jitted = _engine.function(
+            model_fn,
+            fingerprint=(
+                f"tf_image:{base_fp}:{size}:{order}" if base_fp else None
+            ),
+            name=f"tf_image_{fn.name}",
+        )
 
         def process_partition(part):
             rows = part[input_col]

@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import logging
 import os
-from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -48,41 +47,10 @@ class MixedImageSizesError(ValueError):
     reword the guidance, without string-matching the message."""
 
 
-class LRUCache:
-    """Tiny bounded mapping: process-lifetime model/program caches hold
-    compiled XLA executables and full variable pytrees (potentially hundreds
-    of MB each), so they must evict rather than grow without bound."""
-
-    def __init__(self, maxsize: int):
-        self.maxsize = int(maxsize)
-        self._data: "OrderedDict[Any, Any]" = OrderedDict()
-
-    def __contains__(self, key) -> bool:
-        return key in self._data
-
-    def __getitem__(self, key):
-        value = self._data[key]
-        self._data.move_to_end(key)
-        return value
-
-    def __setitem__(self, key, value):
-        self._data[key] = value
-        self._data.move_to_end(key)
-        while len(self._data) > self.maxsize:
-            self._data.popitem(last=False)
-
-    def get(self, key, default=None):
-        return self[key] if key in self._data else default
-
-    def __delitem__(self, key):
-        del self._data[key]
-
-    def __iter__(self):
-        return iter(list(self._data))
-
-    def __len__(self):
-        return len(self._data)
-
+# Moved to utils.lru so the execution engine can share it without a
+# layering cycle; re-exported because serving and the transformers import
+# it from here.
+from sparkdl_tpu.utils.lru import LRUCache  # noqa: E402
 
 _resize_cache = LRUCache(16)
 
@@ -257,8 +225,12 @@ def _device_resize_timed(
             for j, i in enumerate(idxs):
                 out[i] = resized[j]
             continue
-        key = (shape, height, width)
+        # the resize program closes over no weights, so its target size IS
+        # its fingerprint — every process shares one persistent entry per
+        # (source shape, target size)
+        key = (height, width)
         if key not in _resize_cache:
+            from sparkdl_tpu.engine import engine as _engine
 
             def _resize(batch, _h=height, _w=width):
                 n, _, _, c = batch.shape
@@ -266,7 +238,11 @@ def _device_resize_timed(
                     batch, (n, _h, _w, c), method="bilinear"
                 )
 
-            _resize_cache[key] = jax.jit(_resize)
+            _resize_cache[key] = _engine.function(
+                _resize,
+                fingerprint=f"builtin.resize:{height}x{width}:bilinear",
+                name=f"device_resize_{height}x{width}",
+            )
         batch = np.stack([np.asarray(images[i], dtype=np.float32) for i in idxs])
         resized = np.asarray(_resize_cache[key](batch))
         for j, i in enumerate(idxs):
@@ -493,6 +469,12 @@ def run_batched_multi(
     chip), so e.g. ``batchSize=10`` runs as 16-row chunks on 8 chips; row
     count and output order are unaffected.
 
+    Fetches go through the engine's :class:`DispatchWindow`: chunk i's
+    device→host copy streams in the background while chunks i+1..i+N are
+    dispatched, so host transfer hides behind device compute (the same
+    discipline as :func:`run_batched_rows`; ``SPARKDL_SERIAL_INFERENCE=1``
+    collapses the window to strict dispatch→fetch).
+
     Returns one concatenated array per function output.
     """
     from sparkdl_tpu.utils.metrics import metrics
@@ -525,26 +507,41 @@ def run_batched_multi(
 
     else:
         _place = jnp.asarray
+
+    from sparkdl_tpu.engine import DispatchWindow
+
     collected: Optional[List[List[np.ndarray]]] = None
+
+    def _collect(host: Tuple[np.ndarray, ...], k: int) -> None:
+        nonlocal collected
+        if collected is None:
+            collected = [[] for _ in host]
+        for acc, r in zip(collected, host):
+            acc.append(r[:k])
+
+    window = DispatchWindow(depth=0 if _serial_inference() else None)
     # 'sparkdl.serve' is end-to-end loop wall time (the sustained-rate
     # denominator); 'sparkdl.forward' is the dispatch+fetch subset.  Here
     # inputs are pre-decoded so the two coincide; run_batched_rows (lazy
     # decode in the loop) is where they diverge.
     serve_timer = metrics.timer("sparkdl.serve")
     forward_timer = metrics.timer("sparkdl.forward")
-    with maybe_trace(), serve_timer.time(), forward_timer.time():
-        for lo in range(0, n, batch_size):
-            chunks = [a[lo : lo + batch_size] for a in arrays]
-            k = chunks[0].shape[0]
-            if k < batch_size:
-                chunks = [pad_to_batch(c, batch_size) for c in chunks]
-            results = fn(*[_place(c) for c in chunks])
-            if not isinstance(results, (tuple, list)):
-                results = (results,)
-            if collected is None:
-                collected = [[] for _ in results]
-            for acc, r in zip(collected, results):
-                acc.append(np.asarray(jax.device_get(r))[:k])
+    try:
+        with maybe_trace(), serve_timer.time(), forward_timer.time():
+            for lo in range(0, n, batch_size):
+                chunks = [a[lo : lo + batch_size] for a in arrays]
+                k = chunks[0].shape[0]
+                if k < batch_size:
+                    chunks = [pad_to_batch(c, batch_size) for c in chunks]
+                results = fn(*[_place(c) for c in chunks])
+                if not isinstance(results, (tuple, list)):
+                    results = (results,)
+                for host, k_done in window.submit(tuple(results), meta=k):
+                    _collect(host, k_done)
+            for host, k_done in window.drain():
+                _collect(host, k_done)
+    finally:
+        window.abandon()
     metrics.counter("sparkdl.rows_processed").add(n)
     metrics.counter("sparkdl.batches_run").add(-(-n // batch_size))
     rate = metrics.images_per_sec()
@@ -585,9 +582,11 @@ def run_batched_rows(
     - host decode of chunk i+1 runs on a prefetch thread while chunk i is
       on device (the inference analog of the estimator's
       ``StreamingShardLoader``);
-    - chunk i+1 is *dispatched* before chunk i's output is fetched (one
-      in flight — jax dispatch is async, so i+1's host->device transfer
-      and compute ride under i's device->host fetch).
+    - dispatched results ride the engine's depth-N
+      :class:`~sparkdl_tpu.engine.DispatchWindow`
+      (``SPARKDL_DISPATCH_DEPTH``, default 2): chunk i's device→host copy
+      streams asynchronously while chunks i+1..i+N compute, so the fetch
+      finds the bytes already on host.
 
     ``decode(chunk_rows) -> np.ndarray`` must be row-aligned with
     ``rows``.  Chunks are ``batch_size`` rows (mesh-rounded, as in
@@ -640,10 +639,12 @@ def run_batched_rows(
             .prefetch(2)
         )
 
+    from sparkdl_tpu.engine import DispatchWindow
+
     # (images_processed is advanced by the decode layer — e.g.
     # decode_image_batch — not here, to avoid double counting)
     collected: List[np.ndarray] = []
-    pending: Optional[Tuple[Any, int]] = None
+    window = DispatchWindow(depth=0 if serial else None)
     # 'sparkdl.forward' times only dispatch + device fetch: pulling the
     # next chunk (lazy decode in serial mode, queue wait in pipelined
     # mode) advances 'sparkdl.load' inside the decode closure, so timing
@@ -664,25 +665,13 @@ def run_batched_rows(
                             "output in the forward, or use "
                             "run_batched_multi"
                         )
-                    if pending is not None:
-                        r_prev, k_prev = pending
-                        collected.append(
-                            np.asarray(jax.device_get(r_prev))[:k_prev]
-                        )
-                        pending = None
-                    if serial:
-                        collected.append(
-                            np.asarray(jax.device_get(result))[:k]
-                        )
-                    else:
-                        pending = (result, k)
-            if pending is not None:
-                r_prev, k_prev = pending
-                with forward_timer.time():
-                    collected.append(
-                        np.asarray(jax.device_get(r_prev))[:k_prev]
-                    )
+                    for host, k_done in window.submit(result, meta=k):
+                        collected.append(host[:k_done])
+            with forward_timer.time():
+                for host, k_done in window.drain():
+                    collected.append(host[:k_done])
     finally:
+        window.abandon()
         close = getattr(chunk_iter, "close", None)
         if close is not None:
             close()

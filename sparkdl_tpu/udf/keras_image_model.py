@@ -93,7 +93,18 @@ def registerKerasImageUDF(
         x = cast_and_resize_on_device(x, size)
         return fn.apply(params, x)[0]
 
-    forward = jax.jit(forward_core)
+    # AOT through the engine, donating the per-chunk input batch.  Saved
+    # model files carry a (path, mtime, size, dtype) fingerprint, so a
+    # process restart — or a second executor — loads the compiled program
+    # from the persistent cache instead of recompiling.
+    from sparkdl_tpu.engine import engine as _engine
+
+    base_fp = getattr(fn, "fingerprint", None)
+    fingerprint = f"keras_udf:{base_fp}:{size}" if base_fp else None
+    forward = _engine.function(
+        forward_core, fingerprint=fingerprint, donate=True,
+        name=f"keras_udf_{udfName}",
+    )
 
     def evaluate(values):
         # decode and forward run as a pipeline (run_batched_rows): host
@@ -138,6 +149,9 @@ def registerKerasImageUDF(
         "forward": forward_core,
         "item_shape": (size[0], size[1], 3) if size is not None else None,
         "dtype": np.float32,
+        # lets the serving ProgramCache persist/load this model's per-bucket
+        # executables across process restarts
+        "fingerprint": fingerprint,
     }
     from sparkdl_tpu.sql.session import TPUSession
 

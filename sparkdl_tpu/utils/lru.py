@@ -1,0 +1,48 @@
+"""Bounded LRU mapping shared by the program/model caches.
+
+Process-lifetime caches here hold compiled XLA executables and full
+variable pytrees (potentially hundreds of MB each), so they must evict
+rather than grow without bound.  Lives in ``utils`` so the execution
+engine, the transformers, and the serving layer can all share one
+implementation without layering cycles (engine must not import
+transformers).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any
+
+
+class LRUCache:
+    """Tiny bounded mapping with least-recently-used eviction."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = int(maxsize)
+        self._data: "OrderedDict[Any, Any]" = OrderedDict()
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def __getitem__(self, key):
+        value = self._data[key]
+        self._data.move_to_end(key)
+        return value
+
+    def __setitem__(self, key, value):
+        self._data[key] = value
+        self._data.move_to_end(key)
+        while len(self._data) > self.maxsize:
+            self._data.popitem(last=False)
+
+    def get(self, key, default=None):
+        return self[key] if key in self._data else default
+
+    def __delitem__(self, key):
+        del self._data[key]
+
+    def __iter__(self):
+        return iter(list(self._data))
+
+    def __len__(self):
+        return len(self._data)

@@ -1,8 +1,9 @@
 """The CI lint gates (``ci/lint_no_sleep_retry.py``,
-``ci/lint_metric_names.py``): the repo itself stays clean, and each
-lint actually catches what it claims to.  Running them here puts the
-gates in tier-1 — a hand-rolled retry loop or an off-convention metric
-name fails the suite, not just the CI workflow step."""
+``ci/lint_metric_names.py``, ``ci/lint_no_raw_jit.py``): the repo
+itself stays clean, and each lint actually catches what it claims to.
+Running them here puts the gates in tier-1 — a hand-rolled retry loop,
+an off-convention metric name, or a bare ``jax.jit`` on a hot path
+fails the suite, not just the CI workflow step."""
 
 import os
 import subprocess
@@ -12,6 +13,7 @@ import textwrap
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LINT = os.path.join(_REPO, "ci", "lint_no_sleep_retry.py")
 _NAME_LINT = os.path.join(_REPO, "ci", "lint_metric_names.py")
+_JIT_LINT = os.path.join(_REPO, "ci", "lint_no_raw_jit.py")
 
 
 def run_lint(root, lint=_LINT):
@@ -118,3 +120,79 @@ def test_metric_name_lint_flags_planted_violations(tmp_path):
     assert out.count("bad.py:") == 4
     assert "ok.py" not in out
     assert "subsystem prefix" in out  # the diagnostic names the fix
+
+
+def test_repo_hot_paths_have_no_raw_jit():
+    proc = run_lint(_REPO, lint=_JIT_LINT)
+    assert proc.returncode == 0, (
+        f"raw-jit lint failed:\n{proc.stdout}{proc.stderr}"
+    )
+
+
+def test_raw_jit_lint_flags_planted_violations(tmp_path):
+    pkg = tmp_path / "sparkdl_tpu"
+    checked = pkg / "transformers"
+    checked.mkdir(parents=True)
+    (checked / "bad.py").write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            def build(forward):
+                fitted = jax.jit(forward, donate_argnums=(0,))  # call
+                alias = jax.jit                                 # aliasing
+                return fitted, alias
+
+            @jax.jit
+            def decorated(x):
+                return x
+            """
+        )
+    )
+    # 'from jax import jit' is the same bare jit in disguise
+    (checked / "sneaky.py").write_text(
+        textwrap.dedent(
+            """
+            from jax import jit as _j
+
+            def build(forward):
+                return _j(forward)
+            """
+        )
+    )
+    # the engine itself is the sanctioned caller — not scanned
+    home = pkg / "engine"
+    home.mkdir()
+    (home / "core.py").write_text(
+        "import jax\njitted = jax.jit(lambda x: x)\n"
+    )
+    # unchecked packages (estimators/) are out of scope for now
+    other = pkg / "estimators"
+    other.mkdir()
+    (other / "est.py").write_text(
+        "import jax\njitted = jax.jit(lambda x: x)\n"
+    )
+    # strings/comments and engine-routed code in a checked package: clean
+    (checked / "ok.py").write_text(
+        textwrap.dedent(
+            """
+            from sparkdl_tpu.engine import engine
+
+            # jax.jit is forbidden here; see ci/lint_no_raw_jit.py
+            DOC = "replaces jax.jit with engine.function"
+
+            def build(forward):
+                return engine.function(forward, name="ok")
+            """
+        )
+    )
+
+    proc = run_lint(tmp_path, lint=_JIT_LINT)
+    assert proc.returncode == 1
+    out = proc.stdout
+    assert out.count("bad.py:") == 3
+    assert "sneaky.py:" in out
+    assert "engine/core.py" not in out
+    assert "estimators/est.py" not in out
+    assert "ok.py" not in out
+    assert "engine.function" in out  # the diagnostic names the fix
