@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Single static-analysis entrypoint: run every sparkdl_check rule over
+# sparkdl_tpu/ in one pass (one AST parse per file) and leave a JSON
+# report artifact for CI.  Exits non-zero on any finding that is neither
+# suppressed inline (# sparkdl: disable=<rule-id>) nor grandfathered in
+# ci/sparkdl_check/baseline.json, and on stale baseline entries.
+#
+# Usage: ci/check.sh [report-path]
+#   report-path  where to write the JSON report
+#                (default: ci/sparkdl_check/report.json, git-ignored)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+REPORT="${1:-ci/sparkdl_check/report.json}"
+
+python -m ci.sparkdl_check sparkdl_tpu/ --format json > "$REPORT"
+rc=$?
+
+python - "$REPORT" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+for f in doc["findings"]:
+    print(f"{f['path']}:{f['line']}:{f['col']}: {f['rule']} "
+          f"[{f['severity']}] {f['message']}")
+for entry in doc["stale_baseline"]:
+    print(f"stale baseline entry: {entry['rule']} @ {entry['path']}")
+print(f"sparkdl_check: {doc['files_scanned']} file(s), "
+      f"{len(doc['rules'])} rule(s), {doc['elapsed_s']}s — "
+      f"{len(doc['findings'])} finding(s), "
+      f"{len(doc['suppressed'])} suppressed, "
+      f"{len(doc['baselined'])} baselined "
+      f"(report: {sys.argv[1]})")
+EOF
+
+exit "$rc"

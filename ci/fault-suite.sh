@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Fault-injection suite: run the resilience + fault-injection tests on
 # the CPU backend (JAX_PLATFORMS=cpu — deterministic, no TPU needed),
-# then the no-ad-hoc-sleep-retry and metric-name lints.  Tier-1: wired
+# then the full sparkdl_check static-analysis pass.  Tier-1: wired
 # into the `tests` job of .github/workflows/ci.yml.
 #
 # The test run captures a span trace (SPARKDL_TRACE_OUT — retry
@@ -24,5 +24,13 @@ if ! python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
   exit 1
 fi
 
-python ci/lint_no_sleep_retry.py .
-python ci/lint_metric_names.py .
+# full static-analysis pass (replaces the per-script lints: one AST
+# parse per file, all nine rules); on failure print the JSON report so
+# CI logs carry the machine-readable findings, not just the exit code
+CHECK_REPORT="$(mktemp -t fault-suite-check.XXXXXX.json)"
+trap 'rm -f "$TRACE_OUT" "$CHECK_REPORT"' EXIT
+if ! ci/check.sh "$CHECK_REPORT"; then
+  echo "--- sparkdl_check JSON report ---" >&2
+  cat "$CHECK_REPORT" >&2 || true
+  exit 1
+fi

@@ -1,132 +1,33 @@
 #!/usr/bin/env python3
-"""Lint: metric names must follow the ``subsystem.metric_name`` convention.
+"""Back-compat shim: the ``metric-name`` rule now lives in the unified
+``ci/sparkdl_check`` framework (one AST parse per file, every rule).
 
-Every metric registered through the process-wide registry
-(``metrics.counter/timer/gauge/histogram("...")``) is a public,
-greppable contract: dashboards key on it, ``snapshot(prefix=...)``
-filters on the dotted prefix, and the Prometheus exporter derives the
-exposition name from it.  A metric named ``"batches"`` or
-``"Serving.Batches"`` silently escapes every prefix filter, so this
-gate fails CI when one grows in.
-
-Rules (checked over ``sparkdl_tpu/**/*.py``):
-
-- the name is a string literal or an f-string whose *leading* part is a
-  literal (dynamic suffixes like ``f"serving.queue_depth.{model_id}"``
-  are fine — only the prefix is checked);
-- it starts with a sanctioned subsystem prefix (``ALLOWED_PREFIXES``)
-  followed by a dot;
-- the literal part is lowercase ``[a-z0-9_.]`` with no empty dotted
-  segments.
-
-A fully-dynamic name (no leading literal) is flagged too: the registry
-key would be unauditable.
-
-Usage: ``python ci/lint_metric_names.py [root]`` — exits 1 with one
-``path:line`` diagnostic per violation.
+Same CLI contract as the original single-rule script — ``path:line:
+message`` on stdout, ``N violation(s)`` on stderr, exit 1 on findings.
+Prefer ``python -m ci.sparkdl_check`` (runs all rules in one pass).
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 
-#: one entry per subsystem that owns metrics; grow this list when a new
-#: subsystem earns a namespace, not to whitelist a one-off name.
-ALLOWED_PREFIXES = (
-    "sparkdl", "data", "serving", "resilience", "estimator", "engine",
-)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}
+from ci.sparkdl_check.core import run_check  # noqa: E402
 
-_LITERAL_RE = re.compile(r"[a-z0-9_.]*")
-
-
-def _metric_call_name(call: ast.Call):
-    """The metric name argument if ``call`` is ``metrics.<factory>(...)``,
-    else None.  Matches any receiver named ``metrics`` (the module-level
-    singleton is always imported under that name)."""
-    fn = call.func
-    if not (isinstance(fn, ast.Attribute) and fn.attr in METRIC_FACTORIES):
-        return None
-    if not (isinstance(fn.value, ast.Name) and fn.value.id == "metrics"):
-        return None
-    if not call.args:
-        return None
-    return call.args[0]
-
-
-def _leading_literal(node: ast.AST):
-    """The constant prefix of the name expression: the whole string for a
-    literal, the first chunk for an f-string, None when fully dynamic."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value, True
-    if isinstance(node, ast.JoinedStr) and node.values:
-        head = node.values[0]
-        if isinstance(head, ast.Constant) and isinstance(head.value, str):
-            return head.value, False
-    return None, False
-
-
-def _check_name(literal: str, complete: bool):
-    """Diagnostic string for a bad name, or None when it passes."""
-    if _LITERAL_RE.fullmatch(literal) is None:
-        return (
-            f"metric name {literal!r} has characters outside [a-z0-9_.] — "
-            "use lowercase dotted names"
-        )
-    prefix = literal.split(".", 1)[0]
-    if "." not in literal or prefix not in ALLOWED_PREFIXES:
-        return (
-            f"metric name {literal!r} must start with a subsystem prefix "
-            f"({', '.join(p + '.' for p in ALLOWED_PREFIXES)})"
-        )
-    # empty segments ("serving..x", trailing dot on a complete literal)
-    segments = literal.split(".")
-    body = segments if complete else segments[:-1]
-    if any(not s for s in body):
-        return f"metric name {literal!r} has an empty dotted segment"
-    return None
-
-
-def check_file(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    violations = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name_arg = _metric_call_name(node)
-        if name_arg is None:
-            continue
-        literal, complete = _leading_literal(name_arg)
-        if literal is None:
-            violations.append(
-                (
-                    node.lineno,
-                    "metric name is fully dynamic — start it with a "
-                    "literal 'subsystem.' prefix so the registry key is "
-                    "auditable",
-                )
-            )
-            continue
-        msg = _check_name(literal, complete)
-        if msg is not None:
-            violations.append((node.lineno, msg))
-    return violations
+RULE = "metric-name"
 
 
 def main() -> int:
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else ".")
     pkg = root / "sparkdl_tpu"
-    bad = 0
-    for path in sorted(pkg.rglob("*.py")):
-        for line, msg in check_file(path):
-            print(f"{path}:{line}: {msg}")
-            bad += 1
-    if bad:
-        print(f"{bad} violation(s)", file=sys.stderr)
+    scan_root = pkg if pkg.is_dir() else root
+    report = run_check(scan_root, rule_ids=[RULE], baseline=None)
+    for f in report.findings:
+        print(f"{scan_root / f.path}:{f.line}: {f.message}")
+    if report.findings:
+        print(f"{len(report.findings)} violation(s)", file=sys.stderr)
         return 1
     return 0
 

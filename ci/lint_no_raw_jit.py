@@ -1,97 +1,33 @@
 #!/usr/bin/env python3
-"""Lint: no bare ``jax.jit`` on the inference hot paths.
+"""Back-compat shim: the ``raw-jit`` rule now lives in the unified
+``ci/sparkdl_check`` framework (one AST parse per file, every rule).
 
-The execution engine (``sparkdl_tpu/engine/``) owns compilation for the
-inference-serving layers: ``engine.function(...)`` routes every program
-through the in-memory LRU and the persistent on-disk executable cache,
-records ``engine.compile`` / ``engine.cache_hit`` / ``engine.cache_miss``,
-and applies donation uniformly.  A bare ``jax.jit`` in those layers
-silently opts out of all of that — the program recompiles in every
-process, never lands in the disk cache, and its compile time is
-invisible to the metrics.  This gate fails CI when one grows back in.
-
-Checked packages (relative to the ``sparkdl_tpu`` root)::
-
-    transformers/   serving/   udf/
-
-Flagged forms:
-
-- ``jax.jit(...)`` calls and bare ``jax.jit`` references (decorators,
-  aliasing like ``jitted = jax.jit``);
-- ``from jax import jit`` (with or without ``as`` renaming) inside the
-  checked packages — the alias is just a disguised bare jit.
-
-Not flagged:
-
-- anything under ``sparkdl_tpu/engine/`` (the one sanctioned caller);
-- other packages (``estimators/``, ``graph/``, ``native/`` trace and
-  export programs with semantics the engine does not model yet — grow
-  ``CHECKED_PACKAGES`` when they migrate);
-- ``jax.jit`` mentioned in strings or comments.
-
-Usage: ``python ci/lint_no_raw_jit.py [root]`` — exits 1 with one
-``path:line`` diagnostic per violation.
+Same CLI contract as the original single-rule script — ``path:line:
+message`` on stdout, ``N violation(s)`` on stderr, exit 1 on findings.
+Prefer ``python -m ci.sparkdl_check`` (runs all rules in one pass).
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-#: packages (under sparkdl_tpu/) whose compilation must go through the
-#: engine; grow this list as more layers migrate to engine.function.
-CHECKED_PACKAGES = ("transformers", "serving", "udf")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-_FIX = (
-    "route compilation through the execution engine "
-    "(sparkdl_tpu.engine: engine.function(...) / ExecutionEngine.program) "
-    "so it hits the persistent executable cache"
-)
+from ci.sparkdl_check.core import run_check  # noqa: E402
 
-
-def _is_jax_jit(node: ast.AST) -> bool:
-    """True for an ``Attribute`` expression spelling ``jax.jit``."""
-    return (
-        isinstance(node, ast.Attribute)
-        and node.attr == "jit"
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "jax"
-    )
-
-
-def check_file(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    violations = []
-    for node in ast.walk(tree):
-        if _is_jax_jit(node):
-            violations.append(
-                (node.lineno, f"bare jax.jit — {_FIX}")
-            )
-        elif isinstance(node, ast.ImportFrom) and node.module == "jax":
-            for alias in node.names:
-                if alias.name == "jit":
-                    shown = alias.asname or alias.name
-                    violations.append(
-                        (
-                            node.lineno,
-                            f"'from jax import jit' (as {shown!r}) — {_FIX}",
-                        )
-                    )
-    return violations
+RULE = "raw-jit"
 
 
 def main() -> int:
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else ".")
     pkg = root / "sparkdl_tpu"
-    bad = 0
-    for sub in CHECKED_PACKAGES:
-        for path in sorted((pkg / sub).rglob("*.py")):
-            for line, msg in check_file(path):
-                print(f"{path}:{line}: {msg}")
-                bad += 1
-    if bad:
-        print(f"{bad} violation(s)", file=sys.stderr)
+    scan_root = pkg if pkg.is_dir() else root
+    report = run_check(scan_root, rule_ids=[RULE], baseline=None)
+    for f in report.findings:
+        print(f"{scan_root / f.path}:{f.line}: {f.message}")
+    if report.findings:
+        print(f"{len(report.findings)} violation(s)", file=sys.stderr)
         return 1
     return 0
 

@@ -1,107 +1,34 @@
 #!/usr/bin/env python3
-"""Lint: no ad-hoc ``time.sleep`` retry loops outside the resilience
-package.
+"""Back-compat shim: the ``sleep-retry`` rule now lives in the unified
+``ci/sparkdl_check`` framework (one AST parse per file, every rule).
 
-The fault-tolerance subsystem (``sparkdl_tpu/resilience/``) owns
-backoff: ``RetryPolicy`` sleeps deterministically (seeded jitter,
-injectable clock, metrics).  A ``time.sleep`` inside a loop anywhere
-else in ``sparkdl_tpu/`` is almost always a hand-rolled retry loop —
-untyped, unmetered, untestable — so this gate fails CI when one grows
-back.
-
-Flags any ``time.sleep(...)`` / ``sleep(...)`` (imported from ``time``)
-call lexically inside a ``for`` / ``while`` body in ``sparkdl_tpu/``,
-excluding ``sparkdl_tpu/resilience/`` (the one sanctioned home).
-Event-loop waits should use ``threading.Event.wait`` / ``queue``
-timeouts, which also wake early — that is why they are not flagged.
-
-Usage: ``python ci/lint_no_sleep_retry.py [root]`` — exits 1 with one
-``path:line`` diagnostic per violation.
+This script preserves the original single-rule CLI contract — same
+``path:line: message`` lines on stdout, same ``N violation(s)`` on
+stderr, same exit codes — for anything still invoking it directly.
+Prefer ``python -m ci.sparkdl_check`` (runs all rules in one pass).
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
-EXCLUDED = ("resilience",)
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from ci.sparkdl_check.core import run_check  # noqa: E402
 
-def _names_sleep(call: ast.Call, time_aliases: set, sleep_aliases: set) -> bool:
-    fn = call.func
-    if isinstance(fn, ast.Attribute) and fn.attr == "sleep":
-        if isinstance(fn.value, ast.Name) and fn.value.id in time_aliases:
-            return True
-    if isinstance(fn, ast.Name) and fn.id in sleep_aliases:
-        return True
-    return False
-
-
-def _collect_aliases(tree: ast.AST):
-    """Names that ``time`` / ``time.sleep`` are bound to in this module."""
-    time_aliases, sleep_aliases = set(), set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "time":
-                    time_aliases.add(a.asname or "time")
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            for a in node.names:
-                if a.name == "sleep":
-                    sleep_aliases.add(a.asname or "sleep")
-    return time_aliases, sleep_aliases
-
-
-def check_file(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    time_aliases, sleep_aliases = _collect_aliases(tree)
-    if not time_aliases and not sleep_aliases:
-        return []
-    violations = []
-
-    def visit(node: ast.AST, in_loop: bool):
-        for child in ast.iter_child_nodes(node):
-            child_in_loop = in_loop or isinstance(
-                node, (ast.For, ast.While, ast.AsyncFor)
-            )
-            if (
-                child_in_loop
-                and isinstance(child, ast.Call)
-                and _names_sleep(child, time_aliases, sleep_aliases)
-            ):
-                violations.append(child.lineno)
-            # a nested def/lambda resets loop context: its body runs when
-            # called, not per enclosing-loop iteration
-            if isinstance(
-                child,
-                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
-            ):
-                visit(child, False)
-            else:
-                visit(child, child_in_loop)
-
-    visit(tree, False)
-    return violations
+RULE = "sleep-retry"
 
 
 def main() -> int:
     root = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else ".")
     pkg = root / "sparkdl_tpu"
-    bad = 0
-    for path in sorted(pkg.rglob("*.py")):
-        rel = path.relative_to(pkg)
-        if rel.parts and rel.parts[0] in EXCLUDED:
-            continue
-        for line in check_file(path):
-            print(
-                f"{path}:{line}: time.sleep inside a loop — use "
-                "sparkdl_tpu.resilience.RetryPolicy (typed, metered, "
-                "deterministic backoff) instead of an ad-hoc retry loop"
-            )
-            bad += 1
-    if bad:
-        print(f"{bad} violation(s)", file=sys.stderr)
+    scan_root = pkg if pkg.is_dir() else root
+    report = run_check(scan_root, rule_ids=[RULE], baseline=None)
+    for f in report.findings:
+        print(f"{scan_root / f.path}:{f.line}: {f.message}")
+    if report.findings:
+        print(f"{len(report.findings)} violation(s)", file=sys.stderr)
         return 1
     return 0
 

@@ -1,0 +1,51 @@
+"""``sparkdl_check`` — the repo's unified static-analysis framework.
+
+One AST parse per file feeds every registered rule (the three legacy
+single-rule lint scripts each re-parsed the whole tree; they are now
+thin shims over this package).  Rules encode the concurrency and
+device-execution invariants the threaded subsystems rely on:
+
+==================  ====================================================
+rule id             invariant
+==================  ====================================================
+lock-order          lock acquisition order is globally consistent
+                    (no cycles in the acquisition graph → no deadlocks)
+lock-blocking       nothing that can block indefinitely (or for seconds)
+                    runs while a lock is held
+host-sync           hot paths never force an implicit device→host sync
+                    (float()/np.asarray/.item()/device_get on engine
+                    results serializes the dispatch window)
+recompile-hazard    engine programs carry stable fingerprints; no
+                    per-call anonymous programs (cache-key explosion
+                    defeats the persistent compile cache)
+donation-safety     a buffer passed to a donated engine call is never
+                    read afterwards (donation invalidates it)
+contextvar-leak     span context crosses threads/queues only via the
+                    documented tracer.capture()/use_span() pair
+sleep-retry         no ad-hoc time.sleep retry loops outside resilience/
+metric-name         metric names follow 'subsystem.metric_name'
+raw-jit             hot paths compile through the engine, not bare
+                    jax.jit
+==================  ====================================================
+
+Entry point: ``python -m ci.sparkdl_check [root]``.  Suppress one
+finding inline with ``# sparkdl: disable=<rule-id>``; grandfather
+deliberate findings in ``baseline.json``.  See README "Static analysis".
+"""
+
+from ci.sparkdl_check.core import (  # noqa: F401
+    Finding,
+    FileContext,
+    REGISTRY,
+    Report,
+    Rule,
+    all_rule_ids,
+    rule,
+    run_check,
+)
+from ci.sparkdl_check.baseline import (  # noqa: F401
+    DEFAULT_BASELINE,
+    load_baseline,
+    write_baseline,
+)
+from ci.sparkdl_check.report import json_report, text_report  # noqa: F401

@@ -1,0 +1,67 @@
+"""CLI: ``python -m ci.sparkdl_check [root] [options]``.
+
+Exit status is 0 only when every finding is suppressed or baselined,
+every file parsed, and no baseline entry is stale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from ci.sparkdl_check import (
+    REGISTRY,
+    all_rule_ids,
+    load_baseline,
+    run_check,
+    write_baseline,
+)
+from ci.sparkdl_check.report import json_report, text_report
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ci.sparkdl_check",
+        description="sparkdl static-analysis: one parse, every rule.",
+    )
+    p.add_argument("root", nargs="?", default="sparkdl_tpu",
+                   help="directory (or single file) to scan")
+    p.add_argument("--rules", help="comma-separated rule ids (default: all)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", type=Path, default=None,
+                   help="baseline file (default: ci/sparkdl_check/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline (report every finding)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings as the new baseline and exit 0")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid in all_rule_ids():
+            cls = REGISTRY[rid]
+            print(f"{rid:18s} [{cls.severity}] {cls.doc}")
+        return 0
+    rule_ids = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    baseline = None if args.no_baseline else load_baseline(args.baseline)
+    if args.write_baseline:
+        # findings with no baseline applied ARE the new baseline
+        report = run_check(Path(args.root), rule_ids, baseline=None)
+        path = write_baseline(report.findings, args.baseline)
+        print(f"wrote {len(report.findings)} finding(s) to {path}")
+        return 0
+    report = run_check(Path(args.root), rule_ids, baseline=baseline)
+    out = json_report(report) if args.format == "json" else text_report(report)
+    print(out)
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+"""Baseline handling: grandfathered findings that are known, deliberate,
+and documented — not silently ignored.
+
+The baseline file (``ci/sparkdl_check/baseline.json``) is checked in and
+reviewed like code.  Each entry records the rule id, package-relative
+path, the exact diagnostic message, and a human ``reason`` explaining
+why the finding is deferred rather than fixed.  Matching is on
+``(rule, path, message)`` with multiplicity (two identical findings need
+two entries); line numbers are stored for the reader but ignored for
+matching, so unrelated edits above a grandfathered site don't churn the
+file.
+
+A baseline entry whose finding no longer fires is **stale** and fails
+the run: a baseline that over-describes reality would silently mask the
+same finding if it ever came back.  Regenerate with
+``python -m ci.sparkdl_check <root> --write-baseline``.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Optional[Path] = None) -> Optional[dict]:
+    path = Path(path) if path else DEFAULT_BASELINE
+    if not path.exists():
+        return None
+    doc = json.loads(path.read_text())
+    if not isinstance(doc, dict) or "findings" not in doc:
+        raise ValueError(
+            f"baseline {path} must be an object with a 'findings' list"
+        )
+    return doc
+
+
+def write_baseline(findings, path: Optional[Path] = None,
+                   reason: str = "grandfathered by --write-baseline") -> Path:
+    path = Path(path) if path else DEFAULT_BASELINE
+    doc = {
+        "comment": (
+            "Grandfathered sparkdl_check findings. Matched on "
+            "(rule, path, message); 'line' is informational. Entries whose "
+            "finding no longer fires are stale and fail the run — remove "
+            "them. See README 'Static analysis'."
+        ),
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+                "reason": reason,
+            }
+            for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line))
+        ],
+    }
+    path.write_text(json.dumps(doc, indent=2) + "\n")
+    return path
+
+
+def match_baseline(
+    findings: List, baseline: Optional[dict]
+) -> Tuple[List, List, List[dict]]:
+    """Split ``findings`` into (active, baselined) and report stale
+    baseline entries.  Multiplicity-aware: N identical findings consume
+    at most N matching entries."""
+    if not baseline:
+        return list(findings), [], []
+    budget: Counter = Counter()
+    entry_for: Dict[Tuple[str, str, str], dict] = {}
+    for entry in baseline.get("findings", []):
+        key = (entry["rule"], entry["path"], entry["message"])
+        budget[key] += 1
+        entry_for[key] = entry
+    active, baselined = [], []
+    for f in findings:
+        if budget.get(f.key(), 0) > 0:
+            budget[f.key()] -= 1
+            baselined.append(f)
+        else:
+            active.append(f)
+    stale = [
+        {
+            "rule": key[0], "path": key[1], "message": key[2],
+            "count": count,
+            "reason": entry_for[key].get("reason", ""),
+        }
+        for key, count in sorted(budget.items())
+        if count > 0
+    ]
+    return active, baselined, stale

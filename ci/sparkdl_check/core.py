@@ -1,0 +1,256 @@
+"""sparkdl_check core: one AST parse per file feeding a rule registry.
+
+The framework contract (see ``ci/sparkdl_check/__init__.py`` for the
+user-facing story):
+
+- every scanned file is read and ``ast.parse``d exactly ONCE; each
+  registered rule receives the same :class:`FileContext` (tree + source
+  lines + package-relative path) — no rule re-reads or re-parses;
+- rules are small classes registered with :func:`rule`; a rule scopes
+  itself via :meth:`Rule.applies` (package-relative posix path), emits
+  :class:`Finding`s from :meth:`Rule.check`, and may emit cross-file
+  findings from :meth:`Rule.finalize` (e.g. lock-order cycles need the
+  whole-project acquisition graph);
+- inline suppression: a ``# sparkdl: disable=<rule-id>[,<rule-id>...]``
+  comment on the finding's line (or ``disable=all``) moves the finding
+  to the report's ``suppressed`` list;
+- baseline: grandfathered findings listed in a checked-in JSON file
+  (:mod:`ci.sparkdl_check.baseline`) move to ``baselined``; baseline
+  entries that no longer match any finding are reported as
+  ``stale_baseline`` so the file cannot rot.
+
+Everything here is pure stdlib — the checker must start and finish in
+well under the 10 s acceptance budget, so it never imports jax, numpy,
+or sparkdl_tpu itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+#: severity levels, strongest first (display/sorting only: ANY
+#: non-baselined, non-suppressed finding fails the run)
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(r"#\s*sparkdl:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: where, which rule, what, how bad."""
+
+    rule: str
+    path: str  # package-relative posix path (stable across checkouts)
+    line: int
+    message: str
+    severity: str = "error"
+    col: int = 0
+
+    def key(self) -> Tuple[str, str, str]:
+        """The baseline identity: rule + path + message.  Line numbers
+        deliberately excluded — code above a grandfathered finding moving
+        it down a line must not un-baseline it."""
+        return (self.rule, self.path, self.message)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+
+class FileContext:
+    """Everything a rule may want about one file, parsed once."""
+
+    __slots__ = ("path", "relpath", "tree", "lines", "source")
+
+    def __init__(self, path: Path, relpath: str, tree: ast.Module,
+                 source: str, lines: List[str]):
+        self.path = path
+        self.relpath = relpath
+        self.tree = tree
+        self.source = source
+        self.lines = lines
+
+    def suppressed_rules(self, line: int) -> frozenset:
+        """Rule ids disabled on ``line`` via inline comment."""
+        if 1 <= line <= len(self.lines):
+            m = _SUPPRESS_RE.search(self.lines[line - 1])
+            if m:
+                return frozenset(
+                    part.strip() for part in m.group(1).split(",")
+                    if part.strip()
+                )
+        return frozenset()
+
+
+class Rule:
+    """Base class for one analyzer.  Subclass, set ``id``/``doc``, and
+    register with the :func:`rule` decorator."""
+
+    #: stable rule id (what suppressions and baselines reference)
+    id: str = ""
+    #: default severity of this rule's findings
+    severity: str = "error"
+    #: one-line statement of the invariant the rule encodes
+    doc: str = ""
+
+    def applies(self, relpath: str) -> bool:
+        """Whether this rule scans ``relpath`` (package-relative posix)."""
+        return True
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        """Cross-file findings, called once after every file's check()."""
+        return ()
+
+    # -- helpers -------------------------------------------------------
+    def finding(self, ctx_or_path, node_or_line, message: str,
+                severity: Optional[str] = None) -> Finding:
+        path = (
+            ctx_or_path.relpath
+            if isinstance(ctx_or_path, FileContext) else str(ctx_or_path)
+        )
+        if isinstance(node_or_line, ast.AST):
+            line = getattr(node_or_line, "lineno", 0)
+            col = getattr(node_or_line, "col_offset", 0)
+        else:
+            line, col = int(node_or_line), 0
+        return Finding(
+            rule=self.id, path=path, line=line, col=col,
+            message=message, severity=severity or self.severity,
+        )
+
+
+#: rule id -> rule class (populated by the @rule decorator at import of
+#: ci.sparkdl_check.rules)
+REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def rule(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id:
+        raise ValueError(f"rule class {cls.__name__} has no id")
+    if cls.id in REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rule_ids() -> List[str]:
+    from ci.sparkdl_check import rules as _rules  # noqa: F401  (registers)
+
+    return sorted(REGISTRY)
+
+
+@dataclass
+class Report:
+    """The outcome of one run (see reporters in ``report.py``)."""
+
+    root: str
+    rules: List[str]
+    files_scanned: int = 0
+    elapsed_s: float = 0.0
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[dict] = field(default_factory=list)
+    parse_errors: List[dict] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        """Non-zero on any active finding, a file that failed to parse,
+        or a stale baseline entry (a baseline must describe reality)."""
+        if self.findings or self.parse_errors or self.stale_baseline:
+            return 1
+        return 0
+
+
+def package_relpath(path: Path, root: Path) -> str:
+    """The path rules see: relative to the ``sparkdl_tpu`` package root
+    when one is on the path, else relative to the scan root.  Posix
+    separators always (stable baselines across platforms)."""
+    rel = path.relative_to(root)
+    parts = list(rel.parts)
+    if "sparkdl_tpu" in parts:
+        idx = len(parts) - 1 - parts[::-1].index("sparkdl_tpu")
+        parts = parts[idx + 1:]
+    if not parts:  # the root itself
+        parts = [rel.name]
+    return "/".join(parts)
+
+
+def iter_python_files(root: Path) -> List[Path]:
+    if root.is_file():
+        return [root]
+    return sorted(p for p in root.rglob("*.py"))
+
+
+def run_check(
+    root: Path,
+    rule_ids: Optional[Sequence[str]] = None,
+    baseline: Optional[dict] = None,
+) -> Report:
+    """Scan ``root`` with the selected rules (default: all registered).
+
+    ``baseline`` is the parsed baseline document (see
+    :mod:`ci.sparkdl_check.baseline`); None means no grandfathering.
+    """
+    from ci.sparkdl_check.baseline import match_baseline
+
+    registered = all_rule_ids()  # importing the rules package registers them
+    ids = list(rule_ids) if rule_ids else registered
+    unknown = [i for i in ids if i not in REGISTRY]
+    if unknown:
+        raise KeyError(
+            f"unknown rule id(s) {unknown}; known: {all_rule_ids()}"
+        )
+    rules = [REGISTRY[i]() for i in ids]
+    root = Path(root)
+    report = Report(root=str(root), rules=ids)
+    t0 = time.perf_counter()
+
+    raw: List[Finding] = []
+    suppressed: List[Finding] = []
+    for path in iter_python_files(root):
+        relpath = package_relpath(path, root if root.is_dir() else root.parent)
+        applicable = [r for r in rules if r.applies(relpath)]
+        if not applicable:
+            continue
+        try:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))  # the ONE parse
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            report.parse_errors.append({"path": relpath, "error": str(e)})
+            continue
+        ctx = FileContext(path, relpath, tree, source, source.splitlines())
+        report.files_scanned += 1
+        for r in applicable:
+            for f in r.check(ctx):
+                dis = ctx.suppressed_rules(f.line)
+                if f.rule in dis or "all" in dis:
+                    suppressed.append(f)
+                else:
+                    raw.append(f)
+    for r in rules:
+        raw.extend(r.finalize())
+
+    active, baselined, stale = match_baseline(raw, baseline)
+    sev_rank = {s: i for i, s in enumerate(SEVERITIES)}
+    active.sort(key=lambda f: (sev_rank.get(f.severity, 9), f.path, f.line))
+    report.findings = active
+    report.suppressed = suppressed
+    report.baselined = baselined
+    report.stale_baseline = stale
+    report.elapsed_s = time.perf_counter() - t0
+    return report

@@ -1,0 +1,61 @@
+"""Reporters: human text for terminals, JSON for CI artifacts.
+
+The JSON document is the machine contract consumed by ``ci/check.sh``
+(and printed by ``ci/fault-suite.sh`` on failure): top-level keys are
+stable, findings are the ``Finding.to_dict()`` shape, and ``exit_code``
+mirrors what the process will exit with.
+"""
+
+from __future__ import annotations
+
+import json
+
+from ci.sparkdl_check.core import Report
+
+
+def text_report(report: Report) -> str:
+    lines = []
+    for f in report.findings:
+        lines.append(
+            f"{f.path}:{f.line}:{f.col}: {f.rule} [{f.severity}] {f.message}"
+        )
+    for err in report.parse_errors:
+        lines.append(f"{err['path']}: parse-error {err['error']}")
+    for entry in report.stale_baseline:
+        lines.append(
+            f"stale baseline entry: {entry['rule']} @ {entry['path']} "
+            f"({entry['message']!r} no longer fires — remove it)"
+        )
+    n = len(report.findings)
+    summary = (
+        f"{report.files_scanned} file(s), {len(report.rules)} rule(s), "
+        f"{report.elapsed_s:.2f}s: "
+        f"{n} finding(s), {len(report.suppressed)} suppressed, "
+        f"{len(report.baselined)} baselined"
+    )
+    if report.stale_baseline:
+        summary += f", {len(report.stale_baseline)} stale baseline entr(ies)"
+    if report.parse_errors:
+        summary += f", {len(report.parse_errors)} parse error(s)"
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def json_report(report: Report) -> str:
+    counts = {}
+    for f in report.findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    doc = {
+        "root": report.root,
+        "rules": report.rules,
+        "files_scanned": report.files_scanned,
+        "elapsed_s": round(report.elapsed_s, 4),
+        "findings": [f.to_dict() for f in report.findings],
+        "suppressed": [f.to_dict() for f in report.suppressed],
+        "baselined": [f.to_dict() for f in report.baselined],
+        "stale_baseline": report.stale_baseline,
+        "parse_errors": report.parse_errors,
+        "counts": counts,
+        "exit_code": report.exit_code,
+    }
+    return json.dumps(doc, indent=2)

@@ -1,0 +1,13 @@
+"""Importing this package registers every rule with the framework
+registry (each module uses the ``@rule`` decorator at import time)."""
+
+from ci.sparkdl_check.rules import (  # noqa: F401
+    contextvar_leak,
+    donation_safety,
+    host_sync,
+    lock_discipline,
+    metric_names,
+    raw_jit,
+    recompile_hazard,
+    sleep_retry,
+)

@@ -1,0 +1,149 @@
+"""``contextvar-leak`` — span context never crosses threads implicitly.
+
+``contextvars`` do not propagate into new threads: a worker thread (or
+a queue consumer draining work enqueued by another thread) that calls
+``current_span()`` / ``tracer.current()`` / ``record_event(...)`` sees
+an *empty* context, so its events silently attach to no span — or worse,
+to whatever stale span the thread pool last ran.  The documented
+protocol (``obs/trace.py``) is: the producer calls ``tracer.capture()``
+and the consumer re-enters the span with ``with tracer.use_span(span):``.
+
+This rule marks thread-entry functions — ``threading.Thread(target=f)``
+targets, ``executor.submit(f, ...)`` callables, and queue consumers
+(functions that call ``.get()`` on a known ``queue.Queue``) — and flags
+span/context access inside them unless it is lexically inside a
+``with <tracer>.use_span(...):`` block.  Calling ``capture()`` inside
+the worker is flagged too: by then the context is already gone — it
+must be captured on the producer side.
+
+Creating a *new* span inside a worker (``tracer.span(...)`` /
+``start_span``) is fine and not flagged: the batcher worker does exactly
+that by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name, target_name
+
+_READ_ATTRS = {"current", "capture"}
+_READ_NAMES = {"current_span", "record_event"}
+
+
+def _queue_spellings(tree: ast.Module) -> Set[str]:
+    queues: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = dotted_name(node.value.func)
+            if ctor and ctor.split(".")[-1] in {
+                "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"
+            }:
+                for tgt in node.targets:
+                    spelling = target_name(tgt)
+                    if spelling is not None:
+                        queues.add(spelling)
+    return queues
+
+
+def _worker_entry_names(tree: ast.Module, queues: Set[str]) -> Set[str]:
+    """Bare names of functions that run on another thread: Thread
+    targets, executor.submit callables, and queue consumers."""
+    entries: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn_name = dotted_name(node.func)
+            is_thread = fn_name is not None and fn_name.split(".")[-1] == "Thread"
+            if is_thread:
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tname = dotted_name(kw.value)
+                        if tname is not None:
+                            entries.add(tname.split(".")[-1])
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit" and node.args):
+                tname = dotted_name(node.args[0])
+                if tname is not None:
+                    entries.add(tname.split(".")[-1])
+    # queue consumers: functions whose body calls <queue>.get(...)
+    for fnode in ast.walk(tree):
+        if not isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fnode):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"):
+                recv = dotted_name(node.func.value)
+                if recv is not None and recv in queues:
+                    entries.add(fnode.name)
+                    break
+    return entries
+
+
+def _span_read(call: ast.Call) -> Optional[str]:
+    """'tracer.current()'-style context read, or None."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _READ_ATTRS:
+        recv = dotted_name(fn.value)
+        if recv is not None and "tracer" in recv.split(".")[-1].lower():
+            return f"{recv}.{fn.attr}()"
+    if isinstance(fn, ast.Name) and fn.id in _READ_NAMES:
+        return f"{fn.id}()"
+    return None
+
+
+def _is_use_span(with_item: ast.withitem) -> bool:
+    expr = with_item.context_expr
+    return (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr == "use_span"
+    )
+
+
+@rule
+class ContextvarLeakRule(Rule):
+    id = "contextvar-leak"
+    severity = "error"
+    doc = ("worker threads and queue consumers must re-enter spans via "
+           "tracer.capture()/use_span(); contextvars don't cross threads")
+
+    def applies(self, relpath: str) -> bool:
+        # obs/ implements the mechanism; tests exercise it deliberately
+        return not (relpath.startswith(("tests/", "obs/")))
+
+    def check(self, ctx: FileContext):
+        queues = _queue_spellings(ctx.tree)
+        entries = _worker_entry_names(ctx.tree, queues)
+        if not entries:
+            return ()
+        findings = []
+        for fnode in ast.walk(ctx.tree):
+            if not isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fnode.name not in entries:
+                continue
+
+            def visit(node, guarded: bool):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    if any(_is_use_span(item) for item in node.items):
+                        guarded = True
+                if isinstance(node, ast.Call) and not guarded:
+                    read = _span_read(node)
+                    if read is not None:
+                        findings.append(self.finding(
+                            ctx, node,
+                            f"{read} inside thread/queue worker "
+                            f"'{fnode.name}' — contextvars don't propagate "
+                            "into threads, so this reads an empty (or "
+                            "stale) context; capture() on the producer "
+                            "side and wrap the work in 'with "
+                            "tracer.use_span(span):'",
+                        ))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, guarded)
+
+            visit(fnode, False)
+        return findings

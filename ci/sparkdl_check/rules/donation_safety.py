@@ -1,0 +1,122 @@
+"""``donation-safety`` — never read a buffer after donating it.
+
+``engine.function(fn, donate=True)`` hands the input buffer to XLA for
+in-place reuse: after the call, the donated array's storage belongs to
+the output.  Reading the input name afterwards is undefined — on TPU it
+raises, on CPU it *silently* reads whatever the output left there,
+which is how donation bugs ship.
+
+The rule tracks names bound to donated engine callables
+(``f = engine.function(..., donate=True)``) and, per function body in
+source order, flags any Load of a name after it was passed (as a bare
+name) to a donated call — unless the name is re-bound first.  Passing
+an expression (``f(_place(batch))``) is not tracked: the temporary has
+no later readers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name, is_engine_receiver, keyword, target_name
+
+
+def _donated_callables(tree: ast.Module) -> Set[str]:
+    marked: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if not is_engine_receiver(call.func):
+                continue
+            donate = keyword(call, "donate")
+            if isinstance(donate, ast.Constant) and donate.value is True:
+                for tgt in node.targets:
+                    spelling = target_name(tgt)
+                    if spelling is not None:
+                        marked.add(spelling)
+    return marked
+
+
+@rule
+class DonationSafetyRule(Rule):
+    id = "donation-safety"
+    severity = "error"
+    doc = ("a name passed to a donate=True engine call is dead afterwards "
+           "— XLA reuses its buffer for the output")
+
+    def applies(self, relpath: str) -> bool:
+        return not relpath.startswith("tests/")
+
+    def check(self, ctx: FileContext):
+        donated = _donated_callables(ctx.tree)
+        if not donated:
+            return ()
+        findings = []
+        for fnode in ast.walk(ctx.tree):
+            if not isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            findings.extend(self._check_body(ctx, fnode, donated))
+        return findings
+
+    def _check_body(self, ctx, fnode, donated: Set[str]):
+        """Execution-order scan of one function body.  Control flow is
+        approximated lexically (a loop body is scanned once, in order),
+        which is the right trade-off for a linter: the common bug is
+        straight-line 'donate then log the input'.  Assignments evaluate
+        their value before binding targets, so ``x = f(x)`` (donate then
+        rebind) is clean."""
+        findings = []
+        # name -> line where it was donated
+        dead: Dict[str, int] = {}
+
+        def on_name(node: ast.Name):
+            if isinstance(node.ctx, ast.Store):
+                dead.pop(node.id, None)
+            elif isinstance(node.ctx, ast.Load) and node.id in dead:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"'{node.id}' read after being donated on line "
+                    f"{dead[node.id]} — the donated buffer now backs the "
+                    "output; rebind the result or drop donate=True",
+                ))
+                dead.pop(node.id)  # one finding per donation site
+
+        def emit(node):
+            # nested defs/lambdas run later with their own locals; the
+            # outer walk in check() visits them as their own bodies
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                if node.value is not None:
+                    emit(node.value)
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    emit(tgt)
+                return
+            if isinstance(node, ast.Call):
+                emit(node.func)
+                spelling = dotted_name(node.func)
+                is_donating = spelling in donated
+                for arg in node.args:
+                    if is_donating and isinstance(arg, ast.Name):
+                        dead[arg.id] = arg.lineno
+                    else:
+                        emit(arg)
+                for kw in node.keywords:
+                    emit(kw.value)
+                return
+            if isinstance(node, ast.Name):
+                on_name(node)
+                return
+            for child in ast.iter_child_nodes(node):
+                emit(child)
+
+        for stmt in fnode.body:
+            emit(stmt)
+        return findings

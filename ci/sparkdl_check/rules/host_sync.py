@@ -1,0 +1,169 @@
+"""``host-sync`` — no implicit device→host synchronization on hot paths.
+
+The engine's dispatch window (PR 5) keeps N batches in flight; its whole
+benefit evaporates the moment anything on the hot path forces the device
+result onto the host: ``float()``/``int()``/``bool()``/``.item()``/
+``np.asarray()`` on an engine result, or a bare ``jax.device_get`` /
+``block_until_ready``, all block until the device drains.  One stray
+``float(loss)`` serializes every in-flight batch behind it.
+
+Scope: ``transformers/``, ``serving/``, ``engine/``, ``data/`` — the
+packages on the request path — excluding ``engine/executor.py``, which
+is the one sanctioned synchronizer (``DispatchWindow`` fetches results
+*after* they fall out of the in-flight window, via
+``copy_to_host_async``).
+
+Device values are tracked lexically: a name (or container) assigned from
+``<engine>.function(...)`` / ``<engine>.program(...)`` is a device
+callable; calling it — or a name loaded from a marked container —
+produces a device value; coercing that value to host is the finding.
+Bare ``jax.device_get(...)`` / ``jax.block_until_ready(...)`` /
+``x.block_until_ready()`` are flagged unconditionally in scope.
+
+Sanctioned escapes: route fetches through ``DispatchWindow`` (dispatch
+the whole group, fetch as results land), or mark a deliberate
+synchronization point with ``# sparkdl: disable=host-sync`` (e.g. a
+warmup that *wants* to wait for compilation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name, is_engine_receiver, target_name
+
+_HOT_PACKAGES = ("transformers/", "serving/", "engine/", "data/")
+_SANCTIONED = ("engine/executor.py",)
+_COERCIONS = {"float", "int", "bool"}
+_NP_COERCIONS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+
+
+def _device_callables(tree: ast.Module) -> Set[str]:
+    """Spellings of names/attrs/containers bound to engine-wrapped
+    callables anywhere in the file (``fn = engine.function(...)``,
+    ``self._fwd = self._engine.program(...)``,
+    ``_cache[key] = _engine.function(...)`` → container ``_cache``)."""
+    marked: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if is_engine_receiver(node.value.func):
+                for tgt in node.targets:
+                    spelling = target_name(tgt)
+                    if spelling is not None:
+                        marked.add(spelling)
+    return marked
+
+
+def _is_device_value(node: ast.AST, callables: Set[str],
+                     device_names: Set[str]) -> bool:
+    """Expression known to be (or index into) a device result."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        spelling = dotted_name(fn)
+        if spelling is not None and spelling in callables:
+            return True
+        # _cache[key](batch): call of a value loaded from a marked container
+        if isinstance(fn, ast.Subscript):
+            base = dotted_name(fn.value)
+            if base is not None and base in callables:
+                return True
+        # direct engine.program(...)(x) chains
+        if is_engine_receiver(fn):
+            return True
+    spelling = dotted_name(node)
+    if spelling is not None and spelling in device_names:
+        return True
+    if isinstance(node, ast.Subscript):
+        base = dotted_name(node.value)
+        if base is not None and base in device_names:
+            return True
+    return False
+
+
+@rule
+class HostSyncRule(Rule):
+    id = "host-sync"
+    severity = "error"
+    doc = ("hot paths must not force implicit device→host syncs "
+           "(they serialize the dispatch window)")
+
+    def applies(self, relpath: str) -> bool:
+        if relpath in _SANCTIONED:
+            return False
+        return relpath.startswith(_HOT_PACKAGES)
+
+    def check(self, ctx: FileContext):
+        callables = _device_callables(ctx.tree)
+        findings = []
+        # per-function: names locally assigned from a device call
+        for fnode in ast.walk(ctx.tree):
+            if not isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Module)):
+                continue
+            device_names: Set[str] = set()
+            body = fnode.body if not isinstance(fnode, ast.Module) else []
+            for node in ast.walk(ast.Module(body=body, type_ignores=[]) if body else fnode):
+                if isinstance(node, ast.Assign):
+                    if _is_device_value(node.value, callables, device_names):
+                        for tgt in node.targets:
+                            spelling = target_name(tgt)
+                            if spelling is not None:
+                                device_names.add(spelling)
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                spelling = dotted_name(fn)
+                if spelling in ("jax.device_get", "jax.block_until_ready"):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"bare {spelling} on a hot path — blocks until the "
+                        "device drains; fetch through DispatchWindow (or "
+                        "mark a deliberate sync with "
+                        "'# sparkdl: disable=host-sync')",
+                    ))
+                    continue
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr == "block_until_ready"
+                        and not node.args):
+                    findings.append(self.finding(
+                        ctx, node,
+                        ".block_until_ready() on a hot path — blocks until "
+                        "the device drains; fetch through DispatchWindow "
+                        "(or mark a deliberate sync with "
+                        "'# sparkdl: disable=host-sync')",
+                    ))
+                    continue
+                if isinstance(fn, ast.Attribute) and fn.attr == "item":
+                    if _is_device_value(fn.value, callables, device_names):
+                        findings.append(self.finding(
+                            ctx, node,
+                            ".item() on an engine result — implicit "
+                            "device→host sync serializes the dispatch "
+                            "window",
+                        ))
+                    continue
+                coercion = None
+                if isinstance(fn, ast.Name) and fn.id in _COERCIONS:
+                    coercion = f"{fn.id}()"
+                elif spelling in _NP_COERCIONS:
+                    coercion = f"{spelling}()"
+                if coercion and node.args and _is_device_value(
+                        node.args[0], callables, device_names):
+                    findings.append(self.finding(
+                        ctx, node,
+                        f"{coercion} on an engine result — implicit "
+                        "device→host sync serializes the dispatch window; "
+                        "dispatch the whole group, then fetch through "
+                        "DispatchWindow",
+                    ))
+        # dedupe (module-level walk overlaps function walks)
+        seen = set()
+        out = []
+        for f in findings:
+            k = (f.line, f.col, f.message)
+            if k not in seen:
+                seen.add(k)
+                out.append(f)
+        return out

@@ -1,0 +1,383 @@
+"""Lock discipline over the threaded subsystems.
+
+Two rules share one pass:
+
+``lock-order``
+    Builds a global lock-acquisition graph (edge A→B whenever B is
+    acquired while A is held) across every scanned file and, in
+    ``finalize()``, reports every acquisition that participates in a
+    cycle.  Two threads taking the same pair of locks in opposite order
+    is the classic ABBA deadlock; a cycle through more locks is the same
+    bug with more travel.
+
+``lock-blocking``
+    Flags calls that can block indefinitely — or for seconds — while a
+    lock is held: ``time.sleep``, ``Queue.put/get`` without a timeout,
+    ``future.result()`` / ``thread.join()`` / ``Event.wait()`` without a
+    timeout, ``jax.block_until_ready`` / ``jax.device_get`` (device
+    sync), ``subprocess.run``-family, and engine program resolution
+    (``*.program(...)`` on an engine receiver may AOT-compile for
+    seconds).  Every other thread that touches the lock stalls behind
+    the call — in ``serving/`` that means health probes and the
+    admission path.
+
+Lock identity is lexical: ``self._lock = threading.Lock()`` in class
+``C`` of file ``f`` is the lock ``f:C:self._lock``; ``Condition(x)``
+aliases to ``x``'s lock (so ``with cond:`` holds the underlying lock,
+and ``cond.wait()`` — which *releases* it — is never flagged).
+A nested ``def``/``lambda`` resets the held-lock context: its body runs
+when called, not under the enclosing ``with``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name, is_engine_receiver, keyword, target_name
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+
+
+class _FileLockState:
+    """Per-file lock/queue/event/condition inventory, keyed by the
+    spelling used at the assignment site within a class (or module)
+    scope."""
+
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        # (class_qualname, spelling) -> lock id
+        self.locks: Dict[Tuple[str, str], str] = {}
+        # spellings of Condition objects (their .wait releases the lock)
+        self.conditions: Set[Tuple[str, str]] = set()
+        self.events: Set[Tuple[str, str]] = set()
+        self.queues: Set[Tuple[str, str]] = set()
+        self.time_aliases: Set[str] = set()
+        self.sleep_aliases: Set[str] = set()
+
+    def lock_id(self, scopes: List[str], spelling: str) -> Optional[str]:
+        """Resolve a with-statement expression to a lock id, innermost
+        class scope outward, then module scope."""
+        for scope in reversed(scopes):
+            hit = self.locks.get((scope, spelling))
+            if hit:
+                return hit
+        return self.locks.get(("<module>", spelling))
+
+    def _in_scopes(self, table, scopes: List[str], spelling: str) -> bool:
+        return any((s, spelling) in table for s in reversed(scopes)) or (
+            ("<module>", spelling) in table
+        )
+
+    def is_condition(self, scopes, spelling):
+        return self._in_scopes(self.conditions, scopes, spelling)
+
+    def is_event(self, scopes, spelling):
+        return self._in_scopes(self.events, scopes, spelling)
+
+    def is_queue(self, scopes, spelling):
+        return self._in_scopes(self.queues, scopes, spelling)
+
+
+def _ctor_name(value: ast.AST) -> Optional[str]:
+    """'Lock' for threading.Lock()/Lock(), 'Queue' for queue.Queue()…"""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if name is None:
+        return None
+    return name.split(".")[-1]
+
+
+def _collect(ctx: FileContext) -> _FileLockState:
+    state = _FileLockState(ctx.relpath)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    state.time_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    state.sleep_aliases.add(a.asname or "sleep")
+
+    def visit(node: ast.AST, class_stack: List[str]):
+        scope = class_stack[-1] if class_stack else "<module>"
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else (
+                [node.target] if node.target is not None else []
+            )
+            value = node.value
+            ctor = _ctor_name(value) if value is not None else None
+            for tgt in targets:
+                spelling = target_name(tgt)
+                if spelling is None or ctor is None:
+                    continue
+                key = (scope, spelling)
+                if ctor in _LOCK_CTORS:
+                    state.locks[key] = f"{state.relpath}:{scope}:{spelling}"
+                elif ctor == "Condition":
+                    state.conditions.add(key)
+                    # Condition(self._lock) guards the underlying lock;
+                    # a bare Condition() owns a fresh one
+                    under = None
+                    if value.args:
+                        under_spelling = dotted_name(value.args[0])
+                        if under_spelling is not None:
+                            under = state.locks.get((scope, under_spelling))
+                    state.locks[key] = (
+                        under or f"{state.relpath}:{scope}:{spelling}"
+                    )
+                elif ctor == "Event":
+                    state.events.add(key)
+                elif ctor in {"Queue", "SimpleQueue", "LifoQueue",
+                              "PriorityQueue"}:
+                    state.queues.add(key)
+        new_stack = class_stack
+        if isinstance(node, ast.ClassDef):
+            new_stack = class_stack + [node.name]
+        for child in ast.iter_child_nodes(node):
+            visit(child, new_stack)
+
+    visit(ctx.tree, [])
+    return state
+
+
+def _blocking_message(call: ast.Call, state: _FileLockState,
+                      scopes: List[str]) -> Optional[str]:
+    fn = call.func
+    name = dotted_name(fn)
+    # time.sleep (with import aliasing)
+    if isinstance(fn, ast.Attribute) and fn.attr == "sleep":
+        if isinstance(fn.value, ast.Name) and fn.value.id in state.time_aliases:
+            return "time.sleep while holding a lock"
+    if isinstance(fn, ast.Name) and fn.id in state.sleep_aliases:
+        return "time.sleep while holding a lock"
+    if name in ("jax.device_get", "jax.block_until_ready"):
+        return f"{name.split('.')[-1]} (device sync) while holding a lock"
+    if name is not None and name.startswith("subprocess."):
+        if name.split(".")[-1] in _SUBPROCESS_BLOCKING:
+            return f"{name} while holding a lock"
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv_spelling = dotted_name(fn.value)
+    attr = fn.attr
+    if attr == "block_until_ready" and not call.args:
+        return ".block_until_ready() (device sync) while holding a lock"
+    if attr == "result" and not call.args and keyword(call, "timeout") is None:
+        return "future.result() with no timeout while holding a lock"
+    if attr == "join" and not call.args and keyword(call, "timeout") is None:
+        return ".join() with no timeout while holding a lock"
+    if attr == "wait" and not call.args and keyword(call, "timeout") is None:
+        if recv_spelling is not None:
+            # Condition.wait RELEASES the lock while waiting — sanctioned
+            if state.is_condition(scopes, recv_spelling):
+                return None
+            if state.is_event(scopes, recv_spelling):
+                return "Event.wait() with no timeout while holding a lock"
+        return None
+    if attr in ("get", "put") and recv_spelling is not None:
+        if state.is_queue(scopes, recv_spelling):
+            block_kw = keyword(call, "block")
+            nonblocking = (
+                isinstance(block_kw, ast.Constant) and block_kw.value is False
+            )
+            if keyword(call, "timeout") is None and not nonblocking:
+                return (
+                    f"Queue.{attr} without a timeout while holding a lock"
+                )
+    if is_engine_receiver(fn, attrs=("program",)):
+        return (
+            "engine program resolution under a lock — a cache miss "
+            "AOT-compiles for seconds while every other thread blocks"
+        )
+    return None
+
+
+@rule
+class LockOrderRule(Rule):
+    id = "lock-order"
+    severity = "error"
+    doc = ("lock acquisition order must be globally consistent "
+           "(acquisition-graph cycles are deadlocks waiting to happen)")
+
+    # class attribute shared per *instance* via __init__
+    def __init__(self):
+        # (lock_a, lock_b) -> list of (path, line, spell_a, spell_b)
+        self.edges: Dict[Tuple[str, str], List[Tuple[str, int, str, str]]] = {}
+
+    def applies(self, relpath: str) -> bool:
+        return not relpath.startswith("tests/")
+
+    def check(self, ctx: FileContext):
+        state = _collect(ctx)
+        if not state.locks:
+            return ()
+
+        def visit(node, class_stack, held):
+            if isinstance(node, ast.ClassDef):
+                class_stack = class_stack + [node.name]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                held = []  # nested def body does not run under the with
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    spelling = dotted_name(item.context_expr)
+                    if spelling is None:
+                        continue
+                    lock = state.lock_id(class_stack, spelling)
+                    if lock is None:
+                        continue
+                    for held_lock, held_spelling in held:
+                        if held_lock != lock:
+                            self.edges.setdefault(
+                                (held_lock, lock), []
+                            ).append((
+                                ctx.relpath, item.context_expr.lineno,
+                                held_spelling, spelling,
+                            ))
+                    acquired.append((lock, spelling))
+                held = held + acquired
+            for child in ast.iter_child_nodes(node):
+                visit(child, class_stack, held)
+
+        visit(ctx.tree, [], [])
+        return ()
+
+    def finalize(self):
+        # Tarjan SCC over the acquisition graph; any edge inside a
+        # multi-node SCC lies on a cycle.
+        graph: Dict[str, Set[str]] = {}
+        for a, b in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        comp: Dict[str, int] = {}
+        counter = [0, 0]
+
+        def strongconnect(v):
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in graph[v]:
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp[w] = counter[1]
+                    if w == v:
+                        break
+                counter[1] += 1
+
+        for v in graph:
+            if v not in index:
+                strongconnect(v)
+
+        findings = []
+        for (a, b), sites in sorted(self.edges.items()):
+            if comp.get(a) != comp.get(b):
+                continue
+            reverse_sites = self.edges.get((b, a), [])
+            where = ", ".join(
+                f"{p}:{ln}" for p, ln, *_ in reverse_sites[:3]
+            ) or "elsewhere in the cycle"
+            for path, lineno, _, spelling in sites:
+                findings.append(self.finding(
+                    path, lineno,
+                    f"lock '{spelling}' ({b}) acquired while holding "
+                    f"{a}, but a conflicting acquisition order exists "
+                    f"({where}) — ABBA deadlock hazard",
+                ))
+        return findings
+
+
+def _blocking_functions(ctx: FileContext, state: _FileLockState):
+    """One level of same-file call depth: function name -> the blocking
+    reason lexically inside its body.  ``with lock: self._build()`` is
+    just as stalled as ``with lock: subprocess.run(...)`` — the lexical
+    check alone would miss every blocking call hidden one ``def`` away."""
+    blocking: Dict[str, str] = {}
+
+    def visit(node, class_stack):
+        if isinstance(node, ast.ClassDef):
+            class_stack = class_stack + [node.name]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    msg = _blocking_message(sub, state, class_stack)
+                    if msg is not None:
+                        blocking.setdefault(
+                            node.name,
+                            msg.replace(" while holding a lock", ""),
+                        )
+                        break
+        for child in ast.iter_child_nodes(node):
+            visit(child, class_stack)
+
+    visit(ctx.tree, [])
+    return blocking
+
+
+@rule
+class LockBlockingRule(Rule):
+    id = "lock-blocking"
+    severity = "error"
+    doc = ("no call that can block indefinitely (or compile for seconds) "
+           "while a lock is held")
+
+    def applies(self, relpath: str) -> bool:
+        return not relpath.startswith("tests/")
+
+    def check(self, ctx: FileContext):
+        state = _collect(ctx)
+        if not state.locks:
+            return ()
+        blocking_fns = _blocking_functions(ctx, state)
+        findings = []
+
+        def visit(node, class_stack, held_depth):
+            if isinstance(node, ast.ClassDef):
+                class_stack = class_stack + [node.name]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                held_depth = 0
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    spelling = dotted_name(item.context_expr)
+                    if spelling is not None and state.lock_id(
+                            class_stack, spelling) is not None:
+                        held_depth += 1
+            if held_depth > 0 and isinstance(node, ast.Call):
+                msg = _blocking_message(node, state, class_stack)
+                if msg is None:
+                    # one level of same-file indirection: f() where f's
+                    # body contains a blocking call
+                    callee = dotted_name(node.func)
+                    if callee is not None:
+                        bare = callee.split(".")[-1]
+                        if bare in blocking_fns and (
+                            callee == bare or callee == f"self.{bare}"
+                        ):
+                            msg = (
+                                f"{bare}() runs {blocking_fns[bare]} — "
+                                "called while holding a lock"
+                            )
+                if msg is not None:
+                    findings.append(self.finding(ctx, node, msg))
+            for child in ast.iter_child_nodes(node):
+                visit(child, class_stack, held_depth)
+
+        visit(ctx.tree, [], 0)
+        return findings

@@ -1,0 +1,63 @@
+"""``raw-jit`` — migrated from ``ci/lint_no_raw_jit.py``.
+
+Same scope and diagnostics (the script is now a thin shim over this
+rule): the execution engine owns compilation for the inference hot
+paths — ``engine.function(...)`` routes programs through the in-memory
+LRU and the persistent on-disk executable cache, records compile
+metrics, and applies donation uniformly.  A bare ``jax.jit`` (or a
+``from jax import jit`` alias) in ``transformers/``, ``serving/``, or
+``udf/`` silently opts out of all of that.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+
+#: packages (under sparkdl_tpu/) whose compilation must go through the
+#: engine; grow this list as more layers migrate to engine.function.
+CHECKED_PACKAGES = ("transformers/", "serving/", "udf/")
+
+_FIX = (
+    "route compilation through the execution engine "
+    "(sparkdl_tpu.engine: engine.function(...) / ExecutionEngine.program) "
+    "so it hits the persistent executable cache"
+)
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
+
+
+@rule
+class RawJitRule(Rule):
+    id = "raw-jit"
+    severity = "error"
+    doc = ("hot-path packages compile via engine.function, never bare "
+           "jax.jit")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith(CHECKED_PACKAGES)
+
+    def check(self, ctx: FileContext):
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if _is_jax_jit(node):
+                findings.append(self.finding(
+                    ctx, node, f"bare jax.jit — {_FIX}"
+                ))
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "jit":
+                        shown = alias.asname or alias.name
+                        findings.append(self.finding(
+                            ctx, node,
+                            f"'from jax import jit' (as {shown!r}) — {_FIX}",
+                        ))
+        return findings

@@ -1,0 +1,127 @@
+"""``recompile-hazard`` — engine programs must have stable cache keys.
+
+The persistent compile cache (PR 5) keys executables on
+``fingerprint + input shapes``.  Two ways to silently defeat it:
+
+1. **Anonymous per-call programs.**  ``engine.function(lambda x: ...)``
+   without a ``fingerprint=`` kwarg gets an ``anon:<n>`` fingerprint.
+   At module scope that's one stable program per process — tolerable.
+   Inside a function or loop it mints a *new* cache key on every call:
+   nothing ever hits the disk cache, every invocation recompiles, and
+   the cache directory grows without bound.  Error.
+
+2. **Python-scalar arguments.**  Calling an engine-wrapped function
+   with a bare Python ``int``/``float``/``bool`` literal traces the
+   scalar as a constant: every distinct value is a distinct program.
+   Pass it as an array (shape-stable) or bake it into the fingerprint.
+   Warning — sometimes the value really is a one-off constant — but it
+   still fails CI unless suppressed or baselined, because the failure
+   mode (one compile per distinct batch size) is exactly the stall the
+   engine exists to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name, is_engine_receiver, keyword, target_name
+
+
+@rule
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    severity = "error"
+    doc = ("engine programs need stable fingerprints; anonymous per-call "
+           "wrapping and Python-scalar args explode the compile-cache key "
+           "space")
+
+    def applies(self, relpath: str) -> bool:
+        return not relpath.startswith("tests/")
+
+    def check(self, ctx: FileContext):
+        findings = []
+        # spellings of engine-wrapped callables (for the scalar-arg check)
+        wrapped: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                if is_engine_receiver(node.value.func):
+                    for tgt in node.targets:
+                        spelling = target_name(tgt)
+                        if spelling is not None:
+                            wrapped.add(spelling)
+
+        def visit(node, in_function: bool, local_defs: Set[str]):
+            enters_function = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+            if enters_function and not isinstance(node, ast.Lambda):
+                # defs nested inside this function close over its locals
+                local_defs = local_defs | {
+                    c.name for c in node.body
+                    if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+            if isinstance(node, ast.Call):
+                self._check_wrap_site(
+                    ctx, node, in_function, local_defs, findings
+                )
+                self._check_scalar_args(ctx, node, wrapped, findings)
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_function or enters_function, local_defs)
+
+        visit(ctx.tree, False, set())
+        return findings
+
+    def _check_wrap_site(self, ctx, call: ast.Call, in_function: bool,
+                         local_defs: Set[str], findings) -> None:
+        if not is_engine_receiver(call.func):
+            return
+        fp = keyword(call, "fingerprint")
+        has_fp = fp is not None and not (
+            isinstance(fp, ast.Constant) and fp.value is None
+        )
+        if has_fp or not call.args:
+            return
+        fn_arg = call.args[0]
+        anonymous = isinstance(fn_arg, ast.Lambda)
+        if not anonymous and isinstance(fn_arg, ast.Name) and in_function:
+            # a locally-defined closure wrapped without a fingerprint is
+            # just as anonymous as a lambda
+            anonymous = fn_arg.id in local_defs
+        if anonymous and in_function:
+            findings.append(self.finding(
+                ctx, call,
+                "anonymous engine program inside a function — each call "
+                "mints a fresh 'anon:<n>' cache key, so nothing ever hits "
+                "the persistent compile cache; pass a stable "
+                "fingerprint=...",
+            ))
+        elif anonymous:
+            findings.append(self.finding(
+                ctx, call,
+                "engine program wrapped without fingerprint= — it gets an "
+                "anonymous cache key and never lands in the persistent "
+                "compile cache across processes; pass a stable "
+                "fingerprint=...",
+                severity="warning",
+            ))
+
+    def _check_scalar_args(self, ctx, call: ast.Call, wrapped: Set[str],
+                           findings) -> None:
+        spelling = dotted_name(call.func)
+        if spelling is None or spelling not in wrapped:
+            return
+        for arg in call.args:
+            if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, (int, float, bool)
+            ) and not isinstance(arg.value, str):
+                findings.append(self.finding(
+                    ctx, arg,
+                    f"Python scalar {arg.value!r} passed to an "
+                    "engine-wrapped callable — it traces as a constant, so "
+                    "every distinct value compiles a distinct program; "
+                    "pass an array or fold it into the fingerprint",
+                    severity="warning",
+                ))

@@ -1,0 +1,83 @@
+"""``sleep-retry`` — migrated from ``ci/lint_no_sleep_retry.py``.
+
+Same semantics and diagnostic as the original single-rule script (the
+script is now a thin shim over this rule): any ``time.sleep`` /
+aliased ``sleep`` call lexically inside a ``for``/``while`` body,
+outside ``resilience/`` (the sanctioned home of backoff), is an ad-hoc
+retry loop — untyped, unmetered, untestable.  Nested ``def``/``lambda``
+bodies reset the loop context: they run when called, not per iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+
+MESSAGE = (
+    "time.sleep inside a loop — use sparkdl_tpu.resilience.RetryPolicy "
+    "(typed, metered, deterministic backoff) instead of an ad-hoc retry loop"
+)
+
+
+def _collect_aliases(tree: ast.AST):
+    time_aliases, sleep_aliases = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    sleep_aliases.add(a.asname or "sleep")
+    return time_aliases, sleep_aliases
+
+
+def _names_sleep(call: ast.Call, time_aliases, sleep_aliases) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "sleep":
+        if isinstance(fn.value, ast.Name) and fn.value.id in time_aliases:
+            return True
+    if isinstance(fn, ast.Name) and fn.id in sleep_aliases:
+        return True
+    return False
+
+
+@rule
+class SleepRetryRule(Rule):
+    id = "sleep-retry"
+    severity = "error"
+    doc = ("no ad-hoc time.sleep retry loops outside resilience/ "
+           "(RetryPolicy owns backoff)")
+
+    def applies(self, relpath: str) -> bool:
+        return not relpath.startswith(("resilience/", "tests/"))
+
+    def check(self, ctx: FileContext):
+        time_aliases, sleep_aliases = _collect_aliases(ctx.tree)
+        if not time_aliases and not sleep_aliases:
+            return ()
+        findings = []
+
+        def visit(node: ast.AST, in_loop: bool):
+            for child in ast.iter_child_nodes(node):
+                child_in_loop = in_loop or isinstance(
+                    node, (ast.For, ast.While, ast.AsyncFor)
+                )
+                if (
+                    child_in_loop
+                    and isinstance(child, ast.Call)
+                    and _names_sleep(child, time_aliases, sleep_aliases)
+                ):
+                    findings.append(self.finding(ctx, child, MESSAGE))
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    visit(child, False)
+                else:
+                    visit(child, child_in_loop)
+
+        visit(ctx.tree, False)
+        return findings

@@ -38,6 +38,12 @@ class ProgramCache:
 
     def __init__(self, maxsize: int = 32, compile_counter=None):
         self._lock = threading.Lock()
+        # single-flight: serving key -> Event set when its resolve (which
+        # may AOT-compile for seconds) finishes.  Compilation runs OUTSIDE
+        # self._lock so stats()/status() and other endpoints never stall
+        # behind a cold bucket; the event keeps concurrent requests for
+        # the SAME key from compiling the same program N times.
+        self._inflight: Dict[Tuple, threading.Event] = {}
         # serving key -> {"callable", "engine_key", "source", "seconds"}
         self._programs = LRUCache(maxsize)
         self._compile_counter = compile_counter
@@ -73,18 +79,21 @@ class ProgramCache:
         makes the slot's executable eligible for the persistent cache.
         """
         key = self._key(model_id, bucket, item_shape, dtype)
-        with self._lock:
-            hit = self._programs.get(key)
-            if hit is not None:
-                return hit["callable"]
-            # evict the LRU slot from BOTH maps before resolving a new
-            # program, so the engine cannot hold an executable the
-            # serving-level stats no longer admit to
-            while len(self._programs) >= self._programs.maxsize:
-                oldest = next(iter(self._programs))
-                self._engine.evict(self._programs[oldest]["engine_key"])
-                del self._programs[oldest]
+        # claim the key (or wait for whoever holds it), then resolve
+        # outside the lock — an XLA compile takes seconds and must not
+        # block stats()/evict_model()/other buckets behind self._lock
+        while True:
+            with self._lock:
+                hit = self._programs.get(key)
+                if hit is not None:
+                    return hit["callable"]
+                waiter = self._inflight.get(key)
+                if waiter is None:
+                    self._inflight[key] = threading.Event()
+                    break
+            waiter.wait()
 
+        try:
             spec = jax.ShapeDtypeStruct(
                 (int(bucket), *(int(d) for d in item_shape)), np.dtype(dtype)
             )
@@ -104,13 +113,28 @@ class ProgramCache:
                     self._compile_counter.add(1)
             elif handle.source == "disk":
                 metrics.counter("serving.cache_load").add(1)
-            self._programs[key] = {
-                "callable": handle.callable,
-                "engine_key": handle.key,
-                "source": handle.source,
-                "seconds": seconds,
-            }
+            with self._lock:
+                # evict the LRU slot from BOTH maps before admitting the
+                # new program, so the engine cannot hold an executable the
+                # serving-level stats no longer admit to
+                while len(self._programs) >= self._programs.maxsize:
+                    oldest = next(iter(self._programs))
+                    self._engine.evict(self._programs[oldest]["engine_key"])
+                    del self._programs[oldest]
+                self._programs[key] = {
+                    "callable": handle.callable,
+                    "engine_key": handle.key,
+                    "source": handle.source,
+                    "seconds": seconds,
+                }
             return handle.callable
+        finally:
+            # wake waiters even on failure — they re-enter the claim loop
+            # and one of them becomes the new resolver
+            with self._lock:
+                event = self._inflight.pop(key, None)
+            if event is not None:
+                event.set()
 
     def warmup(
         self,
@@ -141,7 +165,10 @@ class ProgramCache:
                 )
                 source = entry["source"] if entry else "memory"
             x = np.zeros((int(b), *item_shape), dtype=np.dtype(dtype))
-            jax.block_until_ready(fn(x))
+            # warmup WANTS to wait: the contract is "no steady-state
+            # request compiles at request time", so block here, off the
+            # request path
+            jax.block_until_ready(fn(x))  # sparkdl: disable=host-sync
             report[int(b)] = {
                 "source": source,
                 "seconds": round(time.perf_counter() - start, 4),

@@ -206,6 +206,7 @@ def _device_resize_timed(
     to_resize = [s for s in groups if s[0] != height or s[1] != width]
     use_host = len(to_resize) > _MAX_DEVICE_RESIZE_SHAPES
 
+    device_groups: List[Tuple[List[int], np.ndarray]] = []
     for shape, idxs in groups.items():
         if shape[0] == height and shape[1] == width:
             for i in idxs:
@@ -243,10 +244,33 @@ def _device_resize_timed(
                 fingerprint=f"builtin.resize:{height}x{width}:bilinear",
                 name=f"device_resize_{height}x{width}",
             )
-        batch = np.stack([np.asarray(images[i], dtype=np.float32) for i in idxs])
-        resized = np.asarray(_resize_cache[key](batch))
-        for j, i in enumerate(idxs):
-            out[i] = resized[j]
+        batch = np.stack(
+            [np.asarray(images[i], dtype=np.float32) for i in idxs]
+        )
+        device_groups.append((idxs, batch))
+
+    if device_groups:
+        # dispatch EVERY shape group before fetching any: a per-group
+        # host sync would serialize the groups (each resize waits for the
+        # previous fetch); the window keeps them in flight together and
+        # fetches as they land
+        from sparkdl_tpu.engine import DispatchWindow
+
+        resize_fn = _resize_cache[(height, width)]
+
+        def _scatter(host: np.ndarray, done_idxs: List[int]) -> None:
+            for j, i in enumerate(done_idxs):
+                out[i] = host[j]
+
+        window = DispatchWindow(depth=0 if _serial_inference() else None)
+        try:
+            for idxs, batch in device_groups:
+                for host, done in window.submit(resize_fn(batch), meta=idxs):
+                    _scatter(host, done)
+            for host, done in window.drain():
+                _scatter(host, done)
+        finally:
+            window.abandon()
     return np.stack(out)  # type: ignore[arg-type]
 
 

@@ -1,10 +1,12 @@
-"""The CI lint gates (``ci/lint_no_sleep_retry.py``,
-``ci/lint_metric_names.py``, ``ci/lint_no_raw_jit.py``): the repo
-itself stays clean, and each lint actually catches what it claims to.
-Running them here puts the gates in tier-1 — a hand-rolled retry loop,
-an off-convention metric name, or a bare ``jax.jit`` on a hot path
-fails the suite, not just the CI workflow step."""
+"""The CI static-analysis gate: one ``ci/sparkdl_check`` run covers the
+whole repo (every rule, one AST parse per file), and each legacy lint
+shim (``ci/lint_no_sleep_retry.py``, ``ci/lint_metric_names.py``,
+``ci/lint_no_raw_jit.py``) still catches what it claims to.  Running
+them here puts the gates in tier-1 — a blocking call under a lock, an
+implicit device→host sync on a hot path, a hand-rolled retry loop, or a
+bare ``jax.jit`` fails the suite, not just the CI workflow step."""
 
+import json
 import os
 import subprocess
 import sys
@@ -25,11 +27,23 @@ def run_lint(root, lint=_LINT):
     )
 
 
-def test_repo_has_no_ad_hoc_sleep_retry_loops():
-    proc = run_lint(_REPO)
-    assert proc.returncode == 0, (
-        f"sleep-retry lint failed:\n{proc.stdout}{proc.stderr}"
+def test_repo_passes_sparkdl_check():
+    """THE tier-1 static-analysis gate: all nine rules over
+    ``sparkdl_tpu/`` in one framework run, failing on any finding that
+    is neither suppressed inline nor grandfathered in the baseline (and
+    on stale baseline entries)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ci.sparkdl_check", "sparkdl_tpu/",
+         "--format", "json"],
+        capture_output=True, text=True, timeout=120, cwd=_REPO,
     )
+    assert proc.returncode == 0, (
+        f"sparkdl_check failed:\n{proc.stdout}{proc.stderr}"
+    )
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == []
+    assert doc["stale_baseline"] == []
+    assert len(doc["rules"]) >= 8  # 5 new analyzers + 3 migrated lints
 
 
 def test_lint_flags_planted_violation(tmp_path):
@@ -79,13 +93,6 @@ def test_lint_flags_planted_violation(tmp_path):
     assert "RetryPolicy" in proc.stdout  # the diagnostic names the fix
 
 
-def test_repo_metric_names_follow_convention():
-    proc = run_lint(_REPO, lint=_NAME_LINT)
-    assert proc.returncode == 0, (
-        f"metric-name lint failed:\n{proc.stdout}{proc.stderr}"
-    )
-
-
 def test_metric_name_lint_flags_planted_violations(tmp_path):
     pkg = tmp_path / "sparkdl_tpu"
     pkg.mkdir()
@@ -120,13 +127,6 @@ def test_metric_name_lint_flags_planted_violations(tmp_path):
     assert out.count("bad.py:") == 4
     assert "ok.py" not in out
     assert "subsystem prefix" in out  # the diagnostic names the fix
-
-
-def test_repo_hot_paths_have_no_raw_jit():
-    proc = run_lint(_REPO, lint=_JIT_LINT)
-    assert proc.returncode == 0, (
-        f"raw-jit lint failed:\n{proc.stdout}{proc.stderr}"
-    )
 
 
 def test_raw_jit_lint_flags_planted_violations(tmp_path):

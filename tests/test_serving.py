@@ -10,6 +10,7 @@ behavior lives in ``test_fault_injection.py``.
 """
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -286,6 +287,131 @@ class TestEndpointContract:
             assert server.status()["program_cache"]["programs"] == 2
             out = server.predict(np.ones((2,), np.float32), timeout=30.0)
             np.testing.assert_allclose(out, 2.0)
+
+
+# ----------------------------------------------------------------------
+# program-cache single-flight: compiles happen OUTSIDE the cache lock
+# (regression for the lock-blocking finding sparkdl_check surfaced:
+# ProgramCache.program used to hold self._lock across a multi-second
+# XLA compile, stalling stats()/status() and every other endpoint)
+# ----------------------------------------------------------------------
+class _SlowEngineStub:
+    """Engine stand-in whose program() blocks until released, counting
+    calls — lets the test hold a 'compile' in flight deterministically."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls = []
+        self.evicted = []
+        self.cache = None
+
+    def program(self, forward, specs, fingerprint=None, donate=False,
+                name=None):
+        self.calls.append(name)
+        if not self.release.wait(timeout=30.0):
+            raise TimeoutError("slow-compile stub never released")
+
+        class Handle:
+            callable = staticmethod(forward)
+            source = "compile"
+            key = f"stub:{name}"
+
+        return Handle()
+
+    def evict(self, key):
+        self.evicted.append(key)
+
+
+class TestProgramCacheSingleFlight:
+    def _cache(self, maxsize=4):
+        from sparkdl_tpu.serving.cache import ProgramCache
+
+        cache = ProgramCache(maxsize=maxsize)
+        stub = _SlowEngineStub()
+        cache._engine = stub
+        return cache, stub
+
+    def test_stats_not_blocked_while_a_compile_is_in_flight(self):
+        cache, stub = self._cache()
+        t = threading.Thread(
+            target=cache.program,
+            args=("m", lambda x: x, 4, (2,), np.float32),
+            daemon=True,
+        )
+        t.start()
+        # wait until the resolve has actually claimed the key
+        deadline = time.monotonic() + 5.0
+        while not stub.calls and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert stub.calls, "stub compile never started"
+        # the health-probe path must answer while the compile hangs
+        start = time.monotonic()
+        stats = cache.stats()
+        elapsed = time.monotonic() - start
+        assert elapsed < 1.0, f"stats() stalled {elapsed:.2f}s behind compile"
+        assert stats["programs"] == 0  # not admitted yet
+        stub.release.set()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert cache.stats()["programs"] == 1
+
+    def test_same_key_callers_share_one_compile(self):
+        cache, stub = self._cache()
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(
+                    cache.program("m", lambda x: x, 4, (2,), np.float32)
+                ),
+                daemon=True,
+            )
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5.0
+        while not stub.calls and time.monotonic() < deadline:
+            time.sleep(0.005)
+        stub.release.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert len(results) == 4
+        assert len(stub.calls) == 1, (
+            f"single-flight broken: {len(stub.calls)} compiles for one key"
+        )
+
+    def test_distinct_keys_resolve_concurrently(self):
+        # a cold bucket must not serialize other buckets behind it
+        cache, stub = self._cache()
+        stub.release.set()  # compiles return immediately
+        cache.program("m", lambda x: x, 4, (2,), np.float32)
+        stub.release.clear()
+        slow = threading.Thread(
+            target=cache.program,
+            args=("m", lambda x: x, 8, (2,), np.float32),
+            daemon=True,
+        )
+        slow.start()
+        deadline = time.monotonic() + 5.0
+        while len(stub.calls) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        # the already-cached bucket serves instantly despite the in-flight
+        # compile of bucket 8
+        start = time.monotonic()
+        fn = cache.program("m", lambda x: x, 4, (2,), np.float32)
+        assert time.monotonic() - start < 1.0
+        assert fn is not None
+        stub.release.set()
+        slow.join(timeout=10.0)
+
+    def test_eviction_contract_preserved(self):
+        cache, stub = self._cache(maxsize=2)
+        stub.release.set()
+        for bucket in (1, 2, 4):
+            cache.program("m", lambda x: x, bucket, (2,), np.float32)
+        stats = cache.stats()
+        assert stats["programs"] == 2
+        assert len(stub.evicted) == 1  # LRU slot left BOTH maps
 
 
 # ----------------------------------------------------------------------

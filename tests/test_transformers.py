@@ -515,3 +515,56 @@ def test_lru_cache_eviction_is_fifo_without_touches():
     for i, k in enumerate("abcde"):
         c[k] = i
     assert list(c) == ["c", "d", "e"]  # a then b evicted, in order
+
+
+# ---------------------------------------------------------------------------
+# mixed-shape device resize through the dispatch window
+# (regression for the host-sync finding sparkdl_check surfaced:
+# _device_resize_timed used to np.asarray each shape group's result
+# before dispatching the next, serializing the groups)
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_shape_resize_correct_per_image_through_window():
+    from sparkdl_tpu.transformers.utils import device_resize as _resize_images
+
+    rng = np.random.default_rng(7)
+    # two distinct source shapes (= _MAX_DEVICE_RESIZE_SHAPES, so the
+    # device path runs) plus images already at target size, interleaved
+    # so scatter order matters
+    shapes = [(8, 6, 3), (4, 4, 3), (6, 8, 3), (8, 6, 3), (4, 4, 3),
+              (6, 8, 3), (8, 6, 3)]
+    images = [rng.uniform(0, 255, s).astype(np.float32) for s in shapes]
+
+    out = _resize_images(images, (4, 4))
+    assert out.shape == (len(images), 4, 4, 3)
+
+    for i, img in enumerate(images):
+        if img.shape[:2] == (4, 4):
+            want = img
+        else:
+            want = np.asarray(jax.image.resize(
+                jnp.asarray(img)[None], (1, 4, 4, 3), method="bilinear"
+            ))[0]
+        np.testing.assert_allclose(
+            out[i], want, rtol=1e-5, atol=1e-4,
+            err_msg=f"row {i} (source shape {img.shape}) scrambled or wrong",
+        )
+
+
+def test_mixed_shape_resize_window_survives_serial_mode(monkeypatch):
+    # SPARKDL_SERIAL_INFERENCE=1 collapses the window to depth 0 —
+    # results must be identical either way
+    from sparkdl_tpu.transformers import utils as tutils
+
+    monkeypatch.setenv("SPARKDL_SERIAL_INFERENCE", "1")
+    rng = np.random.default_rng(11)
+    images = [rng.uniform(0, 255, (8, 6, 3)).astype(np.float32),
+              rng.uniform(0, 255, (6, 8, 3)).astype(np.float32)]
+    out = tutils.device_resize(images, (4, 4))
+    assert out.shape == (2, 4, 4, 3)
+    for i, img in enumerate(images):
+        want = np.asarray(jax.image.resize(
+            jnp.asarray(img)[None], (1, 4, 4, 3), method="bilinear"
+        ))[0]
+        np.testing.assert_allclose(out[i], want, rtol=1e-5, atol=1e-4)
