@@ -1,0 +1,250 @@
+"""Streaming inference: sustained rate, record latency, lag, recovery time.
+
+End-to-end over :class:`sparkdl_tpu.streaming.StreamRunner`: a generator
+thread appends records to a :class:`QueueSource` at a fixed sustained
+rate while the runner micro-batches them through a small jitted MLP and
+commits each epoch to a :class:`JsonlSink` (the full exactly-once path —
+payload, sink, marker, fsync).  Reports:
+
+- **p50/p99 end-to-end record latency** (enqueue → commit, from the
+  ``streaming.record_latency_ms`` histogram);
+- **consumer lag over time** (periodic samples of the source backlog —
+  a drifting lag means the runner can't hold the offered rate);
+- **recovery time** after an injected mid-run crash: the run is killed
+  at a ``streaming.commit`` fault site (subprocess, ``os._exit(9)``),
+  restarted, and the time from restart to first fresh commit — replay
+  cost included — is the recovery number.
+
+Prints one JSON line; ``vs_baseline`` is null (record-only config).
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_streaming.py --seconds 3
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+FEATURES = 64
+HIDDEN = 256
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the crash-recovery trial runs in a subprocess (the fault plan kills
+#: with os._exit); it commits a few epochs, then dies at a commit marker
+_CRASH_WORKER = """
+import json, os, sys, threading, time
+os.environ.setdefault("KERAS_BACKEND", "jax")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import numpy as np
+from sparkdl_tpu.streaming import FileTailSource, JsonlSink, StreamRunner, StreamConfig
+workdir = {workdir!r}
+source = FileTailSource(os.path.join(workdir, "in.jsonl"))
+sink = JsonlSink(os.path.join(workdir, "out.jsonl"))
+runner = StreamRunner(
+    source, lambda xs: [x["x"] for x in xs], sink,
+    os.path.join(workdir, "log"),
+    config=StreamConfig(max_batch={max_batch}, max_wait_ms=5.0,
+                        poll_batch={max_batch}, poll_interval_ms=2.0),
+    pack=False,
+)
+summary = runner.run(idle_timeout_s=1.0)
+print("SUMMARY " + json.dumps(summary))
+"""
+
+
+def _measure_recovery(max_batch: int) -> dict:
+    """Kill a run between payload and marker, restart, and time the
+    restart's recover-and-resume."""
+    from sparkdl_tpu.streaming import CommitLog
+
+    workdir = tempfile.mkdtemp(prefix="bench-streaming-")
+    with open(os.path.join(workdir, "in.jsonl"), "w") as fh:
+        for i in range(20 * max_batch):
+            fh.write(json.dumps({"x": i}) + "\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SPARKDL_FAULT_PLAN"] = json.dumps(
+        [{"site": "streaming.commit", "kill": True, "at": 4}]
+    )
+    script = _CRASH_WORKER.format(
+        repo=_REPO, workdir=workdir, max_batch=max_batch
+    )
+    killed = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=180,
+    )
+    env.pop("SPARKDL_FAULT_PLAN")
+    log = CommitLog(os.path.join(workdir, "log"))
+    committed_before = log.last_committed() or 0
+    t0 = time.perf_counter()
+    restarted = subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=180,
+    )
+    recovery_s = time.perf_counter() - t0
+    summary = None
+    for line in restarted.stdout.splitlines():
+        if line.startswith("SUMMARY "):
+            summary = json.loads(line[len("SUMMARY "):])
+    return {
+        "crash_rc": killed.returncode,
+        "restart_rc": restarted.returncode,
+        "epochs_before_crash": committed_before,
+        "restart_summary": summary,
+        # wall time of the whole restart: interpreter + recover
+        # (pending-epoch replay) + finishing the stream
+        "restart_wall_s": round(recovery_s, 3),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0,
+                    help="sustained-rate measurement window")
+    ap.add_argument("--rate", type=float, default=2000.0,
+                    help="offered records/sec from the generator")
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--skip-recovery", action="store_true",
+                    help="skip the subprocess crash-recovery trial")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="append a JSONL span trace of the measured run "
+                    "to PATH (obs subsystem)")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.obs import JsonlTraceSink, tracer
+    from sparkdl_tpu.streaming import (
+        CallbackSink,
+        QueueSource,
+        StreamConfig,
+        StreamRunner,
+    )
+    from sparkdl_tpu.utils.metrics import metrics
+
+    trace_sink = None
+    if args.trace_out:
+        trace_sink = JsonlTraceSink(path=args.trace_out)
+        tracer.enable(trace_sink)
+
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(FEATURES, HIDDEN).astype(np.float32) * 0.05
+    w2 = rng.randn(HIDDEN, 8).astype(np.float32) * 0.05
+
+    @jax.jit
+    def forward(x):
+        return jnp.maximum(x @ w1, 0.0) @ w2
+
+    metrics.reset()
+    source = QueueSource()
+    committed = [0]
+    sink = CallbackSink(
+        lambda epoch, recs: committed.__setitem__(0, committed[0] + len(recs))
+    )
+    logdir = tempfile.mkdtemp(prefix="bench-streaming-log-")
+    runner = StreamRunner(
+        source,
+        lambda x: forward(np.asarray(x, dtype=np.float32)),
+        sink,
+        logdir,
+        config=StreamConfig(
+            max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+            poll_batch=args.max_batch,
+            poll_interval_ms=1.0,
+        ),
+        # outputs are committed through the JSON payload; keep them small
+        encode=lambda rec, out: {"offset": int(rec.offset),
+                                 "y0": float(out[0])},
+        pack=False,
+    )
+
+    stop = threading.Event()
+    produced = [0]
+    lag_samples = []
+    row = rng.rand(FEATURES).astype(np.float32)
+
+    def generate():
+        # fixed-rate generator: sleep in small quanta, top the queue up
+        # to the ideal produced-so-far count each tick
+        t0 = time.perf_counter()
+        next_sample = 0.0
+        while not stop.is_set():
+            elapsed = time.perf_counter() - t0
+            target = int(elapsed * args.rate)
+            now_ms = time.time() * 1000.0
+            while produced[0] < target:
+                source.put(row, event_time_ms=now_ms)
+                produced[0] += 1
+            if elapsed >= next_sample:
+                lag_samples.append(
+                    {"t_s": round(elapsed, 2),
+                     "lag_records": source.backlog()}
+                )
+                next_sample += max(args.seconds / 10.0, 0.1)
+            stop.wait(0.002)
+        source.end()
+
+    gen = threading.Thread(target=generate, name="bench-stream-generator")
+    gen.start()
+    t0 = time.perf_counter()
+    timer = threading.Timer(args.seconds, stop.set)
+    timer.start()
+    summary = runner.run()  # returns when the generator ends the source
+    elapsed = time.perf_counter() - t0
+    gen.join()
+    timer.cancel()
+
+    snap = metrics.snapshot(prefix="streaming.")
+    if trace_sink is not None:
+        trace_sink.flush()
+    recovery = None if args.skip_recovery else _measure_recovery(
+        args.max_batch
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "streaming sustained commit rate "
+                f"(offered {args.rate:.0f} rec/s)",
+                "value": round(committed[0] / elapsed, 1),
+                "unit": "records/sec",
+                "records_committed": committed[0],
+                "records_offered": produced[0],
+                "epochs": summary["epochs"],
+                "p50_record_latency_ms": round(
+                    snap.get("streaming.record_latency_ms.p50", 0.0), 3
+                ),
+                "p99_record_latency_ms": round(
+                    snap.get("streaming.record_latency_ms.p99", 0.0), 3
+                ),
+                "final_watermark_lag_ms": round(
+                    snap.get("streaming.watermark_lag_ms", 0.0), 1
+                ),
+                "lag_over_time": lag_samples,
+                "recovery": recovery,
+                "seconds": args.seconds,
+                "max_batch": args.max_batch,
+                "max_wait_ms": args.max_wait_ms,
+                "vs_baseline": None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

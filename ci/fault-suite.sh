@@ -17,7 +17,11 @@ TRACE_OUT="$(mktemp -t fault-suite-trace.XXXXXX.jsonl)"
 trap 'rm -f "$TRACE_OUT"' EXIT
 export SPARKDL_TRACE_OUT="$TRACE_OUT"
 
+# test_streaming.py is the streaming fault scenario: FaultPlan kills at
+# streaming.poll / streaming.sink / streaming.commit, restart, and the
+# sink record set must equal the source record set (exactly-once)
 if ! python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
+  tests/test_streaming.py \
   -q -m 'not slow' -p no:cacheprovider; then
   echo "--- captured span trace (last 50 spans, $TRACE_OUT) ---" >&2
   tail -n 50 "$TRACE_OUT" >&2 || true

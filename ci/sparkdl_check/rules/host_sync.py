@@ -34,7 +34,8 @@ from typing import Set
 from ci.sparkdl_check.core import FileContext, Rule, rule
 from ci.sparkdl_check.rules._util import dotted_name, is_engine_receiver, target_name
 
-_HOT_PACKAGES = ("transformers/", "serving/", "engine/", "data/")
+_HOT_PACKAGES = ("transformers/", "serving/", "engine/", "data/",
+                 "streaming/")
 _SANCTIONED = ("engine/executor.py",)
 _COERCIONS = {"float", "int", "bool"}
 _NP_COERCIONS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}

@@ -20,6 +20,7 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: subsystem earns a namespace, not to whitelist a one-off name.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
+    "streaming",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

@@ -1,0 +1,147 @@
+"""Streaming scoring: tail a growing file, score online, commit exactly-once.
+
+The batch stack scores fixed DataFrames; :mod:`sparkdl_tpu.streaming`
+scores *unbounded* sources with exactly-once delivery.  This example
+walks the whole flow, offline-safe:
+
+1. a producer thread appends JSON events to ``events.jsonl`` — the
+   growing file a log shipper or feature bus would write;
+2. :class:`FileTailSource` tails it by byte offset, extracting event
+   times for the bounded-lateness watermark;
+3. each micro-batch is scored through a registered
+   :class:`ModelServer` endpoint (riding its admission control and
+   micro-batcher, sharing capacity with interactive traffic);
+4. scored records land in a :class:`JsonlSink` through the commit log's
+   payload-then-marker protocol — every record exactly once;
+5. mid-run the process receives **SIGTERM**: the runner flushes
+   in-flight epochs into committed state and stops cleanly
+   (``stop_reason="preempted"``), then a second runner *resumes from
+   the last committed offset* and finishes the stream.
+
+Works on the real TPU or the virtual CPU mesh:
+
+    JAX_PLATFORMS=cpu python examples/streaming_scoring.py
+"""
+
+import json
+import os
+import signal
+import tempfile
+import threading
+
+import numpy as np
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+N_EVENTS = 60
+FEATURES = 4
+
+
+def main():
+    from sparkdl_tpu import JsonlSink, StreamConfig, StreamRunner
+    from sparkdl_tpu.serving import ModelServer, ServingConfig
+    from sparkdl_tpu.streaming import FileTailSource
+
+    workdir = tempfile.mkdtemp(prefix="streaming-scoring-")
+    events_path = os.path.join(workdir, "events.jsonl")
+    scores_path = os.path.join(workdir, "scores.jsonl")
+    log_dir = os.path.join(workdir, "commit-log")
+
+    # -- 1. the producer: a log shipper appending events over time -----
+    rng = np.random.RandomState(0)
+    done_producing = threading.Event()
+
+    def produce():
+        pace = threading.Event()
+        with open(events_path, "a") as fh:
+            for i in range(N_EVENTS):
+                event = {
+                    "id": i,
+                    "f": [round(float(v), 4) for v in rng.rand(FEATURES)],
+                    "event_time_ms": 1_000.0 * i,
+                }
+                fh.write(json.dumps(event) + "\n")
+                fh.flush()
+                pace.wait(0.02)
+        done_producing.set()
+
+    producer = threading.Thread(target=produce, name="event-producer")
+    producer.start()
+
+    # -- 2/3. a registered endpoint scores the stream ------------------
+    with ModelServer(config=ServingConfig(max_batch=16)) as server:
+        server.register(
+            "scorer",
+            lambda batch: batch.sum(axis=-1),
+            item_shape=(FEATURES,),
+            compile=False,
+        )
+
+        def score(batch):
+            futures = [
+                server.submit(
+                    np.asarray(rec["f"], dtype=np.float32),
+                    model_id="scorer",
+                )
+                for rec in batch
+            ]
+            return [f.result() for f in futures]
+
+        def make_runner():
+            # a fresh tail each time: recovery seeks it to the last
+            # committed byte offset, so restarts never re-read history
+            source = FileTailSource(
+                events_path, event_time_field="event_time_ms"
+            )
+            return StreamRunner(
+                source,
+                score,
+                JsonlSink(scores_path),
+                log_dir,
+                config=StreamConfig(
+                    max_batch=8, max_wait_ms=20.0, allowed_lateness_ms=500.0
+                ),
+                pack=False,
+            )
+
+        # -- 5a. first run, preempted mid-stream by a real SIGTERM -----
+        threading.Timer(
+            0.4, os.kill, args=(os.getpid(), signal.SIGTERM)
+        ).start()
+        with make_runner() as runner:
+            first = runner.run(idle_timeout_s=10.0)
+        print(
+            f"first run: stop_reason={first['stop_reason']} "
+            f"epochs={first['epochs']} "
+            f"committed_offset={first['committed_offset']}"
+        )
+        assert first["stop_reason"] == "preempted", first
+
+        # -- 5b. restart: resume from the last committed offset --------
+        producer.join()
+        with make_runner() as runner:
+            second = runner.run(idle_timeout_s=2.0)
+        print(
+            f"resumed run: stop_reason={second['stop_reason']} "
+            f"epochs={second['epochs']} replayed={second['replayed']} "
+            f"watermark_ms={second['watermark_ms']}"
+        )
+
+    # -- 4. exactly-once: every event scored, none twice ---------------
+    rows = JsonlSink(scores_path).read_all()
+    ids = sorted(row["input"]["id"] for row in rows)
+    assert ids == list(range(N_EVENTS)), (
+        f"delivery broken: {len(ids)} rows, {len(set(ids))} unique"
+    )
+    for row in rows:
+        expected = sum(row["input"]["f"])
+        assert abs(row["output"] - expected) < 1e-4
+    print(
+        f"scored {len(rows)} events exactly once across a SIGTERM "
+        f"(sink={scores_path})"
+    )
+    print("streaming scoring OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,15 @@ _EXPORTS = {
     "DeviceUnresponsive": "sparkdl_tpu.resilience",
     "Preempted": "sparkdl_tpu.resilience",
     "FaultPlan": "sparkdl_tpu.resilience",
+    "StreamRunner": "sparkdl_tpu.streaming",
+    "StreamConfig": "sparkdl_tpu.streaming",
+    "StreamSource": "sparkdl_tpu.streaming",
+    "QueueSource": "sparkdl_tpu.streaming",
+    "FileTailSource": "sparkdl_tpu.streaming",
+    "WatermarkTracker": "sparkdl_tpu.streaming",
+    "CommitLog": "sparkdl_tpu.streaming",
+    "JsonlSink": "sparkdl_tpu.streaming",
+    "CallbackSink": "sparkdl_tpu.streaming",
     "Span": "sparkdl_tpu.obs",
     "Tracer": "sparkdl_tpu.obs",
     "tracer": "sparkdl_tpu.obs",

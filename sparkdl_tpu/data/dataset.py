@@ -71,10 +71,19 @@ class Dataset:
         iter_factory: Callable[[], Iterator],
         length: Optional[int] = None,
         name: str = "dataset",
+        unbounded: bool = False,
     ):
         self._iter_factory = iter_factory
         self._length = length
         self._name = name
+        self._unbounded = bool(unbounded)
+
+    @property
+    def unbounded(self) -> bool:
+        """True for stream-backed datasets (``from_stream``): iteration
+        may never end, so whole-stream operators (``shuffle``, cyclic
+        padding) are unavailable."""
+        return self._unbounded
 
     # ------------------------------------------------------------------
     # sources
@@ -158,6 +167,53 @@ class Dataset:
 
         return Dataset(rows, length=df.count(), name="from_dataframe")
 
+    @staticmethod
+    def from_stream(
+        source,
+        poll_batch: int = 64,
+        idle_wait_ms: float = 10.0,
+        max_records: Optional[int] = None,
+    ) -> "Dataset":
+        """Unbounded dataset over a :class:`~sparkdl_tpu.streaming.
+        sources.StreamSource`: each iteration polls the source and yields
+        record *values* as they arrive, waiting ``idle_wait_ms`` between
+        empty polls.  Iteration ends only when the source reports
+        ``finished()`` (never, for a true stream) or after
+        ``max_records`` (a bounded window onto the stream — handy for
+        tests and snapshot jobs).
+
+        The resulting dataset is :attr:`unbounded`: ``shuffle`` and
+        cyclic padding are rejected, and ``batch`` defaults to ragged
+        finals (or ``drop_remainder=True``).  For scored, exactly-once
+        consumption use :class:`~sparkdl_tpu.streaming.runner.
+        StreamRunner` instead — this operator is the read-only view.
+        """
+        import threading
+
+        def rows():
+            waiter = threading.Event()  # interruptible idle wait
+            emitted = 0
+            while True:
+                inject.fire("streaming.poll")
+                records = source.poll(poll_batch)
+                if not records:
+                    if source.finished():
+                        return
+                    waiter.wait(idle_wait_ms / 1000.0)
+                    continue
+                for rec in records:
+                    yield rec.value
+                    emitted += 1
+                    if max_records is not None and emitted >= max_records:
+                        return
+
+        return Dataset(
+            rows,
+            length=None,
+            name="from_stream",
+            unbounded=max_records is None,
+        )
+
     # ------------------------------------------------------------------
     # operators
     # ------------------------------------------------------------------
@@ -198,7 +254,8 @@ class Dataset:
                 finally:
                     _close_iter(it)
 
-            return Dataset(sequential, length=self._length, name="map")
+            return Dataset(sequential, length=self._length, name="map",
+                           unbounded=self._unbounded)
 
         window = int(buffer) if buffer is not None else 2 * int(num_workers)
         window = max(1, window)
@@ -241,7 +298,8 @@ class Dataset:
                 _close_iter(it)
                 pool.shutdown(wait=True)
 
-        return Dataset(threaded, length=self._length, name="map")
+        return Dataset(threaded, length=self._length, name="map",
+                       unbounded=self._unbounded)
 
     def shuffle(self, seed: int) -> "Dataset":
         """Seeded whole-dataset shuffle reproducing the estimators'
@@ -253,6 +311,12 @@ class Dataset:
         Materializes the upstream items per iteration (a shuffle is a
         global reorder; upstream sources here are URI/index lists, not
         decoded tensors — shuffle *before* the expensive ``map``)."""
+        if self._unbounded:
+            raise ValueError(
+                "shuffle() is a whole-dataset reorder and cannot apply "
+                "to an unbounded stream; window the stream first "
+                "(from_stream(max_records=...))"
+            )
         src = self
         state: Dict[str, Any] = {}
 
@@ -301,13 +365,15 @@ class Dataset:
         length = None
         if self._length is not None and index is not None and count:
             length = len(range(int(index), self._length, int(count)))
-        return Dataset(strided, length=length, name="shard")
+        return Dataset(strided, length=length, name="shard",
+                       unbounded=self._unbounded)
 
     def batch(
         self,
         batch_size: int,
         pad: Optional[str] = None,
         min_batches: Optional[int] = None,
+        drop_remainder: bool = False,
     ) -> "Dataset":
         """Group items into :class:`Batch` tuples of exactly ``batch_size``.
 
@@ -319,15 +385,32 @@ class Dataset:
         ``min_batches`` (with ``pad="cyclic"``) keeps emitting fully-padded
         ``n_real=0`` batches after exhaustion up to that count — the
         multi-host case where every host must run the same step count.
+
+        ``drop_remainder=True`` discards the ragged final instead — the
+        fixed-shape option for **unbounded** streams, where cyclic padding
+        is impossible (it replays from a start the stream no longer holds
+        and assumes an end that never comes).  On an unbounded dataset
+        only ``pad=None`` semantics apply, and items are NOT retained
+        after they leave their batch (a stream must run in O(batch)
+        memory, not O(stream)).
         """
         if pad not in (None, "cyclic"):
             raise ValueError(f"pad must be None or 'cyclic', got {pad!r}")
         if min_batches is not None and pad != "cyclic":
             raise ValueError("min_batches requires pad='cyclic'")
+        if drop_remainder and pad is not None:
+            raise ValueError("drop_remainder and pad are mutually exclusive")
+        if self._unbounded and pad is not None:
+            raise ValueError(
+                "pad='cyclic' assumes a finite source and cannot apply to "
+                "an unbounded stream; use pad=None (ragged final) or "
+                "drop_remainder=True"
+            )
         bs = int(batch_size)
         if bs < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         src = self
+        keep_seen = pad == "cyclic"
 
         def batched():
             it = iter(src)
@@ -337,12 +420,13 @@ class Dataset:
             try:
                 for item in it:
                     buf.append(item)
-                    seen.append(item)
+                    if keep_seen:
+                        seen.append(item)
                     if len(buf) == bs:
                         yield Batch(_pack(buf), bs)
                         emitted += 1
                         buf = []
-                if buf:
+                if buf and not drop_remainder:
                     k = len(buf)
                     if pad == "cyclic":
                         # the estimator policy: np.resize over the full
@@ -364,8 +448,12 @@ class Dataset:
 
         length = None
         if self._length is not None:
-            length = max(-(-self._length // bs), min_batches or 0)
-        return Dataset(batched, length=length, name="batch")
+            if drop_remainder:
+                length = self._length // bs
+            else:
+                length = max(-(-self._length // bs), min_batches or 0)
+        return Dataset(batched, length=length, name="batch",
+                       unbounded=self._unbounded)
 
     def prefetch(self, size: int = 2) -> "Dataset":
         """Decouple producer from consumer: a background thread runs the
@@ -400,7 +488,8 @@ class Dataset:
             finally:
                 it.close()
 
-        return Dataset(prefetched, length=self._length, name="prefetch")
+        return Dataset(prefetched, length=self._length, name="prefetch",
+                       unbounded=self._unbounded)
 
     def prefetch_to_device(
         self, place: Optional[Callable[[Any], Any]] = None
@@ -433,7 +522,8 @@ class Dataset:
             finally:
                 _close_iter(it)
 
-        return Dataset(doubled, length=self._length, name="prefetch_to_device")
+        return Dataset(doubled, length=self._length,
+                       name="prefetch_to_device", unbounded=self._unbounded)
 
     # ------------------------------------------------------------------
     def __iter__(self) -> Iterator:

@@ -61,6 +61,7 @@ class AdmissionQueue:
         self._items: "deque[Request]" = deque()
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
         self._closed = False
         self._depth_gauge = depth_gauge
         self._shed_counter = shed_counter
@@ -88,6 +89,37 @@ class AdmissionQueue:
             self._items.append(request)
             self._set_depth_locked()
             self._not_empty.notify()
+
+    def offer_wait(
+        self,
+        request: Request,
+        timeout_s: Optional[float] = None,
+    ) -> bool:
+        """Admit ``request``, *blocking* while the queue is full — the
+        backpressure mode a streaming poller wants: a full queue stalls
+        the producer (which stops pulling from its source) instead of
+        shedding the row.  Returns False if still full after
+        ``timeout_s`` (None = wait indefinitely); raises
+        :class:`ServerClosed` once the queue closes."""
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        with self._not_full:
+            while True:
+                if self._closed:
+                    raise ServerClosed("endpoint is closed")
+                if len(self._items) < self.capacity:
+                    self._items.append(request)
+                    self._set_depth_locked()
+                    self._not_empty.notify()
+                    return True
+                if deadline is None:
+                    self._not_full.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._not_full.wait(remaining):
+                        if len(self._items) >= self.capacity:
+                            return False
 
     def take(
         self,
@@ -118,6 +150,7 @@ class AdmissionQueue:
                     break
                 self._not_empty.wait(remaining)
             self._set_depth_locked()
+            self._not_full.notify_all()
             return batch
 
     def close(self) -> List[Request]:
@@ -129,6 +162,7 @@ class AdmissionQueue:
             self._items.clear()
             self._set_depth_locked()
             self._not_empty.notify_all()
+            self._not_full.notify_all()
         return drained
 
     @property

@@ -1,0 +1,69 @@
+"""Streaming inference: unbounded sources, watermarks, exactly-once sinks.
+
+The repo covers batch transformers (``transformers/``) and an online
+server (``serving/``) but nothing between: rows that arrive continuously
+and must be scored with delivery guarantees — CDC scoring, log
+enrichment, near-real-time featurization (ROADMAP open item 4).  This
+package closes that gap by grafting onto every existing layer instead of
+growing a parallel stack:
+
+- **sources** (:mod:`sources`): a pull-based, replayable
+  :class:`StreamSource` protocol (``poll``/``seek``/``position``) with
+  :class:`FileTailSource` (tail a growing JSONL file; offset = byte
+  position) and :class:`QueueSource` (in-memory, for tests and
+  generators); event-time watermarks with bounded lateness
+  (:class:`WatermarkTracker`, ``streaming.watermark_lag_ms`` gauge);
+- **execution** (:mod:`runner`): :class:`StreamRunner` micro-batches
+  arriving rows through the serving layer's
+  :class:`~sparkdl_tpu.serving.admission.AdmissionQueue` (a full queue
+  *blocks the poller* — backpressure reaches the source instead of
+  dropping rows), flushes on max-batch-or-max-wait, and pipelines
+  scored batches through the engine's
+  :class:`~sparkdl_tpu.engine.DispatchWindow` so the device never idles
+  while the source has rows;
+- **exactly-once sinks** (:mod:`commit`): a :class:`CommitLog` using
+  the payload-then-commit-marker pattern proven by the estimator
+  checkpoint protocol — per-micro-batch epoch ids, atomic marker
+  writes, idempotent replay on restart, so a crash between payload and
+  marker re-emits exactly that epoch without duplication
+  (:class:`JsonlSink` dedupes by rewriting the epoch's lines;
+  :class:`CallbackSink` delegates);
+- **recovery**: source offsets ride in each epoch's payload;
+  :func:`~sparkdl_tpu.resilience.preempt.preemption_scope` integration
+  flushes the in-flight epoch on SIGTERM, and a restarted runner
+  resumes from the last committed offset.
+
+Fault-injection sites ``streaming.poll`` / ``streaming.sink`` /
+``streaming.commit`` plug into the PR-3 :class:`~sparkdl_tpu.resilience.
+FaultPlan` harness; consumer lag / watermark / epochs-committed metrics
+export via :mod:`sparkdl_tpu.obs`.
+"""
+
+from sparkdl_tpu.streaming.commit import (
+    CallbackSink,
+    CommitLog,
+    JsonlSink,
+    Sink,
+)
+from sparkdl_tpu.streaming.runner import StreamConfig, StreamRunner
+from sparkdl_tpu.streaming.sources import (
+    FileTailSource,
+    QueueSource,
+    Record,
+    StreamSource,
+    WatermarkTracker,
+)
+
+__all__ = [
+    "CallbackSink",
+    "CommitLog",
+    "FileTailSource",
+    "JsonlSink",
+    "QueueSource",
+    "Record",
+    "Sink",
+    "StreamConfig",
+    "StreamRunner",
+    "StreamSource",
+    "WatermarkTracker",
+]

@@ -90,3 +90,11 @@ def test_tracing_example():
 def test_sql_analytics_example():
     out = _run_example("sql_analytics.py")
     assert "sql analytics OK" in out
+
+
+@pytest.mark.slow
+def test_streaming_scoring_example():
+    out = _run_example("streaming_scoring.py")
+    assert "streaming scoring OK" in out
+    assert "stop_reason=preempted" in out
+    assert "scored 60 events exactly once across a SIGTERM" in out
