@@ -37,7 +37,9 @@ the serialized executable (warmed cache).  One JSON line with
 (``compile_s`` vs ``cache_load_s``).
 """
 
+import faulthandler
 import json
+import os
 import sys
 
 V100_IMAGES_PER_SEC = 1000.0
@@ -97,18 +99,67 @@ print(json.dumps({
 """
 
 
+#: repeating all-thread stack dump interval while the bench runs — the
+#: r05–r07 wedges died futex-parked with ZERO output; with the stall
+#: timer armed, a wedged run narrates where it is stuck to stderr
+STALL_DUMP_S = float(os.environ.get("SPARKDL_BENCH_STALL_S", "240") or 240)
+
+#: probe attempts before reporting the device unreachable (a relay that
+#: answers on the second try should not fail the whole benchmark run)
+PROBE_ATTEMPTS = 2
+PROBE_TIMEOUT_S = 300
+
+
+def _arm_stall_dump() -> None:
+    """faulthandler: native stacks on hard faults, plus a REPEATING
+    all-thread dump every STALL_DUMP_S so a silent wedge leaves a
+    narrative on stderr instead of nothing."""
+    faulthandler.enable()
+    faulthandler.dump_traceback_later(STALL_DUMP_S, repeat=True)
+
+
+def _probe_with_retry(attempts: int = PROBE_ATTEMPTS,
+                      timeout_s: int = PROBE_TIMEOUT_S) -> dict:
+    """``check_device`` with retry and a hard faulthandler backstop.
+
+    The watchdog bounds the probe subprocess; the backstop timer bounds
+    the watchdog machinery itself (the r05–r07 failure was a futex park
+    BEFORE any in-probe timeout could fire): if the whole probe phase
+    exceeds its budget, faulthandler dumps every thread's stack and
+    exits non-zero — all-thread stacks instead of zero output."""
+    from sparkdl_tpu.resilience.watchdog import check_device
+
+    budget = attempts * (timeout_s + 60)
+    # replaces the repeating stall timer for the probe phase (the
+    # faulthandler holds ONE later-dump slot); exit=True makes it a
+    # hard timeout, not just a narrator
+    faulthandler.dump_traceback_later(budget, exit=True)
+    try:
+        probe = None
+        for attempt in range(attempts):
+            probe = check_device(timeout_s=timeout_s)
+            if probe["ok"]:
+                break
+            print(
+                f"# device probe attempt {attempt + 1}/{attempts} "
+                f"failed: {probe['detail'][:200]}",
+                file=sys.stderr, flush=True,
+            )
+        return probe
+    finally:
+        # restore the repeating narrator for the measurement phase
+        faulthandler.dump_traceback_later(STALL_DUMP_S, repeat=True)
+
+
 def _cold_start(trace_out=None) -> int:
-    import os
     import shutil
     import subprocess
     import tempfile
 
-    from sparkdl_tpu.resilience.watchdog import check_device
-
     metric = (
         "DeepImageFeaturizer(InceptionV3) cold-start first-batch latency"
     )
-    probe = check_device(timeout_s=300)
+    probe = _probe_with_retry()
     if not probe["ok"]:
         print(json.dumps({
             "metric": metric, "value": None, "unit": "seconds",
@@ -173,6 +224,8 @@ def main():
     )
     args = ap.parse_args()
 
+    _arm_stall_dump()
+
     if args.cold_start:
         return _cold_start(trace_out=args.trace_out)
 
@@ -183,9 +236,7 @@ def main():
         sink = JsonlTraceSink(path=args.trace_out)
         tracer.enable(sink)
 
-    from sparkdl_tpu.resilience.watchdog import check_device
-
-    probe = check_device(timeout_s=300)
+    probe = _probe_with_retry()
     if not probe["ok"]:
         print(
             json.dumps(

@@ -5,17 +5,22 @@
 # into the `tests` job of .github/workflows/ci.yml.
 #
 # The test run captures a span trace (SPARKDL_TRACE_OUT — retry
-# attempts, breaker flips, batch fan-in); on failure the tail of the
-# trace is printed so CI logs show *what the code was doing*, not just
-# the assertion that noticed.
+# attempts, breaker flips, batch fan-in) AND arms the flight recorder
+# (SPARKDL_BLACKBOX_DIR — bounded rings of spans/events/metric samples,
+# persisted atomically, dumped on crash/watchdog-trip/preemption); on
+# failure the trace tail and every flight-recorder dump are printed so
+# CI logs show *what the code was doing*, not just the assertion that
+# noticed.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
 TRACE_OUT="$(mktemp -t fault-suite-trace.XXXXXX.jsonl)"
-trap 'rm -f "$TRACE_OUT"' EXIT
+BLACKBOX_DIR="$(mktemp -d -t fault-suite-blackbox.XXXXXX)"
+trap 'rm -rf "$TRACE_OUT" "$BLACKBOX_DIR"' EXIT
 export SPARKDL_TRACE_OUT="$TRACE_OUT"
+export SPARKDL_BLACKBOX_DIR="$BLACKBOX_DIR"
 
 # test_streaming.py is the streaming fault scenario: FaultPlan kills at
 # streaming.poll / streaming.sink / streaming.commit, restart, and the
@@ -25,6 +30,14 @@ if ! python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
   -q -m 'not slow' -p no:cacheprovider; then
   echo "--- captured span trace (last 50 spans, $TRACE_OUT) ---" >&2
   tail -n 50 "$TRACE_OUT" >&2 || true
+  echo "--- flight-recorder dumps ($BLACKBOX_DIR) ---" >&2
+  for dump in "$BLACKBOX_DIR"/blackbox-*.json "$BLACKBOX_DIR"/fault-*.txt; do
+    [ -e "$dump" ] || continue
+    echo "--- $dump ---" >&2
+    # dumps are single-line JSON; pretty-print when python is happy,
+    # raw otherwise (a truncated dump is still evidence)
+    python -m json.tool "$dump" >&2 2>/dev/null || cat "$dump" >&2
+  done
   exit 1
 fi
 

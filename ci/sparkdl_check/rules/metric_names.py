@@ -18,9 +18,11 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 
 #: one entry per subsystem that owns metrics; grow this list when a new
 #: subsystem earns a namespace, not to whitelist a one-off name.
+#: "slo" (burn-rate gauges/transitions) and "ts" (time-series recorder
+#: self-metrics) joined with the PR-8 telemetry plane.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
-    "streaming",
+    "streaming", "slo", "ts",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

@@ -70,6 +70,14 @@ _EXPORTS = {
     "tracer": "sparkdl_tpu.obs",
     "JsonlTraceSink": "sparkdl_tpu.obs",
     "prometheus_text": "sparkdl_tpu.obs",
+    "TimeSeriesRecorder": "sparkdl_tpu.obs",
+    "SLO": "sparkdl_tpu.obs",
+    "SLOEngine": "sparkdl_tpu.obs",
+    "ObsServer": "sparkdl_tpu.obs",
+    "FlightRecorder": "sparkdl_tpu.obs",
+    "serving_slos": "sparkdl_tpu.obs",
+    "streaming_slos": "sparkdl_tpu.obs",
+    "availability_slo": "sparkdl_tpu.obs",
 }
 
 __all__ = ["VERSION", *sorted(_EXPORTS)]
@@ -78,11 +86,33 @@ __all__ = ["VERSION", *sorted(_EXPORTS)]
 # SPARKDL_PROFILE_DIR): SPARKDL_TRACE_OUT=<path.jsonl> enables the
 # tracer with a bounded JSONL sink flushed (append) at interpreter
 # exit, so subprocess workers capture into the same file with no code
-# changes.  No env var -> no obs import -> zero cost.
-if os.environ.get("SPARKDL_TRACE_OUT"):
+# changes; SPARKDL_TRACE_SAMPLE arms tail-aware sampling for it.
+# No env var -> no obs import -> zero cost.
+if os.environ.get("SPARKDL_TRACE_OUT") or os.environ.get(
+    "SPARKDL_TRACE_SAMPLE"
+):
     from sparkdl_tpu.obs import enable_from_env as _obs_enable_from_env
 
     _obs_enable_from_env()
+
+# Zero-code flight recorder: SPARKDL_BLACKBOX_DIR=<dir> arms the crash
+# flight recorder (periodic atomic persist + crash/stall hooks), so any
+# worker subprocess leaves a post-mortem dump even on SIGKILL.
+if os.environ.get("SPARKDL_BLACKBOX_DIR"):
+    from sparkdl_tpu.obs.blackbox import (
+        enable_from_env as _blackbox_enable_from_env,
+    )
+
+    _blackbox_enable_from_env()
+
+# Zero-code introspection server: SPARKDL_OBS_PORT=<port> serves
+# /metrics, /healthz, /slo, /debug/* on localhost (0 = ephemeral).
+if os.environ.get("SPARKDL_OBS_PORT"):
+    from sparkdl_tpu.obs.server import (
+        enable_from_env as _obs_server_enable_from_env,
+    )
+
+    _obs_server_enable_from_env()
 
 
 def __getattr__(name):

@@ -19,17 +19,44 @@ arXiv:2101.12127, arXiv:1605.08695):
   and breaker state changes surface as span events through
   ``resilience.policy`` → :func:`trace.record_event`.
 
+PR 8 grows the passive layer into a **telemetry plane**:
+
+- :mod:`timeseries` — :class:`TimeSeriesRecorder`, bounded in-memory
+  metric history with windowed queries (``rate``/``delta``/quantile);
+- :mod:`slo` — declarative :class:`SLO` objectives evaluated as
+  multi-window burn rates through an ``ok → warning → page`` state
+  machine (:class:`SLOEngine`);
+- :mod:`server` — :class:`ObsServer`, the opt-in stdlib HTTP
+  introspection endpoint (``/metrics``, ``/healthz``, ``/slo``,
+  ``/debug/*``; ``SPARKDL_OBS_PORT``);
+- :mod:`blackbox` — :class:`FlightRecorder`, the crash flight recorder
+  (``SPARKDL_BLACKBOX_DIR``) that turns silent wedges into post-mortem
+  dumps.
+
 Disabled by default: every instrumentation site costs one branch until
 ``tracer.enable(...)`` (or the ``SPARKDL_TRACE_OUT`` env var — the
 zero-code hook ``ci/fault-suite.sh`` and subprocess workers use).
+``SPARKDL_TRACE_SAMPLE`` (+ optional ``SPARKDL_TRACE_SLOW_MS``) arms
+tail-aware sampling so production-rate tracing stays bounded.
 
 Layering: ``obs`` depends only on ``utils`` (metrics).  ``data``,
 ``serving`` and the estimators import it; ``resilience`` touches it
-only through a lazy cold-path import in ``policy`` (documented there).
+only through lazy cold-path imports in ``policy``/``watchdog``
+(documented there).
 """
 
+from sparkdl_tpu.obs.blackbox import FlightRecorder
 from sparkdl_tpu.obs.export import JsonlTraceSink, prometheus_text
 from sparkdl_tpu.obs.hooks import FitProfiler, fit_profiler
+from sparkdl_tpu.obs.server import ObsServer
+from sparkdl_tpu.obs.slo import (
+    SLO,
+    SLOEngine,
+    availability_slo,
+    serving_slos,
+    streaming_slos,
+)
+from sparkdl_tpu.obs.timeseries import TimeSeriesRecorder
 from sparkdl_tpu.obs.trace import (
     Span,
     Tracer,
@@ -39,6 +66,8 @@ from sparkdl_tpu.obs.trace import (
 )
 
 ENV_VAR = "SPARKDL_TRACE_OUT"
+ENV_SAMPLE = "SPARKDL_TRACE_SAMPLE"
+ENV_SLOW_MS = "SPARKDL_TRACE_SLOW_MS"
 
 #: the sink installed by :func:`enable_from_env`, if any
 _env_sink = None
@@ -56,6 +85,16 @@ def enable_from_env() -> "JsonlTraceSink | None":
     import atexit
     import os
 
+    # tail-aware sampling arms independently of an output path: a
+    # programmatically-enabled tracer honors the env policy too
+    rate_spec = os.environ.get(ENV_SAMPLE, "").strip()
+    if rate_spec:
+        slow_spec = os.environ.get(ENV_SLOW_MS, "").strip()
+        tracer.configure_sampling(
+            float(rate_spec),
+            slow_ms=float(slow_spec) if slow_spec else None,
+        )
+
     path = os.environ.get(ENV_VAR)
     if not path or _env_sink is not None:
         return _env_sink
@@ -66,15 +105,25 @@ def enable_from_env() -> "JsonlTraceSink | None":
 
 
 __all__ = [
+    "ENV_SAMPLE",
+    "ENV_SLOW_MS",
     "ENV_VAR",
     "FitProfiler",
+    "FlightRecorder",
     "JsonlTraceSink",
+    "ObsServer",
+    "SLO",
+    "SLOEngine",
     "Span",
+    "TimeSeriesRecorder",
     "Tracer",
+    "availability_slo",
     "current_span",
     "enable_from_env",
     "fit_profiler",
     "prometheus_text",
     "record_event",
+    "serving_slos",
+    "streaming_slos",
     "tracer",
 ]

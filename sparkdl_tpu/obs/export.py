@@ -114,6 +114,29 @@ def _prom_name(name: str) -> str:
     return _PROM_BAD.sub("_", name)
 
 
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format: backslash and
+    newline (HELP text is not quoted, so quotes pass through)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label_value(value: Any) -> str:
+    """Label-value escaping per the exposition format: backslash,
+    double-quote, newline — in that order."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _help_line(pn: str, dotted: str, kind: str) -> str:
+    # "<kind> <dotted registry name>": points scrapers back at the
+    # in-process name without leaking extra words into filtered views
+    return f"# HELP {pn} {_escape_help(f'{kind} {dotted}')}"
+
+
 def prometheus_text(registry: Optional[MetricsRegistry] = None,
                     prefix: Optional[str] = None) -> str:
     """The registry in the Prometheus text exposition format.
@@ -134,31 +157,37 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None,
         if not keep(name):
             continue
         pn = _prom_name(name)
+        lines.append(_help_line(pn, name, "counter"))
         lines.append(f"# TYPE {pn} counter")
         lines.append(f"{pn} {c.value:g}")
     for name, g in sorted(view["gauges"].items()):
         if not keep(name):
             continue
         pn = _prom_name(name)
+        lines.append(_help_line(pn, name, "gauge"))
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {g.value:g}")
     for name, t in sorted(view["timers"].items()):
         if not keep(name):
             continue
         pn = _prom_name(name)
+        lines.append(_help_line(f"{pn}_seconds_total", name, "timer"))
         lines.append(f"# TYPE {pn}_seconds_total counter")
         lines.append(f"{pn}_seconds_total {t.seconds:g}")
+        lines.append(_help_line(f"{pn}_entries_total", name, "timer"))
         lines.append(f"# TYPE {pn}_entries_total counter")
         lines.append(f"{pn}_entries_total {t.entries:g}")
     for name, h in sorted(view["histograms"].items()):
         if not keep(name):
             continue
         pn = _prom_name(name)
+        lines.append(_help_line(pn, name, "histogram"))
         lines.append(f"# TYPE {pn} summary")
         for q in _QUANTILES:
             v = h.quantile(q)
             if v is not None:
-                lines.append(f'{pn}{{quantile="{q:g}"}} {v:g}')
+                label = _escape_label_value(f"{q:g}")
+                lines.append(f'{pn}{{quantile="{label}"}} {v:g}')
         lines.append(f"{pn}_sum {h.total:g}")
         lines.append(f"{pn}_count {h.count:g}")
     return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,313 @@
+"""Live introspection server: the telemetry plane's front door.
+
+An opt-in stdlib ``ThreadingHTTPServer`` (no new dependencies) that
+serves the process's existing telemetry over HTTP:
+
+====================  ====================================================
+``/metrics``          Prometheus text exposition
+                      (:func:`~sparkdl_tpu.obs.export.prometheus_text`)
+``/healthz``          JSON health: the wired health callable (e.g.
+                      ``ModelServer.status()``) + the worst SLO state;
+                      **200** while healthy, **503** when not — the
+                      orchestrator-facing contract
+``/slo``              :meth:`SLOEngine.report` — every objective with
+                      burn rates, state, recent transitions
+``/debug/spans``      recent finished spans from the wired
+                      :class:`~sparkdl_tpu.obs.export.JsonlTraceSink`
+``/debug/threads``    all-thread stack dump (``sys._current_frames``)
+``/debug/timeseries`` :meth:`TimeSeriesRecorder.snapshot`
+====================  ====================================================
+
+Design rules:
+
+- **never on a hot-path thread**: handlers run on the HTTP server's own
+  daemon threads and only read bounded snapshots (every wired component
+  copies under its lock and renders outside it) — a slow scraper cannot
+  extend any serving-side critical section;
+- **bind-then-serve**: ``start()`` binds synchronously (``port=0`` gets
+  an ephemeral port, published as ``server.port`` — what the tests use)
+  and serves on a daemon thread;
+- **components are attachable**: the server renders whatever is wired —
+  :meth:`attach` accepts a recorder / SLO engine / span sink / health
+  callable at any time, so the env-armed server
+  (``SPARKDL_OBS_PORT``) starts bare and gains panes as subsystems come
+  up (``ModelServer.start_telemetry`` wires all of them).
+
+Each ``/healthz`` evaluation also records the ``sparkdl.up`` gauge
+(1 healthy / 0 not), which is exactly the series
+:func:`~sparkdl_tpu.obs.slo.availability_slo` watches — scraping your
+health endpoint is what feeds your availability objective.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from sparkdl_tpu.utils.metrics import MetricsRegistry, metrics
+
+ENV_PORT = "SPARKDL_OBS_PORT"
+
+#: the env-armed process-wide server, if any (see :func:`enable_from_env`)
+_server: "Optional[ObsServer]" = None
+
+
+def _thread_dump() -> Dict[str, Any]:
+    """The ``/debug/threads`` payload: one stack per live thread."""
+    names = {t.ident: (t.name, t.daemon) for t in threading.enumerate()}
+    threads = []
+    for ident, frame in sys._current_frames().items():
+        name, daemon = names.get(ident, ("unknown", None))
+        threads.append({
+            "name": name,
+            "ident": ident,
+            "daemon": daemon,
+            "stack": [
+                line.rstrip("\n")
+                for line in traceback.format_stack(frame)
+            ],
+        })
+    threads.sort(key=lambda t: t["name"])
+    return {"count": len(threads), "threads": threads}
+
+
+class ObsServer:
+    """Introspection HTTP server over the process's telemetry.
+
+    ``start()`` binds and serves; ``close()`` shuts down.  All wired
+    components are optional — unwired endpoints return 404 with a hint
+    rather than failing."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        registry: Optional[MetricsRegistry] = None,
+        recorder=None,
+        slo_engine=None,
+        span_sink=None,
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+    ):
+        self.host = host
+        self._requested_port = int(port)
+        self._registry = registry if registry is not None else metrics
+        self._lock = threading.Lock()
+        self._recorder = recorder
+        self._slo_engine = slo_engine
+        self._span_sink = span_sink
+        self._health_fn = health_fn
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # wiring
+    # ------------------------------------------------------------------
+    def attach(
+        self,
+        recorder=None,
+        slo_engine=None,
+        span_sink=None,
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+    ) -> "ObsServer":
+        """Wire components after construction (each is optional; a
+        later attach replaces an earlier one for that slot)."""
+        with self._lock:
+            if recorder is not None:
+                self._recorder = recorder
+            if slo_engine is not None:
+                self._slo_engine = slo_engine
+            if span_sink is not None:
+                self._span_sink = span_sink
+            if health_fn is not None:
+                self._health_fn = health_fn
+        return self
+
+    # ------------------------------------------------------------------
+    # payloads (each reads ONE bounded snapshot; no handler state)
+    # ------------------------------------------------------------------
+    def _health_payload(self) -> Dict[str, Any]:
+        with self._lock:
+            health_fn = self._health_fn
+            engine = self._slo_engine
+        payload: Dict[str, Any] = {"healthy": True}
+        if health_fn is not None:
+            try:
+                status = health_fn()
+                payload.update(status)
+                payload["healthy"] = bool(status.get("healthy", True))
+            except Exception as exc:
+                payload = {
+                    "healthy": False,
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+        if engine is not None:
+            payload["slo_worst"] = engine.worst_state()
+        # feed the availability objective: 1 while healthy, 0 while not
+        self._registry.gauge("sparkdl.up").set(
+            1.0 if payload["healthy"] else 0.0
+        )
+        return payload
+
+    def _handle(self, path: str):
+        """Route one GET; returns (status, content_type, body_bytes)."""
+        with self._lock:
+            recorder = self._recorder
+            engine = self._slo_engine
+            sink = self._span_sink
+
+        def jdump(status: int, obj: Any):
+            body = json.dumps(obj, indent=2, default=str).encode()
+            return status, "application/json", body
+
+        if path in ("/", "/index"):
+            return jdump(200, {
+                "endpoints": [
+                    "/metrics", "/healthz", "/slo", "/debug/spans",
+                    "/debug/threads", "/debug/timeseries",
+                ],
+            })
+        if path == "/metrics":
+            from sparkdl_tpu.obs.export import prometheus_text
+
+            text = prometheus_text(self._registry)
+            return 200, "text/plain; version=0.0.4", text.encode()
+        if path == "/healthz":
+            payload = self._health_payload()
+            return jdump(200 if payload["healthy"] else 503, payload)
+        if path == "/slo":
+            if engine is None:
+                return jdump(404, {"error": "no SLO engine attached"})
+            return jdump(200, engine.report())
+        if path == "/debug/spans":
+            if sink is None:
+                return jdump(404, {"error": "no span sink attached"})
+            spans = sink.spans()
+            return jdump(200, {
+                "count": len(spans),
+                "dropped": sink.dropped,
+                "spans": spans[-256:],
+            })
+        if path == "/debug/threads":
+            return jdump(200, _thread_dump())
+        if path == "/debug/timeseries":
+            if recorder is None:
+                return jdump(404, {"error": "no time-series recorder "
+                                            "attached"})
+            return jdump(200, {"series": recorder.snapshot()})
+        return jdump(404, {"error": f"unknown path {path!r}"})
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "ObsServer":
+        """Bind (synchronously — ``self.port`` is live on return) and
+        serve on a daemon thread.  Idempotent."""
+        with self._lock:
+            if self._httpd is not None:
+                return self
+            outer = self
+
+            class Handler(BaseHTTPRequestHandler):
+                # one handler class per server instance: the closure is
+                # the only channel to the wired components
+                def do_GET(self):  # noqa: N802 (http.server API)
+                    path = self.path.split("?", 1)[0]
+                    try:
+                        status, ctype, body = outer._handle(path)
+                    except Exception as exc:  # never kill the server
+                        body = json.dumps({
+                            "error": f"{type(exc).__name__}: {exc}",
+                        }).encode()
+                        status, ctype = 500, "application/json"
+                    outer._registry.counter("sparkdl.obs_requests").add(1)
+                    self.send_response(status)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def log_message(self, *args):  # silence stderr chatter
+                    pass
+
+            httpd = ThreadingHTTPServer(
+                (self.host, self._requested_port), Handler
+            )
+            httpd.daemon_threads = True
+            self._httpd = httpd
+            self._thread = threading.Thread(
+                target=httpd.serve_forever,
+                kwargs={"poll_interval": 0.2},
+                name="sparkdl-obs-server",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        """The bound port (resolves ``port=0``); None before start()."""
+        with self._lock:
+            if self._httpd is None:
+                return None
+            return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> Optional[str]:
+        port = self.port
+        return None if port is None else f"http://{self.host}:{port}"
+
+    def close(self) -> None:
+        with self._lock:
+            httpd, thread = self._httpd, self._thread
+            self._httpd = None
+            self._thread = None
+        if httpd is not None:
+            httpd.shutdown()      # stops serve_forever (blocks briefly)
+            httpd.server_close()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)
+
+    def __enter__(self) -> "ObsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self):
+        return f"ObsServer(url={self.url!r})"
+
+
+# ---------------------------------------------------------------------------
+# process-wide arming
+# ---------------------------------------------------------------------------
+
+def server() -> Optional[ObsServer]:
+    """The env-armed process-wide server, if any."""
+    return _server
+
+
+def enable_from_env() -> Optional[ObsServer]:
+    """Start the introspection server when ``SPARKDL_OBS_PORT`` is set
+    (``0`` picks an ephemeral port).  Called from
+    ``sparkdl_tpu/__init__`` at import time; idempotent.  Starts bare —
+    ``/metrics``, ``/healthz``, ``/debug/threads`` work immediately;
+    later subsystems :meth:`ObsServer.attach` their panes (and the env
+    trace sink, when one is armed, is wired as the span source)."""
+    global _server
+    import os
+
+    spec = os.environ.get(ENV_PORT, "").strip()
+    if not spec or _server is not None:
+        return _server
+    srv = ObsServer(port=int(spec))
+    from sparkdl_tpu import obs
+
+    if obs._env_sink is not None:
+        srv.attach(span_sink=obs._env_sink)
+    srv.start()
+    _server = srv
+    return srv

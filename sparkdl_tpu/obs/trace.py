@@ -25,7 +25,14 @@ Design rules:
 - **monotonic timing, wall anchoring**: durations come from
   ``time.perf_counter`` (immune to clock steps); each span also records
   one ``time.time`` start so exported traces can be correlated with
-  logs.
+  logs;
+- **tail-aware sampling**: at production rates exporting every healthy
+  span is waste — :meth:`Tracer.configure_sampling` keeps error spans
+  and slow spans (``duration >= slow_ms``) unconditionally and samples
+  the rest by a deterministic per-*trace* hash, so a kept trace is kept
+  whole (no orphaned children).  Dropped spans count into
+  ``sparkdl.spans_sampled_out``; context propagation is unaffected
+  (sampling gates delivery to sinks, not span creation).
 """
 
 from __future__ import annotations
@@ -155,6 +162,10 @@ class Tracer:
         self._lock = threading.Lock()
         self._sinks: tuple = ()
         self.enabled = False
+        # tail-aware sampling: 1.0 = keep everything (the default);
+        # slow_ms None = no slow-span exemption configured
+        self._sample_rate = 1.0
+        self._sample_slow_ms: Optional[float] = None
 
     # -- lifecycle -----------------------------------------------------
     def enable(self, sink: Optional[Callable[[Dict[str, Any]], None]] = None
@@ -168,18 +179,68 @@ class Tracer:
         return self
 
     def disable(self) -> None:
-        """Turn tracing off and drop all sinks (tests use this to
-        restore the pay-nothing default)."""
+        """Turn tracing off, drop all sinks, and reset sampling (tests
+        use this to restore the pay-nothing default)."""
         with self._lock:
             self.enabled = False
             self._sinks = ()
+            self._sample_rate = 1.0
+            self._sample_slow_ms = None
 
     def add_sink(self, sink: Callable[[Dict[str, Any]], None]) -> None:
         with self._lock:
             if sink not in self._sinks:
                 self._sinks = self._sinks + (sink,)
 
+    def remove_sink(self, sink: Callable[[Dict[str, Any]], None]) -> None:
+        """Detach one sink; unknown sinks are ignored (teardown paths
+        must be idempotent)."""
+        with self._lock:
+            self._sinks = tuple(s for s in self._sinks if s is not sink)
+
+    def configure_sampling(
+        self, rate: float, slow_ms: Optional[float] = None,
+    ) -> None:
+        """Tail-aware sampling policy for finished spans.
+
+        ``rate`` is the keep probability for *healthy* traces in
+        ``[0, 1]``; spans with an error attribute, and spans at least
+        ``slow_ms`` long, are always kept — the tail is the signal.
+        The keep decision hashes ``trace_id`` (Knuth multiplicative
+        hash), so every span of a sampled trace is kept and every span
+        of a dropped trace is dropped — no orphaned parents."""
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"sample rate must be in [0, 1], got {rate}")
+        if slow_ms is not None and slow_ms < 0:
+            raise ValueError(f"slow_ms must be >= 0, got {slow_ms}")
+        with self._lock:
+            self._sample_rate = float(rate)
+            self._sample_slow_ms = None if slow_ms is None else float(slow_ms)
+
+    def _sampled_out(self, span: Span) -> bool:
+        """True when tail-aware sampling says to drop this span."""
+        rate = self._sample_rate
+        if rate >= 1.0:
+            return False
+        attrs = span.attributes
+        if any(k in attrs for k in ("error", "error_class", "exception")):
+            return False
+        slow_ms = self._sample_slow_ms
+        if slow_ms is not None:
+            dur = span.duration_ms
+            if dur is not None and dur >= slow_ms:
+                return False
+        # deterministic per-trace coin: Knuth multiplicative hash mapped
+        # onto [0, 1) — same trace, same verdict, any process
+        coin = ((span.trace_id * 2654435761) & 0xFFFFFFFF) / 2**32
+        return coin >= rate
+
     def _deliver(self, span: Span) -> None:
+        if self._sampled_out(span):
+            from sparkdl_tpu.utils.metrics import metrics
+
+            metrics.counter("sparkdl.spans_sampled_out").add(1)
+            return
         for sink in self._sinks:
             try:
                 sink(span.to_dict())

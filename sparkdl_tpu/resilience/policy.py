@@ -55,6 +55,18 @@ def _span_event(name: str, **attrs) -> None:
     record_event(name, **attrs)
 
 
+def _blackbox_trip(reason: str, **attrs) -> None:
+    """Breadcrumb + event dump into the armed flight recorder, if any
+    (same sanctioned lazy crossing as :func:`_span_event`).  Called
+    OUTSIDE the breaker lock: a dump writes a file, and no file write
+    belongs under a held lock (the ``lock-blocking`` rule's discipline).
+    No-op while no recorder is armed."""
+    from sparkdl_tpu.obs import blackbox
+
+    blackbox.note(reason, **attrs)
+    blackbox.dump(reason)
+
+
 class Deadline:
     """An absolute bound on wall time, passed BY VALUE through call
     chains (unlike per-call timeouts, a deadline shrinks as work
@@ -313,6 +325,7 @@ class CircuitBreaker:
                 self._half_open_inflight = 0
 
     def record_failure(self) -> None:
+        tripped_after = None
         with self._lock:
             self._failures += 1
             if self._state == "half_open" or (
@@ -325,9 +338,15 @@ class CircuitBreaker:
                         "circuit %r opened after %d consecutive failures",
                         self.name, self._failures,
                     )
+                    tripped_after = self._failures
                 self._to("open")
                 self._opened_at = self._clock()
                 self._half_open_inflight = 0
+        if tripped_after is not None:
+            _blackbox_trip(
+                f"breaker_open_{self.name}",
+                breaker=self.name, failures=tripped_after,
+            )
 
     def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any):
         """Run ``fn`` under the breaker: rejected-fast when open,

@@ -39,6 +39,18 @@ from sparkdl_tpu.utils.metrics import metrics
 logger = logging.getLogger(__name__)
 
 
+def _blackbox_preempted(reason: str) -> None:
+    """Flight-recorder hook on the ``Preempted`` raise paths (lazy
+    cold-path import — the sanctioned ``resilience`` → ``obs`` crossing,
+    see ``policy._span_event``): the grace window is the LAST chance to
+    leave a post-mortem record before the scheduler's SIGKILL follows.
+    No-op while no recorder is armed."""
+    from sparkdl_tpu.obs import blackbox
+
+    blackbox.note("preempted", reason=reason)
+    blackbox.dump("preempted")
+
+
 class PreemptionToken:
     """The flag a scope's loop polls at safe points."""
 
@@ -59,7 +71,9 @@ class PreemptionToken:
         """Raise :class:`Preempted` when a preemption is pending — call
         at step/epoch boundaries (the points where stopping is safe)."""
         if self._event.is_set():
-            raise Preempted(self.reason or "preemption requested")
+            reason = self.reason or "preemption requested"
+            _blackbox_preempted(reason)
+            raise Preempted(reason)
 
 
 #: innermost-first stack of active scopes (fitMultiple nests fits)
@@ -75,6 +89,7 @@ def request_preemption(reason: str = "preemption requested") -> None:
     with _SCOPES_LOCK:
         token = _SCOPES[-1] if _SCOPES else None
     if token is None:
+        _blackbox_preempted(reason)
         raise Preempted(reason)
     token.request(reason)
 

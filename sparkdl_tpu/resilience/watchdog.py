@@ -40,6 +40,28 @@ logger = logging.getLogger(__name__)
 DEFAULT_PROBE_CODE = "import jax; print(jax.devices()[0].platform)"
 
 
+def _blackbox_note(name: str, **attrs) -> None:
+    """Breadcrumb into the armed flight recorder, if any.
+
+    Lazy cold-path import on purpose: ``resilience`` stays below ``obs``
+    in the layering (same pattern as ``policy._span_event``), and both
+    watchdog timeout paths already cost a subprocess probe — an import
+    is noise there.  No-op while no recorder is armed."""
+    from sparkdl_tpu.obs import blackbox
+
+    blackbox.note(name, **attrs)
+
+
+def _blackbox_dump(reason: str, **attrs) -> None:
+    """Trip the armed flight recorder (breadcrumb + event dump): a hard
+    watchdog timeout IS the silent-wedge moment the recorder exists for.
+    No-op while no recorder is armed."""
+    from sparkdl_tpu.obs import blackbox
+
+    blackbox.note(reason, **attrs)
+    blackbox.dump(reason)
+
+
 def watchdogged(
     fn: Callable[..., Any],
     *args: Any,
@@ -79,6 +101,9 @@ def watchdogged(
     diagnostic = None
     if not done.wait(soft_timeout_s):
         metrics.counter("resilience.watchdog_soft_timeouts").add(1)
+        _blackbox_note(
+            "watchdog_soft_timeout", what=name, timeout_s=soft_timeout_s
+        )
         ok, msg = bounded_subprocess_probe(
             diagnostic_code, timeout_s=int(diagnostic_timeout_s)
         )
@@ -92,6 +117,10 @@ def watchdogged(
             done.wait(remaining)
     if not done.is_set():
         metrics.counter("resilience.watchdog_hard_timeouts").add(1)
+        _blackbox_dump(
+            f"watchdog_{name}",
+            what=name, timeout_s=hard_timeout_s, diagnostic=diagnostic,
+        )
         detail = f"; {diagnostic}" if diagnostic else ""
         raise DeviceUnresponsive(
             f"{name} still running after hard timeout "

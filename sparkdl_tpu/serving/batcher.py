@@ -27,6 +27,7 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import numpy as np
 
 from sparkdl_tpu.engine import DispatchWindow, FetchFailure
+from sparkdl_tpu.obs.slo import sanitize_name
 from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.resilience.errors import CircuitOpen
@@ -126,6 +127,14 @@ class MicroBatcher:
         self._forward = forward
         self._config = config
         self._cache = cache
+        # per-endpoint instruments alongside the process-wide serving.*
+        # aggregates: the sampled `serving.latency_ms.<id>.p99` /
+        # `serving.errors.<id>` / `serving.requests.<id>` series are what
+        # obs.slo.serving_slos() evaluates per endpoint
+        mid = sanitize_name(model_id)
+        self._m_requests = metrics.counter(f"serving.requests.{mid}")
+        self._m_errors = metrics.counter(f"serving.errors.{mid}")
+        self._m_latency = metrics.histogram(f"serving.latency_ms.{mid}")
         # durable model identity (saved-file path+mtime, blob hash) —
         # makes this endpoint's per-bucket executables persistable
         self._fingerprint = fingerprint
@@ -199,6 +208,7 @@ class MicroBatcher:
             req.span = rspan
             req.future.add_done_callback(_end_request_span(rspan))
         metrics.counter("serving.requests").add(1)
+        self._m_requests.add(1)
         self._ensure_worker()
         self._queue.offer(req)
         return req.future
@@ -370,6 +380,7 @@ class MicroBatcher:
             return
         except Exception as e:
             metrics.counter("serving.errors").add(1)
+            self._m_errors.add(len(live))
             self._fail_batch(live, bspan, e, record=True)
             return
         for host, meta in self._window.submit(
@@ -422,6 +433,7 @@ class MicroBatcher:
         live, bucket, bspan = meta
         if isinstance(host, FetchFailure):
             metrics.counter("serving.errors").add(1)
+            self._m_errors.add(len(live))
             self._fail_batch(live, bspan, host.error, record=True)
             return
         self._breaker.record_success()
@@ -429,7 +441,9 @@ class MicroBatcher:
         latency = metrics.histogram("serving.latency_ms")
         for i, r in enumerate(live):
             r.future.set_result(host[i])
-            latency.observe((done - r.enqueued_at) * 1000.0)
+            ms = (done - r.enqueued_at) * 1000.0
+            latency.observe(ms)
+            self._m_latency.observe(ms)
         metrics.counter("serving.batches").add(1)
         metrics.histogram("serving.batch_occupancy").observe(
             len(live) / bucket
@@ -466,6 +480,7 @@ class MicroBatcher:
         except Exception as e:
             self._breaker.record_failure()
             metrics.counter("serving.errors").add(1)
+            self._m_errors.add(len(live))
             for r in live:
                 r.future.set_exception(e)
             return
@@ -474,7 +489,9 @@ class MicroBatcher:
         latency = metrics.histogram("serving.latency_ms")
         for i, r in enumerate(live):
             r.future.set_result(out[i])
-            latency.observe((done - r.enqueued_at) * 1000.0)
+            ms = (done - r.enqueued_at) * 1000.0
+            latency.observe(ms)
+            self._m_latency.observe(ms)
         metrics.counter("serving.batches").add(1)
         metrics.histogram("serving.batch_occupancy").observe(
             len(live) / bucket

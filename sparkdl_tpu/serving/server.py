@@ -44,6 +44,7 @@ class ModelServer:
         self._default: Optional[str] = None
         self._started_at = time.monotonic()
         self._closed = False
+        self._telemetry: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------------
     # registration
@@ -277,8 +278,94 @@ class ModelServer:
             metrics, prefix="serving." if serving_only else None
         )
 
+    def start_telemetry(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        sample_interval_s: float = 1.0,
+        slo_interval_s: float = 5.0,
+        latency_threshold_ms: float = 250.0,
+        latency_objective: float = 0.99,
+        error_objective: float = 0.999,
+        extra_slos: Optional[Sequence] = None,
+        **slo_overrides,
+    ):
+        """Start the telemetry plane for this server; returns the
+        :class:`~sparkdl_tpu.obs.server.ObsServer` (its ``.url`` is the
+        scrape target; ``port=0`` picks an ephemeral port).
+
+        Wires, per the ISSUE-8 plane: a
+        :class:`~sparkdl_tpu.obs.timeseries.TimeSeriesRecorder` sampling
+        the registry every ``sample_interval_s``; an
+        :class:`~sparkdl_tpu.obs.slo.SLOEngine` with the per-endpoint
+        latency + error-rate objectives
+        (:func:`~sparkdl_tpu.obs.slo.serving_slos`, thresholds/windows
+        tunable via the keyword knobs and ``slo_overrides``) plus any
+        ``extra_slos``; a span sink feeding ``/debug/spans`` (spans flow
+        only while tracing is enabled); and ``/healthz`` backed by
+        :meth:`status` — 200 while healthy, 503 when not.  Everything
+        tears down in :meth:`close`.  Idempotent: a second call returns
+        the running server."""
+        if self._telemetry is not None:
+            return self._telemetry["server"]
+        from sparkdl_tpu.obs import (
+            JsonlTraceSink,
+            ObsServer,
+            SLOEngine,
+            TimeSeriesRecorder,
+            serving_slos,
+            tracer,
+        )
+
+        recorder = TimeSeriesRecorder(
+            interval_s=sample_interval_s
+        ).start()
+        engine = SLOEngine(recorder)
+        for mid in self._endpoints:
+            engine.add(*serving_slos(
+                mid,
+                latency_threshold_ms=latency_threshold_ms,
+                latency_objective=latency_objective,
+                error_objective=error_objective,
+                **slo_overrides,
+            ))
+        if extra_slos:
+            engine.add(*extra_slos)
+        engine.start(interval_s=slo_interval_s)
+        sink = JsonlTraceSink(capacity=1024)
+        tracer.add_sink(sink)
+        server = ObsServer(
+            port=port,
+            host=host,
+            recorder=recorder,
+            slo_engine=engine,
+            span_sink=sink,
+            health_fn=self.status,
+        ).start()
+        self._telemetry = {
+            "server": server,
+            "recorder": recorder,
+            "engine": engine,
+            "sink": sink,
+        }
+        return server
+
+    @property
+    def telemetry(self) -> Optional[Dict[str, Any]]:
+        """The live plane (``server``/``recorder``/``engine``/``sink``)
+        or None before :meth:`start_telemetry`."""
+        return self._telemetry
+
     def close(self) -> None:
         self._closed = True
+        if self._telemetry is not None:
+            plane, self._telemetry = self._telemetry, None
+            from sparkdl_tpu.obs import tracer
+
+            plane["engine"].stop()
+            plane["recorder"].stop()
+            plane["server"].close()
+            tracer.remove_sink(plane["sink"])
         for ep in self._endpoints.values():
             ep.close()
 

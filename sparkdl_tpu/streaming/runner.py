@@ -467,6 +467,36 @@ class StreamRunner:
         }
 
     # ------------------------------------------------------------------
+    def slos(
+        self,
+        max_watermark_lag_ms: Optional[float] = None,
+        lag_objective: float = 0.95,
+        min_commit_rate: Optional[float] = None,
+        **overrides,
+    ):
+        """The streaming SLO bundle for this runner
+        (:func:`~sparkdl_tpu.obs.slo.streaming_slos`): bounded
+        ``streaming.watermark_lag_ms`` (threshold defaults to 5 s, never
+        below the configured ``allowed_lateness_ms`` — lag the watermark
+        tolerates by design must not burn the budget) and, when
+        ``min_commit_rate`` is given, a committed-epoch throughput
+        floor.  Register on an SLO engine::
+
+            engine.add(*runner.slos(min_commit_rate=0.5))
+        """
+        from sparkdl_tpu.obs.slo import streaming_slos
+
+        if max_watermark_lag_ms is None:
+            max_watermark_lag_ms = max(
+                5000.0, float(self.config.allowed_lateness_ms)
+            )
+        return streaming_slos(
+            max_watermark_lag_ms=max_watermark_lag_ms,
+            lag_objective=lag_objective,
+            min_commit_rate=min_commit_rate,
+            **overrides,
+        )
+
     def close(self) -> None:
         self._stop_poller.set()
         self._queue.close()

@@ -98,3 +98,12 @@ def test_streaming_scoring_example():
     assert "streaming scoring OK" in out
     assert "stop_reason=preempted" in out
     assert "scored 60 events exactly once across a SIGTERM" in out
+
+
+@pytest.mark.slow
+def test_telemetry_example():
+    out = _run_example("telemetry.py")
+    assert "telemetry plane up at http://127.0.0.1:" in out
+    assert "SLO breach detected: serving.demo.latency ->" in out
+    assert "flight recorder dump:" in out
+    assert "telemetry example complete" in out

@@ -493,3 +493,128 @@ def test_env_hook_captures_from_a_fresh_process(tmp_path):
     spans = [json.loads(ln) for ln in path.read_text().splitlines()]
     assert [s["name"] for s in spans] == ["env_root"]
     assert spans[0]["attributes"] == {"pid": 1}
+
+
+# ----------------------------------------------------------------------
+# tail-aware sampling (PR 8)
+# ----------------------------------------------------------------------
+class TestTailSampling:
+    def test_rate_zero_drops_healthy_spans_and_counts(self):
+        sink = enabled_sink()
+        tracer.configure_sampling(0.0)
+        for _ in range(5):
+            with tracer.span("healthy"):
+                pass
+        assert sink.spans() == []
+        assert metrics.snapshot()["sparkdl.spans_sampled_out"] == 5
+
+    def test_error_spans_always_kept(self):
+        sink = enabled_sink()
+        tracer.configure_sampling(0.0)
+        with tracer.span("failing") as sp:
+            sp.set_attribute("error_class", "TransientError")
+        assert [s["name"] for s in sink.spans()] == ["failing"]
+
+    def test_slow_spans_always_kept(self):
+        sink = enabled_sink()
+        # slow_ms=0: every finished span qualifies as slow -> all kept
+        # even at rate 0 (no sleeps needed to exercise the gate)
+        tracer.configure_sampling(0.0, slow_ms=0.0)
+        with tracer.span("slow"):
+            pass
+        assert [s["name"] for s in sink.spans()] == ["slow"]
+
+    def test_decision_is_per_trace_not_per_span(self):
+        sink = enabled_sink()
+        tracer.configure_sampling(0.5)
+        verdicts = []
+        for _ in range(32):
+            before = len(sink.spans())
+            with tracer.span("root"):
+                with tracer.span("child"):
+                    pass
+            kept = len(sink.spans()) - before
+            assert kept in (0, 2)  # whole trace or nothing
+            verdicts.append(kept)
+        assert 0 in verdicts and 2 in verdicts  # both outcomes occur
+
+    def test_rate_one_keeps_everything(self):
+        sink = enabled_sink()
+        tracer.configure_sampling(1.0)
+        with tracer.span("kept"):
+            pass
+        assert len(sink.spans()) == 1
+        assert "sparkdl.spans_sampled_out" not in metrics.snapshot()
+
+    def test_disable_resets_sampling(self):
+        tracer.configure_sampling(0.0)
+        tracer.disable()
+        sink = enabled_sink()
+        with tracer.span("after_reset"):
+            pass
+        assert len(sink.spans()) == 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            tracer.configure_sampling(1.5)
+        with pytest.raises(ValueError):
+            tracer.configure_sampling(0.5, slow_ms=-1)
+
+    def test_remove_sink(self):
+        sink = enabled_sink()
+        tracer.remove_sink(sink)
+        with tracer.span("unseen"):
+            pass
+        assert sink.spans() == []
+        tracer.remove_sink(sink)  # idempotent
+
+    def test_env_arming(self, monkeypatch):
+        from sparkdl_tpu import obs
+
+        monkeypatch.setenv(obs.ENV_SAMPLE, "0.25")
+        monkeypatch.setenv(obs.ENV_SLOW_MS, "500")
+        monkeypatch.delenv(obs.ENV_VAR, raising=False)
+        obs.enable_from_env()
+        assert tracer._sample_rate == 0.25
+        assert tracer._sample_slow_ms == 500.0
+
+
+# ----------------------------------------------------------------------
+# exposition format details (PR 8)
+# ----------------------------------------------------------------------
+class TestPrometheusHelpAndEscaping:
+    def test_help_precedes_type_for_every_family(self):
+        metrics.counter("serving.requests").add(1)
+        metrics.gauge("data.queue_depth").set(2)
+        metrics.timer("estimator.step").add_seconds(0.1)
+        metrics.histogram("serving.latency_ms").observe(1.0)
+        text = prometheus_text(metrics)
+        assert ("# HELP serving_requests counter serving.requests\n"
+                "# TYPE serving_requests counter") in text
+        assert ("# HELP data_queue_depth gauge data.queue_depth\n"
+                "# TYPE data_queue_depth gauge") in text
+        assert "# HELP estimator_step_seconds_total " in text
+        assert "# HELP estimator_step_entries_total " in text
+        assert ("# HELP serving_latency_ms histogram serving.latency_ms\n"
+                "# TYPE serving_latency_ms summary") in text
+        # every TYPE line is immediately preceded by its HELP line
+        lines = text.splitlines()
+        for i, line in enumerate(lines):
+            if line.startswith("# TYPE "):
+                family = line.split()[2]
+                assert lines[i - 1].startswith(f"# HELP {family} ")
+
+    def test_label_value_escaping(self):
+        from sparkdl_tpu.obs.export import _escape_help, _escape_label_value
+
+        assert _escape_label_value('a"b') == 'a\\"b'
+        assert _escape_label_value("a\\b") == "a\\\\b"
+        assert _escape_label_value("a\nb") == "a\\nb"
+        assert _escape_help("a\nb\\c") == "a\\nb\\\\c"
+
+    def test_quantile_labels_still_byte_stable(self):
+        h = metrics.histogram("serving.latency_ms")
+        for v in (1.0, 2.0, 3.0, 4.0):
+            h.observe(v)
+        text = prometheus_text(metrics)
+        assert 'serving_latency_ms{quantile="0.5"} 2.5' in text

@@ -1,0 +1,416 @@
+"""Telemetry plane core: the bounded time-series recorder and the SLO
+burn-rate engine (``obs/timeseries.py`` + ``obs/slo.py``).
+
+Everything here drives synthetic clocks — ``sample_once(now=...)`` /
+``evaluate_once(now=...)`` — so windows, burn rates, and the
+``ok → warning → page`` state machine are tested deterministically, no
+sleeps, no background threads (the ISSUE-8 acceptance shape for the
+state machine).
+"""
+
+import time
+
+import pytest
+
+from sparkdl_tpu.obs.slo import (
+    SLO,
+    SLOEngine,
+    availability_slo,
+    sanitize_name,
+    serving_slos,
+    streaming_slos,
+)
+from sparkdl_tpu.obs.timeseries import TimeSeriesRecorder, _interpolated_quantile
+from sparkdl_tpu.utils.metrics import MetricsRegistry
+
+
+@pytest.fixture()
+def registry():
+    return MetricsRegistry()
+
+
+@pytest.fixture()
+def recorder(registry):
+    return TimeSeriesRecorder(registry=registry, interval_s=1.0)
+
+
+# ----------------------------------------------------------------------
+# time-series recorder
+# ----------------------------------------------------------------------
+class TestTimeSeriesRecorder:
+    def test_samples_registry_snapshot_flat_names(self, registry, recorder):
+        registry.counter("serving.requests").add(3)
+        registry.gauge("data.queue_depth").set(2.0)
+        registry.histogram("serving.latency_ms").observe(10.0)
+        n = recorder.sample_once(now=1.0)
+        assert n >= 3
+        names = recorder.series_names()
+        assert "serving.requests" in names
+        assert "data.queue_depth" in names
+        # histograms land in their snapshot() expansion
+        assert "serving.latency_ms.p99" in names
+        assert recorder.latest("serving.requests") == 3.0
+
+    def test_excludes_own_ts_metrics(self, registry, recorder):
+        registry.counter("serving.requests").add(1)
+        recorder.sample_once(now=1.0)
+        recorder.sample_once(now=2.0)
+        assert not any(
+            n.startswith("ts.") for n in recorder.series_names()
+        )
+        # but the self-metrics exist in the registry
+        assert registry.snapshot()["ts.samples"] == 2
+
+    def test_window_queries(self, registry, recorder):
+        c = registry.counter("serving.requests")
+        for t in range(10):
+            c.add(5)
+            recorder.sample_once(now=float(t))
+        # full window: 10 samples, 45 of increase over 9 seconds
+        assert recorder.delta("serving.requests", 100.0, now=9.0) == 45.0
+        assert recorder.rate("serving.requests", 100.0, now=9.0) == 5.0
+        # trailing window keeps only the in-window points
+        pts = recorder.points("serving.requests", 2.0, now=9.0)
+        assert [p[0] for p in pts] == [7.0, 8.0, 9.0]
+        assert recorder.delta("serving.requests", 2.0, now=9.0) == 10.0
+
+    def test_windowed_queries_need_two_points(self, registry, recorder):
+        registry.counter("serving.requests").add(1)
+        recorder.sample_once(now=1.0)
+        assert recorder.delta("serving.requests", 10.0, now=1.0) is None
+        assert recorder.rate("serving.requests", 10.0, now=1.0) is None
+        assert recorder.delta("nope", 10.0, now=1.0) is None
+        assert recorder.latest("nope") is None
+
+    def test_quantile_and_fraction_over_window(self, registry, recorder):
+        g = registry.gauge("serving.lag")
+        for t, v in enumerate([10.0, 20.0, 30.0, 40.0, 50.0]):
+            g.set(v)
+            recorder.sample_once(now=float(t))
+        assert recorder.quantile_over_window(
+            "serving.lag", 0.5, 100.0, now=4.0
+        ) == 30.0
+        assert recorder.fraction_where(
+            "serving.lag", lambda v: v > 25.0, 100.0, now=4.0
+        ) == pytest.approx(0.6)
+        assert recorder.fraction_where(
+            "serving.lag", lambda v: v > 25.0, 100.0, now=500.0
+        ) is None  # window slid past every point
+
+    def test_max_points_ring_drops_oldest(self, registry):
+        rec = TimeSeriesRecorder(registry=registry, max_points=5)
+        g = registry.gauge("serving.lag")
+        for t in range(10):
+            g.set(float(t))
+            rec.sample_once(now=float(t))
+        pts = rec.points("serving.lag")
+        assert len(pts) == 5
+        assert pts[0] == (5.0, 5.0)
+
+    def test_max_series_cap_counts_drops(self, registry):
+        rec = TimeSeriesRecorder(registry=registry, max_series=3)
+        for i in range(6):
+            registry.gauge(f"serving.g{i}").set(1.0)
+        rec.sample_once(now=1.0)
+        assert len(rec.series_names()) == 3
+        assert registry.snapshot()["ts.series_dropped"] >= 3
+
+    def test_snapshot_truncates(self, registry):
+        rec = TimeSeriesRecorder(registry=registry, max_points=100)
+        g = registry.gauge("serving.lag")
+        for t in range(50):
+            g.set(float(t))
+            rec.sample_once(now=float(t))
+        snap = rec.snapshot(max_points=10)
+        assert len(snap["serving.lag"]) == 10
+        assert snap["serving.lag"][-1] == [49.0, 49.0]
+
+    def test_interpolated_quantile(self):
+        assert _interpolated_quantile([], 0.5) is None
+        assert _interpolated_quantile([1.0, 2.0, 3.0], 0.5) == 2.0
+        assert _interpolated_quantile([1.0, 3.0], 0.5) == 2.0
+        with pytest.raises(ValueError):
+            _interpolated_quantile([1.0], 1.5)
+
+    def test_validation(self, registry):
+        with pytest.raises(ValueError):
+            TimeSeriesRecorder(registry=registry, interval_s=0)
+        with pytest.raises(ValueError):
+            TimeSeriesRecorder(registry=registry, max_points=1)
+        with pytest.raises(ValueError):
+            TimeSeriesRecorder(registry=registry, max_series=0)
+
+    def test_background_thread_lifecycle(self, registry):
+        rec = TimeSeriesRecorder(registry=registry, interval_s=0.01)
+        registry.counter("serving.requests").add(1)
+        rec.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not rec.series_names():
+                if time.monotonic() > deadline:
+                    pytest.fail("background sampler never sampled")
+        finally:
+            rec.stop()
+        assert "serving.requests" in rec.series_names()
+
+
+# ----------------------------------------------------------------------
+# SLO declarations
+# ----------------------------------------------------------------------
+class TestSLODeclaration:
+    def test_sanitize_name(self):
+        assert sanitize_name("My-Model v2") == "my_model_v2"
+        assert sanitize_name(".weird.") == "weird"
+        assert sanitize_name("...") == "unnamed"
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unknown SLO kind"):
+            SLO(name="x", kind="bogus", series="s", threshold=1.0)
+        with pytest.raises(ValueError, match="objective"):
+            SLO(name="x", kind="threshold", series="s", threshold=1.0,
+                objective=1.0)
+        with pytest.raises(ValueError, match="numerator"):
+            SLO(name="x", kind="error_rate")
+        with pytest.raises(ValueError, match="needs a series"):
+            SLO(name="x", kind="threshold", threshold=1.0)
+        with pytest.raises(ValueError, match="needs a threshold"):
+            SLO(name="x", kind="threshold", series="s")
+        with pytest.raises(ValueError, match="fast_window_s"):
+            SLO(name="x", kind="threshold", series="s", threshold=1.0,
+                fast_window_s=600.0, slow_window_s=60.0)
+
+    def test_budget(self):
+        slo = SLO(name="x", kind="threshold", series="s", threshold=1.0,
+                  objective=0.99)
+        assert slo.budget == pytest.approx(0.01)
+
+    def test_factories(self):
+        pair = serving_slos("My Model", latency_threshold_ms=100.0)
+        assert [s.name for s in pair] == [
+            "serving.my_model.latency", "serving.my_model.errors",
+        ]
+        assert pair[0].series == "serving.latency_ms.my_model.p99"
+        assert pair[1].numerator == "serving.errors.my_model"
+        bundle = streaming_slos(min_commit_rate=2.0)
+        assert [s.name for s in bundle] == [
+            "streaming.watermark_lag", "streaming.commit_rate",
+        ]
+        up = availability_slo()
+        assert up.kind == "availability" and up.series == "sparkdl.up"
+
+
+# ----------------------------------------------------------------------
+# burn-rate state machine (synthetic clock throughout)
+# ----------------------------------------------------------------------
+def _latency_slo(**overrides):
+    """p99-latency objective: 99% of samples under 100 ms, 60s fast /
+    600s slow windows, page at burn 14, warn at 6, clear after 3."""
+    defaults = dict(
+        name="lat", kind="threshold", series="serving.p99",
+        threshold=100.0, objective=0.99,
+        fast_window_s=60.0, slow_window_s=600.0,
+    )
+    defaults.update(overrides)
+    return SLO(**defaults)
+
+
+class _Plant:
+    """Drive a (recorder, engine) pair: one sample + one evaluation per
+    10-second tick, gauge value chosen by the caller."""
+
+    def __init__(self, registry, slo):
+        self.registry = registry
+        self.recorder = TimeSeriesRecorder(registry=registry)
+        self.engine = SLOEngine(
+            self.recorder, registry=registry, clock=lambda: self.t
+        )
+        self.engine.add(slo)
+        self.gauge = registry.gauge(slo.series)
+        self.t = 0.0
+
+    def tick(self, value, n=1, step_s=10.0):
+        out = None
+        for _ in range(n):
+            self.t += step_s
+            self.gauge.set(value)
+            self.recorder.sample_once(now=self.t)
+            out = self.engine.evaluate_once(now=self.t)
+        return out
+
+
+class TestBurnRateStateMachine:
+    def test_healthy_stays_ok(self, registry):
+        plant = _Plant(registry, _latency_slo())
+        states = plant.tick(50.0, n=30)
+        assert states == {"lat": "ok"}
+        st = plant.engine.report()["slos"][0]
+        assert st["burn_fast"] == 0.0 and st["no_data"] is False
+
+    def test_no_data_is_ok_not_breach(self, registry):
+        plant = _Plant(registry, _latency_slo())
+        assert plant.engine.evaluate_once(now=0.0) == {"lat": "ok"}
+        assert plant.engine.report()["slos"][0]["no_data"] is True
+
+    def test_total_breach_pages_and_is_hysteretic(self, registry):
+        plant = _Plant(registry, _latency_slo())
+        plant.tick(50.0, n=30)  # 5 healthy minutes
+        # latency regression: every sample lands over threshold.  Fast
+        # burn saturates immediately; page waits for the slow window to
+        # confirm real budget spend (burn_slow >= 6 needs >= 6% of the
+        # slow window bad).
+        states = plant.tick(500.0, n=1)
+        assert states == {"lat": "warning"}  # fast breach, unconfirmed
+        states = plant.tick(500.0, n=5)
+        assert states == {"lat": "page"}
+        # recovery: downgrade waits clear_after consecutive clean evals
+        # per step, and steps DOWN through warning while the slow window
+        # still holds the breach (hysteresis: no flapping at threshold)
+        states = plant.tick(50.0, n=1)
+        assert states == {"lat": "page"}
+        plant.tick(50.0, n=70)  # drain both windows well past clean
+        assert plant.engine.states() == {"lat": "ok"}
+        trans = plant.engine.report()["slos"][0]["transitions"]
+        assert [(x["from"], x["to"]) for x in trans] == [
+            ("ok", "warning"), ("warning", "page"),
+            ("page", "warning"), ("warning", "ok"),
+        ]
+
+    def test_partial_breach_warns_without_paging(self, registry):
+        # sparse breach: every 10th sample bad.  The 7-point fast window
+        # makes one bad sample burn ~14x, so pin page_burn out of reach
+        # and assert the multiwindow gate holds the state at warning
+        # (slow-window burn ~10 >= warn_burn 6) without ever paging
+        plant = _Plant(registry, _latency_slo(page_burn=100.0))
+        for _ in range(10):
+            plant.tick(50.0, n=9)
+            plant.tick(500.0, n=1)
+        assert plant.engine.states() == {"lat": "warning"}
+        assert not any(
+            x["to"] == "page"
+            for x in plant.engine.report()["slos"][0]["transitions"]
+        )
+
+    def test_escalation_is_immediate_not_hysteretic(self, registry):
+        plant = _Plant(registry, _latency_slo(clear_after=1000))
+        plant.tick(50.0, n=30)
+        plant.tick(500.0, n=6)
+        # huge clear_after delays downgrades, never upgrades
+        assert plant.engine.states() == {"lat": "page"}
+
+    def test_gauges_and_transition_counter_exported(self, registry):
+        plant = _Plant(registry, _latency_slo())
+        plant.tick(50.0, n=30)
+        plant.tick(500.0, n=6)
+        snap = registry.snapshot()
+        assert snap["slo.lat.state"] == 2.0  # page
+        assert snap["slo.lat.burn_fast"] >= 14.0
+        assert snap["slo.lat.burn_slow"] >= 6.0
+        assert snap["slo.transitions"] == 2  # ok->warning, warning->page
+
+    def test_transition_callback_seam(self, registry):
+        plant = _Plant(registry, _latency_slo())
+        seen = []
+        plant.engine.on_transition(
+            lambda slo, old, new, st: seen.append((slo.name, old, new))
+        )
+        plant.tick(50.0, n=30)
+        plant.tick(500.0, n=6)
+        assert ("lat", "ok", "warning") in seen
+        assert ("lat", "warning", "page") in seen
+
+    def test_callback_errors_do_not_break_evaluation(self, registry):
+        plant = _Plant(registry, _latency_slo())
+
+        def bad_hook(*a):
+            raise RuntimeError("hook boom")
+
+        plant.engine.on_transition(bad_hook)
+        plant.tick(50.0, n=30)
+        assert plant.tick(500.0, n=6) == {"lat": "page"}
+
+    def test_error_rate_kind_zero_traffic_is_zero_burn(self, registry):
+        recorder = TimeSeriesRecorder(registry=registry)
+        engine = SLOEngine(recorder, registry=registry)
+        engine.add(SLO(
+            name="err", kind="error_rate", objective=0.999,
+            numerator="serving.errors.m", denominator="serving.requests.m",
+        ))
+        errors = registry.counter("serving.errors.m")
+        requests = registry.counter("serving.requests.m")
+        t = 0.0
+        for _ in range(10):  # idle: counters flat
+            t += 10.0
+            recorder.sample_once(now=t)
+        assert engine.evaluate_once(now=t) == {"err": "ok"}
+        # 50% errors on live traffic with budget 0.001 -> page fast
+        for _ in range(10):
+            t += 10.0
+            requests.add(100)
+            errors.add(50)
+            recorder.sample_once(now=t)
+            engine.evaluate_once(now=t)
+        assert engine.states() == {"err": "page"}
+
+    def test_rate_min_kind(self, registry):
+        recorder = TimeSeriesRecorder(registry=registry)
+        engine = SLOEngine(recorder, registry=registry)
+        engine.add(SLO(
+            name="commits", kind="rate_min", objective=0.99,
+            series="streaming.epochs_committed", threshold=1.0,
+            fast_window_s=60.0, slow_window_s=600.0,
+        ))
+        committed = registry.counter("streaming.epochs_committed")
+        t = 0.0
+        for _ in range(30):  # 2 epochs/s >= floor of 1
+            t += 10.0
+            committed.add(20)
+            recorder.sample_once(now=t)
+            engine.evaluate_once(now=t)
+        assert engine.states() == {"commits": "ok"}
+        for _ in range(40):  # throughput collapses below the floor
+            t += 10.0
+            recorder.sample_once(now=t)
+            engine.evaluate_once(now=t)
+        assert engine.states() == {"commits": "page"}
+
+    def test_availability_kind(self, registry):
+        recorder = TimeSeriesRecorder(registry=registry)
+        engine = SLOEngine(recorder, registry=registry)
+        engine.add(availability_slo(objective=0.99))
+        up = registry.gauge("sparkdl.up")
+        t = 0.0
+        for _ in range(30):
+            t += 10.0
+            up.set(1.0)
+            recorder.sample_once(now=t)
+            engine.evaluate_once(now=t)
+        assert engine.states() == {"availability": "ok"}
+        for _ in range(6):
+            t += 10.0
+            up.set(0.0)
+            recorder.sample_once(now=t)
+            engine.evaluate_once(now=t)
+        assert engine.states() == {"availability": "page"}
+
+    def test_report_shape_and_worst(self, registry):
+        plant = _Plant(registry, _latency_slo())
+        plant.engine.add(SLO(
+            name="other", kind="threshold", series="serving.other",
+            threshold=1.0,
+        ))
+        plant.tick(50.0, n=30)
+        plant.tick(500.0, n=6)
+        report = plant.engine.report()
+        assert report["worst"] == "page"
+        assert plant.engine.worst_state() == "page"
+        row = {r["name"]: r for r in report["slos"]}["lat"]
+        assert row["kind"] == "threshold"
+        assert row["windows_s"] == [60.0, 600.0]
+        assert row["state"] == "page"
+
+    def test_duplicate_slo_rejected(self, registry):
+        recorder = TimeSeriesRecorder(registry=registry)
+        engine = SLOEngine(recorder, registry=registry)
+        engine.add(_latency_slo())
+        with pytest.raises(ValueError, match="already registered"):
+            engine.add(_latency_slo())

@@ -771,3 +771,95 @@ def test_repo_baseline_has_no_stale_entries(repo_report):
 def test_repo_scan_is_fast_enough(repo_report):
     """Acceptance: the full 9-rule scan completes in < 10 s on CPU."""
     assert repo_report.elapsed_s < 10.0, repo_report.elapsed_s
+
+
+# ---------------------------------------------------------------------------
+# PR 8: the telemetry plane joins the checked surface
+# ---------------------------------------------------------------------------
+
+def test_metric_name_rule_sanctions_telemetry_prefixes(tmp_path):
+    """``slo.`` (burn-rate gauges) and ``ts.`` (recorder self-metrics)
+    are sanctioned subsystem prefixes; a lookalike is not."""
+    report = check_snippet(
+        tmp_path, "obs/x.py",
+        """
+        from sparkdl_tpu.utils.metrics import metrics
+        metrics.gauge("slo.latency.state").set(0)
+        metrics.counter("slo.transitions").add(1)
+        metrics.counter("ts.samples").add(1)
+        metrics.gauge("ts.active_series").set(3)
+        metrics.counter("tsx.samples").add(1)
+        """,
+        rules=["metric-name"],
+    )
+    assert len(report.findings) == 1
+    assert "tsx.samples" in report.findings[0].message
+
+
+def test_lock_blocking_scope_covers_obs_server(tmp_path):
+    """The introspection server is in the lock-blocking rule's scope: a
+    handler that renders (or joins) under a held lock must fire."""
+    report = check_snippet(
+        tmp_path, "obs/server.py",
+        """
+        import threading
+
+        _lock = threading.Lock()
+
+        def close(thread, fut):
+            with _lock:
+                thread.join()
+                fut.result()
+        """,
+        rules=["lock-blocking"],
+    )
+    assert len(report.findings) == 2
+    assert all(f.path == "obs/server.py" for f in report.findings)
+
+
+def test_lock_blocking_scope_covers_obs_blackbox(tmp_path):
+    """The flight recorder must never do file I/O under its ring lock —
+    the rule watches the file that promises it."""
+    report = check_snippet(
+        tmp_path, "obs/blackbox.py",
+        """
+        import subprocess
+        import threading
+
+        _lock = threading.Lock()
+
+        def dump(cmd):
+            with _lock:
+                subprocess.run(cmd)
+        """,
+        rules=["lock-blocking"],
+    )
+    assert len(report.findings) == 1
+    snapshot_outside = check_snippet(
+        tmp_path, "obs/blackbox2.py",
+        """
+        import json
+        import threading
+
+        _lock = threading.Lock()
+        _ring = []
+
+        def dump(path):
+            with _lock:
+                payload = list(_ring)
+            with open(path, "w") as fh:
+                json.dump(payload, fh)
+        """,
+        rules=["lock-blocking"],
+    )
+    assert [f for f in snapshot_outside.findings
+            if f.path == "obs/blackbox2.py"] == []
+
+
+def test_repo_telemetry_plane_is_clean(repo_report):
+    """The shipped obs/server.py + obs/blackbox.py (new in PR 8) carry
+    zero findings — copy-under-lock, render-outside is the law there."""
+    dirty = [f for f in repo_report.findings
+             if f.path in ("obs/server.py", "obs/blackbox.py",
+                           "obs/timeseries.py", "obs/slo.py")]
+    assert dirty == [], dirty
