@@ -5,15 +5,23 @@
 # suppressed inline (# sparkdl: disable=<rule-id>) nor grandfathered in
 # ci/sparkdl_check/baseline.json, and on stale baseline entries.
 #
-# Usage: ci/check.sh [report-path]
-#   report-path  where to write the JSON report
-#                (default: ci/sparkdl_check/report.json, git-ignored)
+# Usage: ci/check.sh [--changed-only] [report-path]
+#   --changed-only  scan only files touched per git diff (HEAD + worktree)
+#                   plus their reverse call-graph dependents; stale-baseline
+#                   enforcement is off in this mode (partial view)
+#   report-path     where to write the JSON report
+#                   (default: ci/sparkdl_check/report.json, git-ignored)
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
+CHANGED_ONLY=""
+if [[ "${1:-}" == "--changed-only" ]]; then
+    CHANGED_ONLY="--changed-only"
+    shift
+fi
 REPORT="${1:-ci/sparkdl_check/report.json}"
 
-python -m ci.sparkdl_check sparkdl_tpu/ --format json > "$REPORT"
+python -m ci.sparkdl_check sparkdl_tpu/ --format json $CHANGED_ONLY > "$REPORT"
 rc=$?
 
 python - "$REPORT" <<'EOF'
@@ -24,12 +32,18 @@ for f in doc["findings"]:
           f"[{f['severity']}] {f['message']}")
 for entry in doc["stale_baseline"]:
     print(f"stale baseline entry: {entry['rule']} @ {entry['path']}")
+t = doc.get("timings", {})
+slowest = sorted(t.get("rules", {}).items(), key=lambda kv: -kv[1])[:3]
 print(f"sparkdl_check: {doc['files_scanned']} file(s), "
-      f"{len(doc['rules'])} rule(s), {doc['elapsed_s']}s — "
+      f"{len(doc['rules'])} rule(s), {doc['elapsed_s']}s "
+      f"[cache: {doc.get('cache_status', '?')}] — "
       f"{len(doc['findings'])} finding(s), "
       f"{len(doc['suppressed'])} suppressed, "
       f"{len(doc['baselined'])} baselined "
       f"(report: {sys.argv[1]})")
+print(f"  timings: parse {t.get('parse_s', 0)}s, "
+      f"call graph {t.get('graph_build_s', 0)}s; slowest rules: "
+      + ", ".join(f"{rid} {s}s" for rid, s in slowest))
 EOF
 
 exit "$rc"

@@ -7,6 +7,7 @@ every file parsed, and no baseline entry is stale.
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 
@@ -17,7 +18,41 @@ from ci.sparkdl_check import (
     run_check,
     write_baseline,
 )
+from ci.sparkdl_check.cache import DEFAULT_CACHE
 from ci.sparkdl_check.report import json_report, text_report
+
+
+def _git_changed_relpaths(root: Path) -> list:
+    """Package-relative paths of .py files git considers changed
+    (worktree diff vs HEAD, plus untracked), limited to the scan root."""
+    cwd = root if root.is_dir() else root.parent
+    names = set()
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=str(cwd), capture_output=True, text=True,
+                timeout=10,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode == 0:
+            names.update(
+                n.strip() for n in proc.stdout.splitlines() if n.strip()
+            )
+    out = []
+    for name in sorted(names):
+        if not name.endswith(".py"):
+            continue
+        parts = name.split("/")
+        if "sparkdl_tpu" in parts:
+            idx = len(parts) - 1 - parts[::-1].index("sparkdl_tpu")
+            parts = parts[idx + 1:]
+        if parts:
+            out.append("/".join(parts))
+    return out
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -35,6 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ignore the baseline (report every finding)")
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings as the new baseline and exit 0")
+    p.add_argument("--changed-only", action="store_true",
+                   help="scan only git-changed files plus their reverse "
+                        "call-graph dependents (fast pre-commit mode; "
+                        "skips stale-baseline enforcement and the cache)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the incremental result cache")
     p.add_argument("--list-rules", action="store_true")
     return p
 
@@ -57,7 +98,16 @@ def main(argv=None) -> int:
         path = write_baseline(report.findings, args.baseline)
         print(f"wrote {len(report.findings)} finding(s) to {path}")
         return 0
-    report = run_check(Path(args.root), rule_ids, baseline=baseline)
+    only_paths = None
+    if args.changed_only:
+        only_paths = _git_changed_relpaths(Path(args.root))
+        if not only_paths:
+            print("changed-only: no changed .py files — nothing to scan")
+            return 0
+    cache_path = None if (args.no_cache or args.changed_only) else \
+        DEFAULT_CACHE
+    report = run_check(Path(args.root), rule_ids, baseline=baseline,
+                       cache_path=cache_path, only_paths=only_paths)
     out = json_report(report) if args.format == "json" else text_report(report)
     print(out)
     return report.exit_code

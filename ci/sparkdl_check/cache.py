@@ -1,0 +1,165 @@
+"""Incremental result cache for sparkdl_check.
+
+The interprocedural pass (``callgraph.py``) costs real time on every
+run; the tier-1 gate runs the checker on every test invocation.  The
+cache keeps the warm path well under the 10 s budget by remembering the
+previous run's findings, keyed so that any input that could change a
+finding invalidates exactly the findings it could change:
+
+- **toolchain version** — sha256 over the *contents* of every
+  ``ci/sparkdl_check/**/*.py`` file.  Editing any rule, the graph
+  builder, or this module invalidates everything (rule-set version).
+- **whole-run key** — scan root, selected rule ids, the per-file sha256
+  map of every scanned file, and a digest of ``tests/`` (the
+  fault-site-coverage rule reads test sources).  Exact match replays
+  the previous run's raw findings without parsing a single file.
+- **per-file key** — a file's own sha256 plus a digest of the sha256s
+  of every file in its forward call-graph closure.  On a partial match
+  (some files changed) the checker re-parses everything — the graph
+  must reflect reality — but skips re-running *cacheable* rules on
+  files whose own content and whole dependency closure are unchanged.
+
+Stateful rules (``cacheable = False`` — e.g. lock-order accumulates the
+global acquisition graph during ``check()``) always re-run, and
+``finalize()`` findings are always recomputed from live rule state.
+
+Findings cached here are RAW (pre-baseline): the baseline is matched
+fresh on every run, so editing ``baseline.json`` never requires a cache
+flush.  The cache file lives next to the baseline
+(``ci/sparkdl_check/.cache.json``), is git-ignored, and is written
+atomically (tmp + rename) so a crashed run cannot corrupt it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Dict, Iterable, Optional, Tuple
+
+CACHE_VERSION_TAG = 1  # bump to orphan every existing cache file
+
+DEFAULT_CACHE = Path(__file__).resolve().parent / ".cache.json"
+
+_toolchain_memo: Optional[str] = None
+
+
+def toolchain_version() -> str:
+    """sha256 over the checker's own source: any edit to a rule, the
+    call-graph builder, or the framework invalidates the cache."""
+    global _toolchain_memo
+    if _toolchain_memo is None:
+        h = hashlib.sha256(f"v{CACHE_VERSION_TAG}".encode())
+        pkg = Path(__file__).resolve().parent
+        for p in sorted(pkg.rglob("*.py")):
+            h.update(str(p.relative_to(pkg)).encode())
+            try:
+                h.update(p.read_bytes())
+            except OSError:
+                h.update(b"<unreadable>")
+        _toolchain_memo = h.hexdigest()
+    return _toolchain_memo
+
+
+def digest_tree(root: Optional[Path]) -> str:
+    """Order-stable digest of every ``*.py`` under ``root`` (name +
+    content); used for the tests/ directory the fault-site-coverage
+    rule reads."""
+    h = hashlib.sha256()
+    if root is not None and root.is_dir():
+        for p in sorted(root.rglob("*.py")):
+            h.update(str(p).encode())
+            try:
+                h.update(p.read_bytes())
+            except OSError:
+                h.update(b"<unreadable>")
+    return h.hexdigest()
+
+
+def deps_digest(shas: Dict[str, str], closure: Iterable[str]) -> str:
+    """Digest of the (path, sha) pairs of a file's forward call-graph
+    closure — the second half of the per-file cache key."""
+    h = hashlib.sha256()
+    for rel in sorted(closure):
+        h.update(rel.encode())
+        h.update(shas.get(rel, "<gone>").encode())
+    return h.hexdigest()
+
+
+def load_cache(path: Optional[Path]) -> Optional[dict]:
+    if path is None:
+        return None
+    path = Path(path)
+    if not path.exists():
+        return None
+    try:
+        doc = json.loads(path.read_text())
+    except (ValueError, OSError):
+        return None  # corrupt/unreadable cache is just a cold start
+    if not isinstance(doc, dict) or doc.get("version") != toolchain_version():
+        return None
+    return doc
+
+
+def write_cache(path: Optional[Path], doc: dict) -> None:
+    if path is None:
+        return
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    try:
+        tmp.write_text(json.dumps(doc, indent=1) + "\n")
+        os.replace(tmp, path)  # atomic on POSIX: never a torn cache
+    except OSError:
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
+
+
+def run_key_matches(cache: dict, root: str, rule_ids, shas: Dict[str, str],
+                    tests_digest: str) -> bool:
+    """True when NOTHING the checker reads has changed since the cached
+    run — the whole-run replay fast path."""
+    if cache.get("root") != root or cache.get("rules") != list(rule_ids):
+        return False
+    if cache.get("tests_digest") != tests_digest:
+        return False
+    cached_files = cache.get("files", {})
+    if set(cached_files) != set(shas):
+        return False
+    return all(
+        cached_files[rel].get("sha") == sha for rel, sha in shas.items()
+    )
+
+
+def reusable_file_rules(
+    cache: Optional[dict], relpath: str, sha: str, deps_sha: str
+) -> Optional[Dict[str, dict]]:
+    """The cached per-rule results for ``relpath`` when both its content
+    and its dependency closure are unchanged, else None."""
+    if cache is None:
+        return None
+    entry = cache.get("files", {}).get(relpath)
+    if entry is None:
+        return None
+    if entry.get("sha") != sha or entry.get("deps_sha") != deps_sha:
+        return None
+    return entry.get("rules", {})
+
+
+def build_doc(root: str, rule_ids, shas: Dict[str, str], tests_digest: str,
+              file_entries: Dict[str, dict],
+              run_findings, run_suppressed, files_scanned: int) -> dict:
+    return {
+        "version": toolchain_version(),
+        "root": root,
+        "rules": list(rule_ids),
+        "tests_digest": tests_digest,
+        "files": file_entries,
+        "run": {
+            "findings": run_findings,
+            "suppressed": run_suppressed,
+            "files_scanned": files_scanned,
+        },
+    }

@@ -6,6 +6,11 @@ user-facing story):
 - every scanned file is read and ``ast.parse``d exactly ONCE; each
   registered rule receives the same :class:`FileContext` (tree + source
   lines + package-relative path) — no rule re-reads or re-parses;
+- all files are parsed BEFORE any rule runs, and the full set is handed
+  to rules as a :class:`Project` (``self.project``) whose lazily-built
+  :class:`~ci.sparkdl_check.callgraph.CallGraph` gives every rule the
+  same whole-program view (cross-file call resolution + per-function
+  effect summaries), computed once per run;
 - rules are small classes registered with :func:`rule`; a rule scopes
   itself via :meth:`Rule.applies` (package-relative posix path), emits
   :class:`Finding`s from :meth:`Rule.check`, and may emit cross-file
@@ -17,7 +22,16 @@ user-facing story):
 - baseline: grandfathered findings listed in a checked-in JSON file
   (:mod:`ci.sparkdl_check.baseline`) move to ``baselined``; baseline
   entries that no longer match any finding are reported as
-  ``stale_baseline`` so the file cannot rot.
+  ``stale_baseline`` so the file cannot rot;
+- incremental cache (:mod:`ci.sparkdl_check.cache`): pass
+  ``cache_path`` and an unchanged tree replays the previous run's raw
+  findings without parsing; a partially-changed tree re-parses (the
+  graph must reflect reality) but skips re-running cacheable rules on
+  files whose content + dependency closure are unchanged.  The baseline
+  is matched fresh either way;
+- ``only_paths`` restricts *reporting* to the given files plus nothing
+  else, while stateful rules still see the whole tree — the
+  ``--changed-only`` pre-commit mode.
 
 Everything here is pure stdlib — the checker must start and finish in
 well under the 10 s acceptance budget, so it never imports jax, numpy,
@@ -27,11 +41,12 @@ or sparkdl_tpu itself.
 from __future__ import annotations
 
 import ast
+import hashlib
 import re
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
 
 #: severity levels, strongest first (display/sorting only: ANY
 #: non-baselined, non-suppressed finding fails the run)
@@ -93,6 +108,47 @@ class FileContext:
         return frozenset()
 
 
+class Project:
+    """The whole scanned tree, handed to every rule as ``self.project``:
+    all parsed files, the tests/ root (for cross-tree rules like
+    fault-site-coverage), and the lazily-built whole-program call graph
+    — built at most once per run, on first access, with its wall time
+    recorded for the report."""
+
+    def __init__(self, root: Path, files: Dict[str, FileContext],
+                 tests_root: Optional[Path] = None):
+        self.root = root
+        self.files = files
+        self.tests_root = tests_root
+        self.graph_build_s = 0.0
+        self._graph = None
+        self._test_sources: Optional[List[Tuple[str, str]]] = None
+
+    @property
+    def callgraph(self):
+        if self._graph is None:
+            from ci.sparkdl_check.callgraph import CallGraph
+
+            t0 = time.perf_counter()
+            self._graph = CallGraph(self.files)
+            self.graph_build_s = time.perf_counter() - t0
+        return self._graph
+
+    def test_sources(self) -> List[Tuple[str, str]]:
+        """(filename, source) for every test file — read once, shared
+        by every rule that cross-references tests/."""
+        if self._test_sources is None:
+            out: List[Tuple[str, str]] = []
+            if self.tests_root is not None and self.tests_root.is_dir():
+                for p in sorted(self.tests_root.rglob("*.py")):
+                    try:
+                        out.append((p.name, p.read_text()))
+                    except OSError:
+                        continue
+            self._test_sources = out
+        return self._test_sources
+
+
 class Rule:
     """Base class for one analyzer.  Subclass, set ``id``/``doc``, and
     register with the :func:`rule` decorator."""
@@ -103,6 +159,12 @@ class Rule:
     severity: str = "error"
     #: one-line statement of the invariant the rule encodes
     doc: str = ""
+    #: False for rules that accumulate cross-file state during check()
+    #: (their per-file results cannot be cached or skipped — lock-order
+    #: needs every file's acquisitions before finalize() makes sense)
+    cacheable: bool = True
+    #: the whole-program view; set by run_check before any check() call
+    project: Optional[Project] = None
 
     def applies(self, relpath: str) -> bool:
         """Whether this rule scans ``relpath`` (package-relative posix)."""
@@ -166,6 +228,10 @@ class Report:
     baselined: List[Finding] = field(default_factory=list)
     stale_baseline: List[dict] = field(default_factory=list)
     parse_errors: List[dict] = field(default_factory=list)
+    #: per-rule check+finalize seconds, parse_s, graph_build_s, total_s
+    timings: Dict[str, object] = field(default_factory=dict)
+    #: disabled | cold | partial | warm | changed-only
+    cache_status: str = "disabled"
 
     @property
     def exit_code(self) -> int:
@@ -196,17 +262,46 @@ def iter_python_files(root: Path) -> List[Path]:
     return sorted(p for p in root.rglob("*.py"))
 
 
+def _finish(report: Report, raw: List[Finding], suppressed: List[Finding],
+            baseline: Optional[dict], t0: float,
+            enforce_stale: bool = True) -> Report:
+    from ci.sparkdl_check.baseline import match_baseline
+
+    active, baselined, stale = match_baseline(raw, baseline)
+    sev_rank = {s: i for i, s in enumerate(SEVERITIES)}
+    active.sort(key=lambda f: (sev_rank.get(f.severity, 9), f.path, f.line))
+    report.findings = active
+    report.suppressed = suppressed
+    report.baselined = baselined
+    report.stale_baseline = stale if enforce_stale else []
+    report.elapsed_s = time.perf_counter() - t0
+    rules_t = report.timings.get("rules", {})
+    report.timings["rules"] = {
+        k: round(v, 4) for k, v in rules_t.items()
+    }
+    report.timings["total_s"] = round(report.elapsed_s, 4)
+    return report
+
+
 def run_check(
     root: Path,
     rule_ids: Optional[Sequence[str]] = None,
     baseline: Optional[dict] = None,
+    cache_path: Optional[Path] = None,
+    only_paths: Optional[Iterable[str]] = None,
 ) -> Report:
     """Scan ``root`` with the selected rules (default: all registered).
 
     ``baseline`` is the parsed baseline document (see
     :mod:`ci.sparkdl_check.baseline`); None means no grandfathering.
+    ``cache_path`` enables the incremental result cache (None — the
+    default, and what the test helpers use — disables it).
+    ``only_paths`` is the ``--changed-only`` mode: report findings only
+    for these package-relative paths plus their reverse call-graph
+    dependents; stale-baseline enforcement is skipped (entries for
+    unselected files would look stale) and the cache is bypassed.
     """
-    from ci.sparkdl_check.baseline import match_baseline
+    from ci.sparkdl_check import cache as _cache
 
     registered = all_rule_ids()  # importing the rules package registers them
     ids = list(rule_ids) if rule_ids else registered
@@ -218,39 +313,147 @@ def run_check(
     rules = [REGISTRY[i]() for i in ids]
     root = Path(root)
     report = Report(root=str(root), rules=ids)
+    report.timings = {"rules": {i: 0.0 for i in ids},
+                      "parse_s": 0.0, "graph_build_s": 0.0}
     t0 = time.perf_counter()
 
+    scan_base = root if root.is_dir() else root.parent
+    tests_root = None
+    for cand in (scan_base / "tests", scan_base.parent / "tests"):
+        if cand.is_dir():
+            tests_root = cand
+            break
+
+    # -- phase 0: read + hash every file (no parse yet) ----------------
+    blobs: Dict[str, Tuple[Path, bytes]] = {}
+    shas: Dict[str, str] = {}
+    for path in iter_python_files(root):
+        relpath = package_relpath(path, scan_base)
+        try:
+            data = path.read_bytes()
+        except OSError as e:
+            report.parse_errors.append({"path": relpath, "error": str(e)})
+            continue
+        blobs[relpath] = (path, data)
+        shas[relpath] = hashlib.sha256(data).hexdigest()
+
+    use_cache = cache_path is not None and only_paths is None
+    tdigest = _cache.digest_tree(tests_root) if use_cache else ""
+    cached = _cache.load_cache(cache_path) if use_cache else None
+
+    # -- warm fast path: nothing changed, replay the raw results -------
+    if (cached is not None and not report.parse_errors
+            and _cache.run_key_matches(cached, str(root), ids, shas,
+                                       tdigest)):
+        run = cached.get("run", {})
+        raw = [Finding(**f) for f in run.get("findings", [])]
+        sup = [Finding(**f) for f in run.get("suppressed", [])]
+        report.files_scanned = int(run.get("files_scanned", 0))
+        report.cache_status = "warm"
+        return _finish(report, raw, sup, baseline, t0)
+
+    # -- phase 1: parse everything (the graph must reflect reality) ----
+    t_parse = time.perf_counter()
+    files: Dict[str, FileContext] = {}
+    for relpath, (path, data) in blobs.items():
+        try:
+            source = data.decode()
+            tree = ast.parse(source, filename=str(path))  # the ONE parse
+        except (SyntaxError, UnicodeDecodeError, ValueError) as e:
+            report.parse_errors.append({"path": relpath, "error": str(e)})
+            continue
+        files[relpath] = FileContext(path, relpath, tree, source,
+                                     source.splitlines())
+    report.timings["parse_s"] = round(time.perf_counter() - t_parse, 4)
+
+    project = Project(root=scan_base, files=files, tests_root=tests_root)
+    for r in rules:
+        r.project = project
+
+    selected: Optional[Set[str]] = None
+    if only_paths is not None:
+        changed = {p for p in only_paths if p in files}
+        selected = changed | project.callgraph.reverse_file_dependents(
+            changed
+        )
+        report.cache_status = "changed-only"
+    elif use_cache:
+        report.cache_status = "cold"
+
+    # -- phase 2: per-file checks (with per-file cache reuse) ----------
     raw: List[Finding] = []
     suppressed: List[Finding] = []
-    for path in iter_python_files(root):
-        relpath = package_relpath(path, root if root.is_dir() else root.parent)
+    file_entries: Dict[str, dict] = {}
+    for relpath, ctx in files.items():
         applicable = [r for r in rules if r.applies(relpath)]
         if not applicable:
             continue
-        try:
-            source = path.read_text()
-            tree = ast.parse(source, filename=str(path))  # the ONE parse
-        except (SyntaxError, UnicodeDecodeError, OSError) as e:
-            report.parse_errors.append({"path": relpath, "error": str(e)})
-            continue
-        ctx = FileContext(path, relpath, tree, source, source.splitlines())
         report.files_scanned += 1
+        deps_sha = None
+        reusable = None
+        if use_cache:
+            deps_sha = _cache.deps_digest(
+                shas, project.callgraph.file_forward_closure(relpath)
+            )
+            if cached is not None:
+                reusable = _cache.reusable_file_rules(
+                    cached, relpath, shas[relpath], deps_sha
+                )
+        entry_rules: Dict[str, dict] = {}
         for r in applicable:
-            for f in r.check(ctx):
-                dis = ctx.suppressed_rules(f.line)
-                if f.rule in dis or "all" in dis:
-                    suppressed.append(f)
-                else:
-                    raw.append(f)
-    for r in rules:
-        raw.extend(r.finalize())
+            if (selected is not None and r.cacheable
+                    and relpath not in selected):
+                # changed-only: stateless rules skip unselected files;
+                # stateful ones still see the whole tree
+                continue
+            if reusable is not None and r.cacheable and r.id in reusable:
+                got = reusable[r.id]
+                active_f = [Finding(**d) for d in got.get("findings", [])]
+                sup_f = [Finding(**d) for d in got.get("suppressed", [])]
+                report.cache_status = "partial"
+            else:
+                t_r = time.perf_counter()
+                found = list(r.check(ctx))
+                report.timings["rules"][r.id] += time.perf_counter() - t_r
+                active_f, sup_f = [], []
+                for f in found:
+                    dis = ctx.suppressed_rules(f.line)
+                    if f.rule in dis or "all" in dis:
+                        sup_f.append(f)
+                    else:
+                        active_f.append(f)
+            raw.extend(active_f)
+            suppressed.extend(sup_f)
+            if use_cache and r.cacheable:
+                entry_rules[r.id] = {
+                    "findings": [f.to_dict() for f in active_f],
+                    "suppressed": [f.to_dict() for f in sup_f],
+                }
+        if use_cache:
+            file_entries[relpath] = {
+                "sha": shas[relpath], "deps_sha": deps_sha,
+                "rules": entry_rules,
+            }
 
-    active, baselined, stale = match_baseline(raw, baseline)
-    sev_rank = {s: i for i, s in enumerate(SEVERITIES)}
-    active.sort(key=lambda f: (sev_rank.get(f.severity, 9), f.path, f.line))
-    report.findings = active
-    report.suppressed = suppressed
-    report.baselined = baselined
-    report.stale_baseline = stale
-    report.elapsed_s = time.perf_counter() - t0
-    return report
+    # -- phase 3: cross-file finalize (always recomputed) --------------
+    for r in rules:
+        t_r = time.perf_counter()
+        raw.extend(r.finalize())
+        report.timings["rules"][r.id] += time.perf_counter() - t_r
+
+    if selected is not None:
+        raw = [f for f in raw if f.path in selected]
+        suppressed = [f for f in suppressed if f.path in selected]
+
+    report.timings["graph_build_s"] = round(project.graph_build_s, 4)
+
+    if use_cache and not report.parse_errors:
+        _cache.write_cache(cache_path, _cache.build_doc(
+            str(root), ids, shas, tdigest, file_entries,
+            [f.to_dict() for f in raw],
+            [f.to_dict() for f in suppressed],
+            report.files_scanned,
+        ))
+
+    return _finish(report, raw, suppressed, baseline, t0,
+                   enforce_stale=only_paths is None)

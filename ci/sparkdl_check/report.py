@@ -29,7 +29,7 @@ def text_report(report: Report) -> str:
     n = len(report.findings)
     summary = (
         f"{report.files_scanned} file(s), {len(report.rules)} rule(s), "
-        f"{report.elapsed_s:.2f}s: "
+        f"{report.elapsed_s:.2f}s [cache: {report.cache_status}]: "
         f"{n} finding(s), {len(report.suppressed)} suppressed, "
         f"{len(report.baselined)} baselined"
     )
@@ -56,6 +56,8 @@ def json_report(report: Report) -> str:
         "stale_baseline": report.stale_baseline,
         "parse_errors": report.parse_errors,
         "counts": counts,
+        "timings": report.timings,
+        "cache_status": report.cache_status,
         "exit_code": report.exit_code,
     }
     return json.dumps(doc, indent=2)

@@ -4,10 +4,13 @@ registry (each module uses the ``@rule`` decorator at import time)."""
 from ci.sparkdl_check.rules import (  # noqa: F401
     contextvar_leak,
     donation_safety,
+    exception_safety,
+    fault_sites,
     host_sync,
     lock_discipline,
     metric_names,
     raw_jit,
     recompile_hazard,
+    resource_lifecycle,
     sleep_retry,
 )

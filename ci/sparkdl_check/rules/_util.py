@@ -1,59 +1,15 @@
-"""Shared AST helpers for sparkdl_check rules."""
+"""Shared AST helpers for sparkdl_check rules.
 
-from __future__ import annotations
+The implementations live in :mod:`ci.sparkdl_check.astutil` (outside the
+rules package, so the call-graph builder can use them without importing
+the rule registry); this module re-exports them under the historical
+name every rule already imports.
+"""
 
-import ast
-from typing import Optional
-
-
-def dotted_name(node: ast.AST) -> Optional[str]:
-    """``a.b.c`` for Name/Attribute chains, else None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def target_name(node: ast.AST) -> Optional[str]:
-    """Assignment-target spelling for Name / Attribute / Subscript-base
-    targets: ``x``, ``self._x``, and for ``cache[k] = ...`` the container
-    ``cache`` (marking a container marks everything fetched from it)."""
-    if isinstance(node, ast.Subscript):
-        return dotted_name(node.value)
-    return dotted_name(node)
-
-
-def is_engine_receiver(func: ast.AST, attrs=("function", "program")) -> bool:
-    """True for calls spelled ``<something engine-ish>.function(...)`` /
-    ``.program(...)`` — receiver Name/Attribute whose final identifier
-    contains ``engine`` (covers ``engine``, ``_engine``,
-    ``self._engine``, ``get_engine()``)."""
-    if not (isinstance(func, ast.Attribute) and func.attr in attrs):
-        return False
-    recv = func.value
-    if isinstance(recv, ast.Call):  # get_engine().function(...)
-        recv = recv.func
-    name = dotted_name(recv)
-    if name is None:
-        return False
-    return "engine" in name.split(".")[-1].lower()
-
-
-def keyword(call: ast.Call, name: str) -> Optional[ast.AST]:
-    for kw in call.keywords:
-        if kw.arg == name:
-            return kw.value
-    return None
-
-
-def enclosing_map(tree: ast.AST):
-    """node -> parent for every node in the tree."""
-    parents = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
-    return parents
+from ci.sparkdl_check.astutil import (  # noqa: F401
+    dotted_name,
+    enclosing_map,
+    is_engine_receiver,
+    keyword,
+    target_name,
+)

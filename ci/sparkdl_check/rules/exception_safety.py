@@ -1,0 +1,267 @@
+"""``exception-safety`` — paired resources must survive exceptions.
+
+Three pairings, one failure mode: an exception between *take* and *give
+back* leaks the resource forever, because nothing ran the give-back.
+
+``lock.acquire()``
+    A manual ``acquire()`` on a known lock/semaphore/condition (the
+    lexical inventory from :mod:`ci.sparkdl_check.callgraph`) must sit
+    in a ``try`` whose ``finally`` calls ``release()`` on the same
+    spelling.  ``with lock:`` is always safe and always preferred; a
+    bare acquire/release pair deadlocks every other thread the first
+    time the code between them raises.
+
+``span = tracer.start_span(...)``
+    A manually-started span must be ``end()``-ed on every exit path.
+    Flagged when the function neither ends the span nor lets it escape
+    (returned, yielded, passed to a call, stored in an attribute /
+    container / subscript, or used as a context manager — escaped spans
+    are someone else's responsibility, e.g. the batcher parks the
+    request span on the future's done-callback).  Also flagged when the
+    ``end()`` IS in the same function but not inside a ``finally`` and
+    other calls stand between start and end — any of them raising skips
+    the end and the span leaks open in the trace ring.
+
+Semaphore slots follow the lock case (``Semaphore`` is in the lock-like
+inventory).  The analysis is deliberately per-function and lexical:
+cross-function protocols (acquire here, release there) are exactly the
+pattern ``with``-statements exist to kill, and get flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ci.sparkdl_check.callgraph import collect_lock_state
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name
+
+
+def _try_releases(try_node: ast.Try, spelling: str) -> bool:
+    for final_stmt in try_node.finalbody:
+        for sub in ast.walk(final_stmt):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "release"
+                    and dotted_name(sub.func.value) == spelling):
+                return True
+    return False
+
+
+def _finally_releases(fn_node: ast.AST, acquire: ast.Call,
+                      spelling: str) -> bool:
+    """True when ``acquire`` sits inside a Try whose finalbody releases
+    the same spelling, or is the statement immediately before one — the
+    canonical ``lock.acquire()`` then ``try/finally: lock.release()``
+    shape (acquire stays OUTSIDE the try so a failed acquire doesn't
+    release a lock it never took)."""
+    # map node -> parent within the function
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(fn_node):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    stmt: ast.AST = acquire
+    while stmt in parents and not isinstance(stmt, ast.stmt):
+        stmt = parents[stmt]
+    node = acquire
+    while node in parents:
+        node = parents[node]
+        if isinstance(node, ast.Try) and _try_releases(node, spelling):
+            return True
+    owner = parents.get(stmt)
+    if owner is not None:
+        for field in ("body", "orelse", "finalbody"):
+            seq = getattr(owner, field, None)
+            if isinstance(seq, list) and stmt in seq:
+                i = seq.index(stmt)
+                if (i + 1 < len(seq)
+                        and isinstance(seq[i + 1], ast.Try)
+                        and _try_releases(seq[i + 1], spelling)):
+                    return True
+    return False
+
+
+def _span_targets(fn_node: ast.AST) -> List[Tuple[str, ast.Assign]]:
+    """Names assigned from ``*.start_span(...)`` directly in this
+    function (not in nested defs)."""
+    out = []
+    for node in _walk_shallow(fn_node):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "start_span"):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.append((tgt.id, node))
+    return out
+
+
+def _walk_shallow(fn_node: ast.AST):
+    """Walk a function body without descending into nested defs/lambdas
+    (their bodies run on their own schedule)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _name_escapes(fn_node: ast.AST, name: str,
+                  assign: ast.Assign) -> bool:
+    """Whether ``name`` leaves this function's control: returned,
+    yielded, passed as a call argument, stored into an attribute /
+    subscript / container literal, or used as a context manager."""
+    def mentions(node) -> bool:
+        return any(
+            isinstance(sub, ast.Name) and sub.id == name
+            for sub in ast.walk(node)
+        )
+
+    for node in _walk_shallow(fn_node):
+        if node is assign:
+            continue
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            if node.value is not None and mentions(node.value):
+                return True
+        elif isinstance(node, ast.Call):
+            if any(mentions(a) for a in node.args) or any(
+                    kw.value is not None and mentions(kw.value)
+                    for kw in node.keywords):
+                return True
+        elif isinstance(node, ast.Assign):
+            # span stored somewhere that outlives the frame, or packed
+            # into a container that travels
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)) and \
+                        mentions(node.value):
+                    return True
+            if isinstance(node.value, (ast.Tuple, ast.List, ast.Dict,
+                                       ast.Set)) and mentions(node.value):
+                return True
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            if any(mentions(item.context_expr) for item in node.items):
+                return True
+    return False
+
+
+def _end_calls(fn_node: ast.AST, name: str) -> List[ast.Call]:
+    return [
+        node for node in _walk_shallow(fn_node)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "end"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == name
+    ]
+
+
+def _in_finally(fn_node: ast.AST, target: ast.AST) -> bool:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(fn_node):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    node = target
+    while node in parents:
+        parent = parents[node]
+        if isinstance(parent, ast.Try) and any(
+                node is stmt or any(node is sub for sub in ast.walk(stmt))
+                for stmt in parent.finalbody):
+            return True
+        node = parent
+    return False
+
+
+def _calls_between(fn_node: ast.AST, start_line: int,
+                   end_line: int, span_name: str) -> bool:
+    """Any call strictly between the start assignment and the end()
+    that could raise (calls on the span itself don't count)."""
+    for node in _walk_shallow(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        line = getattr(node, "lineno", 0)
+        if not (start_line < line < end_line):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and isinstance(
+                fn.value, ast.Name) and fn.value.id == span_name:
+            continue
+        return True
+    return False
+
+
+@rule
+class ExceptionSafetyRule(Rule):
+    id = "exception-safety"
+    severity = "error"
+    doc = ("manual lock acquire()s and started spans must be released/"
+           "ended on every exit path (try/finally or with)")
+
+    def applies(self, relpath: str) -> bool:
+        return not relpath.startswith("tests/")
+
+    def check(self, ctx: FileContext):
+        state = collect_lock_state(ctx.tree, ctx.relpath)
+        findings = []
+
+        def visit(node, class_stack):
+            if isinstance(node, ast.ClassDef):
+                class_stack = class_stack + [node.name]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(
+                    self._check_function(ctx, node, state, class_stack)
+                )
+            for child in ast.iter_child_nodes(node):
+                visit(child, class_stack)
+
+        visit(ctx.tree, [])
+        return findings
+
+    def _check_function(self, ctx, fn_node, state, class_stack):
+        # -- manual lock/semaphore acquires ----------------------------
+        for node in _walk_shallow(fn_node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"):
+                continue
+            spelling = dotted_name(node.func.value)
+            if spelling is None or not state.is_lock_like(
+                    class_stack, spelling):
+                continue
+            if not _finally_releases(fn_node, node, spelling):
+                yield self.finding(
+                    ctx, node,
+                    f"{spelling}.acquire() without a try/finally "
+                    f"releasing it — an exception before "
+                    f"{spelling}.release() deadlocks every other "
+                    "thread; use 'with' or release in a finally",
+                )
+        # -- manually-started spans ------------------------------------
+        for name, assign in _span_targets(fn_node):
+            ends = _end_calls(fn_node, name)
+            if not ends:
+                if not _name_escapes(fn_node, name, assign):
+                    yield self.finding(
+                        ctx, assign,
+                        f"span '{name}' started but never end()ed and "
+                        "never handed off — it stays open in the trace "
+                        "ring forever; end it in a finally or use the "
+                        "tracer's context manager",
+                    )
+                continue
+            for end in ends:
+                if _in_finally(fn_node, end):
+                    break
+            else:
+                last_end = max(e.lineno for e in ends)
+                if _calls_between(fn_node, assign.lineno, last_end, name):
+                    yield self.finding(
+                        ctx, assign,
+                        f"span '{name}' is end()ed outside any finally "
+                        "with raising calls in between — an exception "
+                        "skips the end() and leaks the span; move the "
+                        "end() into a finally",
+                    )

@@ -1,0 +1,82 @@
+"""``fault-site-coverage`` — every fault-injection site must be killed
+by at least one test.
+
+``resilience/inject.py`` lets a ``FaultPlan`` crash the process at
+named sites (``inject.fire("streaming.commit")``); the whole value of
+the mechanism is that each site has a test proving the system survives
+a kill *there*.  A new ``fire("x.y")`` with no test is a fault path
+nobody has ever exercised — exactly the untested-recovery-code class of
+outage the resilience layer exists to prevent.
+
+The rule is cross-tree: ``check()`` collects every **literal** site
+string passed to a ``fire(...)`` call anywhere under the scan root
+(dynamic sites like ``fire(f"watchdog.{name}")`` are statically
+unknowable and exempt), and ``finalize()`` greps the collected sites
+against every test source under ``tests/`` (read once, via the shared
+:class:`~ci.sparkdl_check.core.Project`).  A site string appearing
+anywhere in a test file counts — the convention is
+``FaultPlan().add("<site>", ...)``, and any spelling of it means a
+human pointed a test at that site.
+
+One finding per missing site (not per fire call), anchored at the
+first place it fires.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Tuple
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name
+
+
+@rule
+class FaultSiteCoverageRule(Rule):
+    id = "fault-site-coverage"
+    severity = "error"
+    doc = ("every literal FaultPlan fire() site must appear in at least "
+           "one test under tests/ — no untested fault paths")
+    cacheable = False  # accumulates sites during check(); finalize greps
+
+    def __init__(self):
+        # site -> (relpath, line) of the first fire
+        self.sites: Dict[str, Tuple[str, int]] = {}
+
+    def applies(self, relpath: str) -> bool:
+        return not relpath.startswith("tests/")
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted_name(node.func)
+            if name is None or name.split(".")[-1] not in (
+                    "fire", "_fire"):
+                continue
+            site = node.args[0]
+            if isinstance(site, ast.Constant) and isinstance(
+                    site.value, str) and site.value:
+                self.sites.setdefault(
+                    site.value, (ctx.relpath, node.lineno)
+                )
+        return ()
+
+    def finalize(self):
+        if not self.sites:
+            return
+        tests = self.project.test_sources() if self.project else []
+        if not tests:
+            # no tests/ tree next to the scan root (e.g. a bare fixture
+            # dir): nothing to cross-reference, stay silent rather than
+            # flagging every site of a tree that has its tests elsewhere
+            return
+        for site, (relpath, line) in sorted(self.sites.items()):
+            if any(site in source for _, source in tests):
+                continue
+            yield self.finding(
+                relpath, line,
+                f"fault site '{site}' is fired here but appears in no "
+                "test under tests/ — add a FaultPlan test that kills "
+                "the process at this site and proves recovery",
+            )

@@ -24,6 +24,14 @@ Sanctioned escapes: route fetches through ``DispatchWindow`` (dispatch
 the whole group, fetch as results land), or mark a deliberate
 synchronization point with ``# sparkdl: disable=host-sync`` (e.g. a
 warmup that *wants* to wait for compilation).
+
+Since PR 9 the rule is also **interprocedural**: a call from a hot file
+is resolved through the whole-program call graph and flagged when it
+reaches a function *outside* the hot packages whose body forces a
+device sync (``utils/`` helpers are the classic hiding spot — the old
+file-local scan never read them).  Chains that terminate inside a hot
+file are not re-flagged (the sync line itself is already reported
+there), and traversal never enters the sanctioned synchronizer.
 """
 
 from __future__ import annotations
@@ -159,6 +167,7 @@ class HostSyncRule(Rule):
                         "dispatch the whole group, then fetch through "
                         "DispatchWindow",
                     ))
+        findings.extend(self._hidden_syncs(ctx))
         # dedupe (module-level walk overlaps function walks)
         seen = set()
         out = []
@@ -168,3 +177,37 @@ class HostSyncRule(Rule):
                 seen.add(k)
                 out.append(f)
         return out
+
+    def _hidden_syncs(self, ctx: FileContext):
+        """Calls from this hot file into out-of-package helpers that
+        (transitively) force a device sync."""
+        if self.project is None:
+            return
+        graph = self.project.callgraph
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = graph.callee_of(ctx.relpath, node)
+            if callee is None:
+                continue
+            info = graph.info(callee)
+            if info is None or info.relpath.startswith(_HOT_PACKAGES):
+                # a hot-file callee is scanned by this rule itself: the
+                # chain gets reported at the call site that actually
+                # leaves the hot packages, exactly once
+                continue
+            hit = graph.transitive_effect(
+                callee, "host_sync", stop_relpaths=_SANCTIONED
+            )
+            if hit is None:
+                continue
+            chain, reason = hit
+            terminal = chain[-1]
+            yield self.finding(
+                ctx, node,
+                f"{chain[0].name}() forces a device→host sync ({reason} "
+                f"in {terminal.relpath}) from a hot path — via "
+                f"{graph.format_chain(chain, ctx.relpath)}; fetch through "
+                "DispatchWindow (or mark a deliberate sync with "
+                "'# sparkdl: disable=host-sync')",
+            )

@@ -21,6 +21,17 @@ Two rules share one pass:
     the call — in ``serving/`` that means health probes and the
     admission path.
 
+    Since PR 9 the indirect case is **interprocedural**: a call under a
+    held lock is resolved through the whole-program call graph
+    (:mod:`ci.sparkdl_check.callgraph`) and flagged when ANY function
+    within :data:`~ci.sparkdl_check.callgraph.MAX_DEPTH` call hops —
+    same file or not — blocks or compiles.  The finding prints the full
+    call chain (``flush() → commit() [streaming/sink.py] → fsync …``)
+    so the reader sees *why* the top call stalls.  The old check
+    followed exactly one level of same-file depth and was blind to
+    ``with lock: self._helper()`` whenever ``_helper`` lived one import
+    away.
+
 Lock identity is lexical: ``self._lock = threading.Lock()`` in class
 ``C`` of file ``f`` is the lock ``f:C:self._lock``; ``Condition(x)``
 aliases to ``x``'s lock (so ``with cond:`` holds the underlying lock,
@@ -34,165 +45,34 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
+from ci.sparkdl_check.callgraph import (
+    FileLockState,
+    blocking_reason,
+    collect_lock_state,
+)
 from ci.sparkdl_check.core import FileContext, Rule, rule
-from ci.sparkdl_check.rules._util import dotted_name, is_engine_receiver, keyword, target_name
+from ci.sparkdl_check.rules._util import dotted_name, is_engine_receiver
 
-_LOCK_CTORS = {"Lock", "RLock"}
-_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output"}
+# lock/blocking inventory now lives in callgraph.py (the graph builder
+# needs the same facts for its effect summaries); keep the old names
+# importable for anything that grew against them
+_FileLockState = FileLockState
+_collect = collect_lock_state
 
-
-class _FileLockState:
-    """Per-file lock/queue/event/condition inventory, keyed by the
-    spelling used at the assignment site within a class (or module)
-    scope."""
-
-    def __init__(self, relpath: str):
-        self.relpath = relpath
-        # (class_qualname, spelling) -> lock id
-        self.locks: Dict[Tuple[str, str], str] = {}
-        # spellings of Condition objects (their .wait releases the lock)
-        self.conditions: Set[Tuple[str, str]] = set()
-        self.events: Set[Tuple[str, str]] = set()
-        self.queues: Set[Tuple[str, str]] = set()
-        self.time_aliases: Set[str] = set()
-        self.sleep_aliases: Set[str] = set()
-
-    def lock_id(self, scopes: List[str], spelling: str) -> Optional[str]:
-        """Resolve a with-statement expression to a lock id, innermost
-        class scope outward, then module scope."""
-        for scope in reversed(scopes):
-            hit = self.locks.get((scope, spelling))
-            if hit:
-                return hit
-        return self.locks.get(("<module>", spelling))
-
-    def _in_scopes(self, table, scopes: List[str], spelling: str) -> bool:
-        return any((s, spelling) in table for s in reversed(scopes)) or (
-            ("<module>", spelling) in table
-        )
-
-    def is_condition(self, scopes, spelling):
-        return self._in_scopes(self.conditions, scopes, spelling)
-
-    def is_event(self, scopes, spelling):
-        return self._in_scopes(self.events, scopes, spelling)
-
-    def is_queue(self, scopes, spelling):
-        return self._in_scopes(self.queues, scopes, spelling)
+_ENGINE_PROGRAM_MSG = (
+    "engine program resolution under a lock — a cache miss "
+    "AOT-compiles for seconds while every other thread blocks"
+)
 
 
-def _ctor_name(value: ast.AST) -> Optional[str]:
-    """'Lock' for threading.Lock()/Lock(), 'Queue' for queue.Queue()…"""
-    if not isinstance(value, ast.Call):
-        return None
-    name = dotted_name(value.func)
-    if name is None:
-        return None
-    return name.split(".")[-1]
-
-
-def _collect(ctx: FileContext) -> _FileLockState:
-    state = _FileLockState(ctx.relpath)
-    for node in ast.walk(ctx.tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name == "time":
-                    state.time_aliases.add(a.asname or "time")
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            for a in node.names:
-                if a.name == "sleep":
-                    state.sleep_aliases.add(a.asname or "sleep")
-
-    def visit(node: ast.AST, class_stack: List[str]):
-        scope = class_stack[-1] if class_stack else "<module>"
-        if isinstance(node, (ast.Assign, ast.AnnAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) else (
-                [node.target] if node.target is not None else []
-            )
-            value = node.value
-            ctor = _ctor_name(value) if value is not None else None
-            for tgt in targets:
-                spelling = target_name(tgt)
-                if spelling is None or ctor is None:
-                    continue
-                key = (scope, spelling)
-                if ctor in _LOCK_CTORS:
-                    state.locks[key] = f"{state.relpath}:{scope}:{spelling}"
-                elif ctor == "Condition":
-                    state.conditions.add(key)
-                    # Condition(self._lock) guards the underlying lock;
-                    # a bare Condition() owns a fresh one
-                    under = None
-                    if value.args:
-                        under_spelling = dotted_name(value.args[0])
-                        if under_spelling is not None:
-                            under = state.locks.get((scope, under_spelling))
-                    state.locks[key] = (
-                        under or f"{state.relpath}:{scope}:{spelling}"
-                    )
-                elif ctor == "Event":
-                    state.events.add(key)
-                elif ctor in {"Queue", "SimpleQueue", "LifoQueue",
-                              "PriorityQueue"}:
-                    state.queues.add(key)
-        new_stack = class_stack
-        if isinstance(node, ast.ClassDef):
-            new_stack = class_stack + [node.name]
-        for child in ast.iter_child_nodes(node):
-            visit(child, new_stack)
-
-    visit(ctx.tree, [])
-    return state
-
-
-def _blocking_message(call: ast.Call, state: _FileLockState,
-                      scopes: List[str]) -> Optional[str]:
-    fn = call.func
-    name = dotted_name(fn)
-    # time.sleep (with import aliasing)
-    if isinstance(fn, ast.Attribute) and fn.attr == "sleep":
-        if isinstance(fn.value, ast.Name) and fn.value.id in state.time_aliases:
-            return "time.sleep while holding a lock"
-    if isinstance(fn, ast.Name) and fn.id in state.sleep_aliases:
-        return "time.sleep while holding a lock"
-    if name in ("jax.device_get", "jax.block_until_ready"):
-        return f"{name.split('.')[-1]} (device sync) while holding a lock"
-    if name is not None and name.startswith("subprocess."):
-        if name.split(".")[-1] in _SUBPROCESS_BLOCKING:
-            return f"{name} while holding a lock"
-    if not isinstance(fn, ast.Attribute):
-        return None
-    recv_spelling = dotted_name(fn.value)
-    attr = fn.attr
-    if attr == "block_until_ready" and not call.args:
-        return ".block_until_ready() (device sync) while holding a lock"
-    if attr == "result" and not call.args and keyword(call, "timeout") is None:
-        return "future.result() with no timeout while holding a lock"
-    if attr == "join" and not call.args and keyword(call, "timeout") is None:
-        return ".join() with no timeout while holding a lock"
-    if attr == "wait" and not call.args and keyword(call, "timeout") is None:
-        if recv_spelling is not None:
-            # Condition.wait RELEASES the lock while waiting — sanctioned
-            if state.is_condition(scopes, recv_spelling):
-                return None
-            if state.is_event(scopes, recv_spelling):
-                return "Event.wait() with no timeout while holding a lock"
-        return None
-    if attr in ("get", "put") and recv_spelling is not None:
-        if state.is_queue(scopes, recv_spelling):
-            block_kw = keyword(call, "block")
-            nonblocking = (
-                isinstance(block_kw, ast.Constant) and block_kw.value is False
-            )
-            if keyword(call, "timeout") is None and not nonblocking:
-                return (
-                    f"Queue.{attr} without a timeout while holding a lock"
-                )
-    if is_engine_receiver(fn, attrs=("program",)):
-        return (
-            "engine program resolution under a lock — a cache miss "
-            "AOT-compiles for seconds while every other thread blocks"
-        )
+def _direct_blocking_message(call: ast.Call, state: FileLockState,
+                             scopes: List[str]) -> Optional[str]:
+    """The lexical case: this very call blocks while the lock is held."""
+    reason = blocking_reason(call, state, scopes)
+    if reason is not None:
+        return f"{reason} while holding a lock"
+    if is_engine_receiver(call.func, attrs=("program",)):
+        return _ENGINE_PROGRAM_MSG
     return None
 
 
@@ -202,8 +82,8 @@ class LockOrderRule(Rule):
     severity = "error"
     doc = ("lock acquisition order must be globally consistent "
            "(acquisition-graph cycles are deadlocks waiting to happen)")
+    cacheable = False  # accumulates the global acquisition graph in check()
 
-    # class attribute shared per *instance* via __init__
     def __init__(self):
         # (lock_a, lock_b) -> list of (path, line, spell_a, spell_b)
         self.edges: Dict[Tuple[str, str], List[Tuple[str, int, str, str]]] = {}
@@ -212,7 +92,7 @@ class LockOrderRule(Rule):
         return not relpath.startswith("tests/")
 
     def check(self, ctx: FileContext):
-        state = _collect(ctx)
+        state = collect_lock_state(ctx.tree, ctx.relpath)
         if not state.locks:
             return ()
 
@@ -303,48 +183,47 @@ class LockOrderRule(Rule):
         return findings
 
 
-def _blocking_functions(ctx: FileContext, state: _FileLockState):
-    """One level of same-file call depth: function name -> the blocking
-    reason lexically inside its body.  ``with lock: self._build()`` is
-    just as stalled as ``with lock: subprocess.run(...)`` — the lexical
-    check alone would miss every blocking call hidden one ``def`` away."""
-    blocking: Dict[str, str] = {}
-
-    def visit(node, class_stack):
-        if isinstance(node, ast.ClassDef):
-            class_stack = class_stack + [node.name]
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for sub in ast.walk(node):
-                if isinstance(sub, ast.Call):
-                    msg = _blocking_message(sub, state, class_stack)
-                    if msg is not None:
-                        blocking.setdefault(
-                            node.name,
-                            msg.replace(" while holding a lock", ""),
-                        )
-                        break
-        for child in ast.iter_child_nodes(node):
-            visit(child, class_stack)
-
-    visit(ctx.tree, [])
-    return blocking
-
-
 @rule
 class LockBlockingRule(Rule):
     id = "lock-blocking"
     severity = "error"
     doc = ("no call that can block indefinitely (or compile for seconds) "
-           "while a lock is held")
+           "while a lock is held — transitively, across files")
 
     def applies(self, relpath: str) -> bool:
         return not relpath.startswith("tests/")
 
+    def _indirect_message(self, ctx: FileContext,
+                          call: ast.Call) -> Optional[str]:
+        """Resolve the call through the whole-program graph and look for
+        a blocking (or compiling) function within MAX_DEPTH hops."""
+        if self.project is None:
+            return None
+        graph = self.project.callgraph
+        callee = graph.callee_of(ctx.relpath, call)
+        if callee is None:
+            return None
+        hit = graph.transitive_effect(callee, "blocks")
+        if hit is not None:
+            chain, reason = hit
+            if len(chain) == 1 and chain[0].relpath == ctx.relpath:
+                # depth-1, same file: keep the established short form
+                return (f"{chain[0].name}() runs {reason} — "
+                        "called while holding a lock")
+            return (f"{chain[0].name}() reaches {reason} while a lock is "
+                    f"held — via {graph.format_chain(chain, ctx.relpath)}")
+        hit = graph.transitive_effect(callee, "compiles")
+        if hit is not None:
+            chain, _ = hit
+            return (f"{chain[0].name}() resolves an engine program (a "
+                    "cache miss AOT-compiles for seconds) while a lock "
+                    f"is held — via {graph.format_chain(chain, ctx.relpath)}")
+        return None
+
     def check(self, ctx: FileContext):
-        state = _collect(ctx)
+        state = collect_lock_state(ctx.tree, ctx.relpath)
         if not state.locks:
             return ()
-        blocking_fns = _blocking_functions(ctx, state)
         findings = []
 
         def visit(node, class_stack, held_depth):
@@ -360,20 +239,9 @@ class LockBlockingRule(Rule):
                             class_stack, spelling) is not None:
                         held_depth += 1
             if held_depth > 0 and isinstance(node, ast.Call):
-                msg = _blocking_message(node, state, class_stack)
+                msg = _direct_blocking_message(node, state, class_stack)
                 if msg is None:
-                    # one level of same-file indirection: f() where f's
-                    # body contains a blocking call
-                    callee = dotted_name(node.func)
-                    if callee is not None:
-                        bare = callee.split(".")[-1]
-                        if bare in blocking_fns and (
-                            callee == bare or callee == f"self.{bare}"
-                        ):
-                            msg = (
-                                f"{bare}() runs {blocking_fns[bare]} — "
-                                "called while holding a lock"
-                            )
+                    msg = self._indirect_message(ctx, node)
                 if msg is not None:
                     findings.append(self.finding(ctx, node, msg))
             for child in ast.iter_child_nodes(node):

@@ -18,6 +18,13 @@ The persistent compile cache (PR 5) keys executables on
    still fails CI unless suppressed or baselined, because the failure
    mode (one compile per distinct batch size) is exactly the stall the
    engine exists to prevent.
+
+Since PR 9 hazard №1 is also caught **interprocedurally**: calling — from
+inside a function — a helper whose body (transitively, across files)
+wraps an engine program with no ``fingerprint=`` is the same bug with a
+``def`` in between; every call of the helper mints a fresh anon cache
+key.  Lambda/local-closure wraps are excluded from the transitive form
+(they are already flagged at the wrap site itself by case 1).
 """
 
 from __future__ import annotations
@@ -68,6 +75,8 @@ class RecompileHazardRule(Rule):
                     ctx, node, in_function, local_defs, findings
                 )
                 self._check_scalar_args(ctx, node, wrapped, findings)
+                if in_function:
+                    self._check_transitive_wrap(ctx, node, findings)
             for child in ast.iter_child_nodes(node):
                 visit(child, in_function or enters_function, local_defs)
 
@@ -107,6 +116,28 @@ class RecompileHazardRule(Rule):
                 "fingerprint=...",
                 severity="warning",
             ))
+
+    def _check_transitive_wrap(self, ctx, call: ast.Call, findings) -> None:
+        """In-function call to a helper that (transitively) wraps an
+        engine program with no fingerprint: a fresh anon cache key per
+        call, with a def in between."""
+        if self.project is None or is_engine_receiver(call.func):
+            return
+        graph = self.project.callgraph
+        callee = graph.callee_of(ctx.relpath, call)
+        if callee is None:
+            return
+        hit = graph.transitive_effect(callee, "wraps_anon")
+        if hit is None:
+            return
+        chain, _ = hit
+        findings.append(self.finding(
+            ctx, call,
+            f"{chain[0].name}() wraps an engine program without "
+            "fingerprint= — calling it from here mints a fresh anon "
+            "cache key per call, so nothing ever hits the persistent "
+            f"compile cache; via {graph.format_chain(chain, ctx.relpath)}",
+        ))
 
     def _check_scalar_args(self, ctx, call: ast.Call, wrapped: Set[str],
                            findings) -> None:

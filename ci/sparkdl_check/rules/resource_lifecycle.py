@@ -1,0 +1,158 @@
+"""``resource-lifecycle`` — threads, executors, and servers must have a
+shutdown story.
+
+The streaming/serving/telemetry planes start real OS resources; each
+kind has exactly one acceptable lifecycle and this rule checks it
+lexically, file-wide:
+
+``threading.Thread(...)``
+    Must be constructed with ``daemon=True`` (the process can always
+    exit) OR be ``join()``-ed / marked ``.daemon = True`` somewhere in
+    the file under the spelling it was assigned to.  A non-daemon,
+    never-joined thread turns every crash into a hang: the interpreter
+    waits forever for a worker nobody will stop.
+
+``ThreadPoolExecutor(...)`` / ``ProcessPoolExecutor(...)``
+    Must be used as a context manager or have ``.shutdown(`` called on
+    its spelling somewhere in the file — otherwise worker threads (and
+    their queued work) outlive the owner.
+
+``ThreadingHTTPServer(...)`` / ``HTTPServer(...)``
+    Must have ``.shutdown(`` or ``.server_close(`` reachable on its
+    spelling — a serve-forever loop with no stop path holds the port
+    until the process dies.
+
+"Somewhere in the file under the same spelling" is deliberately
+generous: lifecycle protocols legitimately split across methods
+(``start()`` assigns ``self._thread``, ``stop()`` joins it).  What the
+rule refuses is a resource with NO spelled-out reclaim path at all.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Set
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name, keyword, target_name
+
+_EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_SERVER_CTORS = {"ThreadingHTTPServer", "HTTPServer"}
+
+
+def _ctor(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    return name.split(".")[-1] if name else None
+
+
+def _assigned_spelling(parents, call: ast.Call) -> Optional[str]:
+    parent = parents.get(call)
+    if isinstance(parent, ast.Assign):
+        for tgt in parent.targets:
+            spelling = target_name(tgt)
+            if spelling is not None:
+                return spelling
+    return None
+
+
+@rule
+class ResourceLifecycleRule(Rule):
+    id = "resource-lifecycle"
+    severity = "error"
+    doc = ("threads need daemon=/join, executors need shutdown/with, "
+           "servers need shutdown/server_close — no resource without a "
+           "reclaim path")
+
+    def applies(self, relpath: str) -> bool:
+        return not relpath.startswith("tests/")
+
+    def check(self, ctx: FileContext):
+        parents = {}
+        with_exprs = []
+        attr_calls: Set[tuple] = set()   # (spelling, attr) called
+        daemon_sets: Set[str] = set()    # spellings with .daemon = True
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_exprs.append(item.context_expr)
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                recv = dotted_name(node.func.value)
+                if recv is not None:
+                    attr_calls.add((recv, node.func.attr))
+                    # spelling aliases: 'self._thread' also reclaims
+                    # bare '_thread' patterns like `t = self._thread`
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.targets[0], ast.Attribute) and \
+                    node.targets[0].attr == "daemon" and isinstance(
+                    node.value, ast.Constant) and node.value.value is True:
+                base = dotted_name(node.targets[0].value)
+                if base is not None:
+                    daemon_sets.add(base)
+
+        # `t = self._thread; t.join()` style: follow one simple alias hop
+        aliases = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                src = dotted_name(node.value) if not isinstance(
+                    node.value, ast.Call) else None
+                if src is not None:
+                    aliases.setdefault(src, set()).add(node.targets[0].id)
+
+        def reclaimed(spelling: str, attrs) -> bool:
+            candidates = {spelling} | aliases.get(spelling, set())
+            return any(
+                (c, a) in attr_calls for c in candidates for a in attrs
+            )
+
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            ctor = _ctor(node)
+            spelling = _assigned_spelling(parents, node)
+            in_with = any(
+                node is expr or (
+                    isinstance(expr, ast.Call) and node is expr
+                ) for expr in with_exprs
+            )
+            if ctor == "Thread":
+                dm = keyword(node, "daemon")
+                if isinstance(dm, ast.Constant) and dm.value is True:
+                    continue
+                if spelling is not None and (
+                        spelling in daemon_sets
+                        or reclaimed(spelling, ("join",))):
+                    continue
+                findings.append(self.finding(
+                    ctx, node,
+                    "Thread created without daemon=True and never "
+                    "join()ed — a non-daemon worker nobody stops turns "
+                    "every shutdown into a hang",
+                ))
+            elif ctor in _EXECUTOR_CTORS:
+                if in_with:
+                    continue
+                if spelling is not None and reclaimed(
+                        spelling, ("shutdown",)):
+                    continue
+                findings.append(self.finding(
+                    ctx, node,
+                    f"{ctor} with no shutdown path — use it as a "
+                    "context manager or call .shutdown() so worker "
+                    "threads don't outlive the owner",
+                ))
+            elif ctor in _SERVER_CTORS:
+                if spelling is not None and reclaimed(
+                        spelling, ("shutdown", "server_close")):
+                    continue
+                findings.append(self.finding(
+                    ctx, node,
+                    f"{ctor} with no shutdown()/server_close() path — "
+                    "a serve-forever loop with no stop holds the port "
+                    "until the process dies",
+                ))
+        return findings

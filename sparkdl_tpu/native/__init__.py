@@ -33,6 +33,8 @@ _SRC_PATH = os.path.join(_HERE, "batchpack.cpp")
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+#: set while one thread runs the build/dlopen; later callers wait on it
+_inflight: Optional[threading.Event] = None
 
 
 def _build() -> bool:
@@ -69,72 +71,101 @@ def _build() -> bool:
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if os.environ.get("SPARKDL_NO_NATIVE") == "1":
+    """Resolve the library handle, building at most once (single-flight).
+
+    The slow work — the g++ subprocess and the dlopen — runs OUTSIDE
+    ``_lock``: the first caller claims the build by planting an Event
+    under the lock, every later caller waits on that Event (not on the
+    lock, which stays free), and the result is admitted under the lock
+    once ready.  Same shape as ``serving/cache.py``'s ProgramCache —
+    holding a lock across a multi-second subprocess stalls every thread
+    that so much as *checks* availability (the lock-blocking rule).
+    """
+    global _lib, _tried, _inflight
+    while True:
+        with _lock:
+            if _tried:
+                return _lib
+            if _inflight is None:
+                _inflight = claim = threading.Event()
+                break
+            waiter = _inflight
+        waiter.wait()
+    lib = None
+    try:
+        lib = _resolve()
+    finally:
+        with _lock:
+            _lib = lib
+            _tried = True
+            _inflight = None
+        claim.set()
+    return lib
+
+
+def _resolve() -> Optional[ctypes.CDLL]:
+    """Build (if needed) + dlopen + bind signatures.  Runs with no lock
+    held, in exactly one thread per process (see :func:`_load`)."""
+    if os.environ.get("SPARKDL_NO_NATIVE") == "1":
+        return None
+    try:
+        src_mtime = os.path.getmtime(_SRC_PATH)
+    except OSError:
+        src_mtime = None  # source not shipped (wheel install)
+    so_exists = os.path.exists(_SO_PATH)
+    stale = (
+        src_mtime is not None
+        and so_exists
+        and os.path.getmtime(_SO_PATH) < src_mtime
+    )
+    if not so_exists or stale:
+        if src_mtime is None or not _build():
             return None
-        try:
-            src_mtime = os.path.getmtime(_SRC_PATH)
-        except OSError:
-            src_mtime = None  # source not shipped (wheel install)
-        so_exists = os.path.exists(_SO_PATH)
-        stale = (
-            src_mtime is not None
-            and so_exists
-            and os.path.getmtime(_SO_PATH) < src_mtime
-        )
-        if not so_exists or stale:
-            if src_mtime is None or not _build():
-                return None
-        try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
-            logger.warning("native bridge load failed: %s", e)
-            return None
-        if lib.sdl_abi_version() != 1:
-            logger.warning("native bridge ABI mismatch; ignoring")
-            return None
-        lib.sdl_pack_resize_batch.restype = ctypes.c_int64
-        lib.sdl_pack_resize_batch.argtypes = [
-            ctypes.POINTER(ctypes.c_void_p),  # datas
-            ctypes.POINTER(ctypes.c_int32),   # heights
-            ctypes.POINTER(ctypes.c_int32),   # widths
-            ctypes.POINTER(ctypes.c_int32),   # channels
-            ctypes.POINTER(ctypes.c_int32),   # modes
-            ctypes.c_int64,                   # n
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # out h/w/c
-            ctypes.c_int32,                   # bgr_to_rgb
-            ctypes.POINTER(ctypes.c_float),   # out
-            ctypes.c_int32,                   # n_threads
-        ]
-        lib.sdl_pack_batch_u8.restype = ctypes.c_int64
-        lib.sdl_pack_batch_u8.argtypes = [
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_uint8),
-            ctypes.c_int32,
-        ]
-        lib.sdl_resize_batch_f32.restype = ctypes.c_int64
-        lib.sdl_resize_batch_f32.argtypes = [
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int64,
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int32,
-        ]
-        _lib = lib
-        logger.info("native columnar bridge loaded (%s)", _SO_PATH)
-        return _lib
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError as e:
+        logger.warning("native bridge load failed: %s", e)
+        return None
+    if lib.sdl_abi_version() != 1:
+        logger.warning("native bridge ABI mismatch; ignoring")
+        return None
+    lib.sdl_pack_resize_batch.restype = ctypes.c_int64
+    lib.sdl_pack_resize_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),  # datas
+        ctypes.POINTER(ctypes.c_int32),   # heights
+        ctypes.POINTER(ctypes.c_int32),   # widths
+        ctypes.POINTER(ctypes.c_int32),   # channels
+        ctypes.POINTER(ctypes.c_int32),   # modes
+        ctypes.c_int64,                   # n
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,  # out h/w/c
+        ctypes.c_int32,                   # bgr_to_rgb
+        ctypes.POINTER(ctypes.c_float),   # out
+        ctypes.c_int32,                   # n_threads
+    ]
+    lib.sdl_pack_batch_u8.restype = ctypes.c_int64
+    lib.sdl_pack_batch_u8.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int32,
+    ]
+    lib.sdl_resize_batch_f32.restype = ctypes.c_int64
+    lib.sdl_resize_batch_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int32,
+    ]
+    logger.info("native columnar bridge loaded (%s)", _SO_PATH)
+    return lib
 
 
 def is_available() -> bool:

@@ -42,6 +42,8 @@ DEFAULT_PLUGIN = os.environ.get(
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+#: set while one thread runs the build/dlopen; later callers wait on it
+_inflight: Optional[threading.Event] = None
 
 
 def _xla_include_dir() -> Optional[str]:
@@ -85,90 +87,116 @@ def _build() -> bool:
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _tried
-    with _lock:
-        if _tried:
-            return _lib
-        _tried = True
-        if os.environ.get("SPARKDL_NO_NATIVE") == "1":
-            return None
-        stale = (
-            not os.path.exists(_SO_PATH)
-            or os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH)
-        )
-        if stale and not _build():
-            return None
-        try:
-            lib = ctypes.CDLL(_SO_PATH)
-        except OSError as e:
-            logger.warning("pjrt runner dlopen failed: %s", e)
-            return None
-        lib.pjrt_runner_create_opts.restype = ctypes.c_void_p
-        lib.pjrt_runner_create_opts.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_char_p),
-            ctypes.POINTER(ctypes.c_char_p),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.c_int32, ctypes.c_char_p, ctypes.c_int,
-        ]
-        lib.pjrt_runner_last_error.restype = ctypes.c_char_p
-        lib.pjrt_runner_last_error.argtypes = [ctypes.c_void_p]
-        lib.pjrt_runner_platform.restype = ctypes.c_int
-        lib.pjrt_runner_platform.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
-        ]
-        lib.pjrt_runner_compile.restype = ctypes.c_int64
-        lib.pjrt_runner_compile.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
-            ctypes.c_char_p, ctypes.c_int64,
-        ]
-        lib.pjrt_runner_num_outputs.restype = ctypes.c_int64
-        lib.pjrt_runner_num_outputs.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64,
-        ]
-        lib.pjrt_runner_put.restype = ctypes.c_int64
-        lib.pjrt_runner_put.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
-        ]
-        lib.pjrt_runner_put_async.restype = ctypes.c_int64
-        lib.pjrt_runner_put_async.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
-        ]
-        lib.pjrt_runner_await_buffer.restype = ctypes.c_int
-        lib.pjrt_runner_await_buffer.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64,
-        ]
-        lib.pjrt_runner_free_buffer.restype = ctypes.c_int
-        lib.pjrt_runner_free_buffer.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64,
-        ]
-        lib.pjrt_runner_execute.restype = ctypes.c_int64
-        lib.pjrt_runner_execute.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.pjrt_runner_execute_async.restype = ctypes.c_int64
-        lib.pjrt_runner_execute_async.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.pjrt_runner_buffer_size.restype = ctypes.c_int64
-        lib.pjrt_runner_buffer_size.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64,
-        ]
-        lib.pjrt_runner_get.restype = ctypes.c_int
-        lib.pjrt_runner_get.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
-        ]
-        lib.pjrt_runner_destroy.restype = None
-        lib.pjrt_runner_destroy.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    """Resolve the runner library, building at most once (single-flight).
+
+    Mirrors ``native/__init__.py``: the g++ subprocess and the dlopen
+    run with NO lock held — the first caller claims the build via an
+    Event planted under ``_lock``, later callers wait on the Event, and
+    the handle is admitted under the lock once ready.
+    """
+    global _lib, _tried, _inflight
+    while True:
+        with _lock:
+            if _tried:
+                return _lib
+            if _inflight is None:
+                _inflight = claim = threading.Event()
+                break
+            waiter = _inflight
+        waiter.wait()
+    lib = None
+    try:
+        lib = _resolve()
+    finally:
+        with _lock:
+            _lib = lib
+            _tried = True
+            _inflight = None
+        claim.set()
+    return lib
+
+
+def _resolve() -> Optional[ctypes.CDLL]:
+    """Build (if needed) + dlopen + bind signatures.  Runs with no lock
+    held, in exactly one thread per process (see :func:`_load`)."""
+    if os.environ.get("SPARKDL_NO_NATIVE") == "1":
+        return None
+    stale = (
+        not os.path.exists(_SO_PATH)
+        or os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH)
+    )
+    if stale and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError as e:
+        logger.warning("pjrt runner dlopen failed: %s", e)
+        return None
+    lib.pjrt_runner_create_opts.restype = ctypes.c_void_p
+    lib.pjrt_runner_create_opts.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.pjrt_runner_last_error.restype = ctypes.c_char_p
+    lib.pjrt_runner_last_error.argtypes = [ctypes.c_void_p]
+    lib.pjrt_runner_platform.restype = ctypes.c_int
+    lib.pjrt_runner_platform.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.pjrt_runner_compile.restype = ctypes.c_int64
+    lib.pjrt_runner_compile.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64,
+    ]
+    lib.pjrt_runner_num_outputs.restype = ctypes.c_int64
+    lib.pjrt_runner_num_outputs.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.pjrt_runner_put.restype = ctypes.c_int64
+    lib.pjrt_runner_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+    ]
+    lib.pjrt_runner_put_async.restype = ctypes.c_int64
+    lib.pjrt_runner_put_async.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+    ]
+    lib.pjrt_runner_await_buffer.restype = ctypes.c_int
+    lib.pjrt_runner_await_buffer.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.pjrt_runner_free_buffer.restype = ctypes.c_int
+    lib.pjrt_runner_free_buffer.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.pjrt_runner_execute.restype = ctypes.c_int64
+    lib.pjrt_runner_execute.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.pjrt_runner_execute_async.restype = ctypes.c_int64
+    lib.pjrt_runner_execute_async.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.pjrt_runner_buffer_size.restype = ctypes.c_int64
+    lib.pjrt_runner_buffer_size.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.pjrt_runner_get.restype = ctypes.c_int
+    lib.pjrt_runner_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.pjrt_runner_destroy.restype = None
+    lib.pjrt_runner_destroy.argtypes = [ctypes.c_void_p]
+    return lib
 
 
 def is_available() -> bool:

@@ -289,6 +289,46 @@ def test_preemption_mid_epoch_resumes_bit_identical(tmp_path, caplog):
     assert baseline._training_loss == resumed._training_loss
 
 
+def test_preemption_at_epoch_boundary_resumes(tmp_path, caplog):
+    """The ``estimator.epoch`` fault site (the one spot the step/commit
+    tests above never hit): a preemption flagged exactly at the epoch
+    boundary — after epoch 1's steps, before its checkpoint dispatch —
+    must still flush epoch 1's checkpoint and let an identical re-fit
+    resume from it.  Found by sparkdl_check's fault-site-coverage rule:
+    every fired site needs at least one test that proves recovery."""
+    from sparkdl_tpu.estimators import checkpointing
+    from sparkdl_tpu.resilience import FaultPlan, Preempted, active_plan
+
+    workdir = str(tmp_path)
+    build_fixtures(workdir)
+    df = make_df(workdir)
+
+    est = make_estimator(workdir, epochs=2)
+    plan = FaultPlan().add("estimator.epoch", preempt=True, at=1)
+    with active_plan(plan):
+        with pytest.raises(Preempted, match="injected preemption"):
+            est.fit(df)
+
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    namespace = est._ckpt_namespace()
+    assert checkpointing.committed_epochs(ckpt_dir, namespace) == [1], (
+        "the epoch-boundary preemption must still commit epoch 1"
+    )
+
+    import logging
+
+    with caplog.at_level(
+        logging.INFO,
+        logger="sparkdl_tpu.estimators.keras_image_file_estimator",
+    ):
+        model = make_estimator(workdir, epochs=2).fit(df)
+    assert model is not None and np.isfinite(model._training_loss)
+    assert any(
+        "resuming from checkpoint epoch 1" in r.message
+        for r in caplog.records
+    ), "restart did not resume from the epoch committed before preemption"
+
+
 # ---------------------------------------------------------------------------
 # online serving faults: every failure mode must surface as a TYPED error
 # on the affected request's future, leave the worker serving, and keep the

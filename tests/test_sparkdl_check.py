@@ -32,6 +32,37 @@ def check_snippet(tmp_path, relpath, source, rules=None, baseline=None):
     return run_check(tmp_path, rule_ids=rules, baseline=baseline)
 
 
+def check_files(tmp_path, files, rules=None, baseline=None,
+                cache_path=None, only_paths=None):
+    """Multi-file fixture tree (cross-file rules need more than one
+    file).  Imports inside fixtures must be spelled relative to the scan
+    root (``from helper import f``), exactly as sparkdl_tpu modules
+    import each other relative to the package root."""
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    return run_check(tmp_path, rule_ids=rules, baseline=baseline,
+                     cache_path=cache_path, only_paths=only_paths)
+
+
+def build_graph(tmp_path, files):
+    """A CallGraph over fixture files, for the unit tests below."""
+    import ast
+
+    from ci.sparkdl_check.callgraph import CallGraph
+    from ci.sparkdl_check.core import FileContext
+
+    ctxs = {}
+    for relpath, source in files.items():
+        src = textwrap.dedent(source)
+        path = tmp_path / relpath
+        ctxs[relpath] = FileContext(
+            path, relpath, ast.parse(src), src, src.splitlines()
+        )
+    return CallGraph(ctxs)
+
+
 def rule_lines(report, rule_id):
     return [f.line for f in report.findings if f.rule == rule_id]
 
@@ -40,11 +71,12 @@ def rule_lines(report, rule_id):
 # framework plumbing
 # ---------------------------------------------------------------------------
 
-def test_registry_has_all_nine_rules():
+def test_registry_has_all_twelve_rules():
     assert set(all_rule_ids()) == {
         "lock-order", "lock-blocking", "host-sync", "recompile-hazard",
         "donation-safety", "contextvar-leak", "sleep-retry", "metric-name",
-        "raw-jit",
+        "raw-jit", "exception-safety", "resource-lifecycle",
+        "fault-site-coverage",
     }
 
 
@@ -863,3 +895,671 @@ def test_repo_telemetry_plane_is_clean(repo_report):
              if f.path in ("obs/server.py", "obs/blackbox.py",
                            "obs/timeseries.py", "obs/slo.py")]
     assert dirty == [], dirty
+
+
+# ---------------------------------------------------------------------------
+# PR 9: the whole-program call graph
+# ---------------------------------------------------------------------------
+
+def test_callgraph_resolves_import_aliases(tmp_path):
+    graph = build_graph(tmp_path, {
+        "helper.py": """
+            def slow():
+                pass
+            """,
+        "a.py": """
+            import helper as h
+            from helper import slow as renamed
+
+            def use_module():
+                h.slow()
+
+            def use_from():
+                renamed()
+            """,
+    })
+    def callees(qname):
+        return {q for _line, q in graph.info(qname).calls}
+
+    assert "helper.py::slow" in callees("a.py::use_module")
+    assert "helper.py::slow" in callees("a.py::use_from")
+
+
+def test_callgraph_resolves_methods_and_instances(tmp_path):
+    graph = build_graph(tmp_path, {
+        "w.py": """
+            import time
+
+            class Worker:
+                def run(self):
+                    self.step()
+
+                def step(self):
+                    time.sleep(1.0)
+
+            class Owner:
+                def __init__(self):
+                    self._w = Worker()
+
+                def go(self):
+                    self._w.run()
+            """,
+    })
+    def callees(qname):
+        return {q for _line, q in graph.info(qname).calls}
+
+    assert "w.py::Worker.step" in callees("w.py::Worker.run")
+    assert "w.py::Worker.run" in callees("w.py::Owner.go")
+    # effect summaries ride on the nodes: step blocks, and the block is
+    # reachable transitively from the owner
+    hit = graph.transitive_effect("w.py::Owner.go", "blocks")
+    assert hit is not None
+    chain, reason = hit
+    assert reason == "time.sleep"
+    assert [i.qname for i in chain] == [
+        "w.py::Owner.go", "w.py::Worker.run", "w.py::Worker.step",
+    ]
+
+
+def test_callgraph_tolerates_cycles(tmp_path):
+    graph = build_graph(tmp_path, {
+        "c.py": """
+            import time
+
+            def ping():
+                pong()
+
+            def pong():
+                ping()
+
+            def ping_blocking():
+                pong_blocking()
+
+            def pong_blocking():
+                ping_blocking()
+                time.sleep(1.0)
+            """,
+    })
+    # a pure cycle with no effect terminates with no hit
+    assert graph.transitive_effect("c.py::ping", "blocks") is None
+    # a cycle WITH an effect still reports it exactly once
+    hit = graph.transitive_effect("c.py::ping_blocking", "blocks")
+    assert hit is not None and hit[1] == "time.sleep"
+
+
+def test_callgraph_depth_is_bounded(tmp_path):
+    from ci.sparkdl_check.callgraph import MAX_DEPTH
+
+    chain_src = ["import time", ""]
+    for i in range(6):
+        chain_src += [f"def f{i}():", f"    f{i + 1}()", ""]
+    chain_src += ["def f6():", "    time.sleep(1.0)", ""]
+    graph = build_graph(tmp_path, {"deep.py": "\n".join(chain_src)})
+    # a chain of MAX_DEPTH hops is still found...
+    near = graph.transitive_effect(
+        f"deep.py::f{6 - MAX_DEPTH}", "blocks"
+    )
+    assert near is not None and len(near[0]) == MAX_DEPTH + 1
+    # ...but one hop further out the bounded search deliberately stops
+    assert graph.transitive_effect(
+        f"deep.py::f{5 - MAX_DEPTH}", "blocks"
+    ) is None
+
+
+def test_callgraph_reverse_file_dependents(tmp_path):
+    graph = build_graph(tmp_path, {
+        "helper.py": "def slow():\n    pass\n",
+        "mid.py": "from helper import slow\ndef go():\n    slow()\n",
+        "top.py": "import mid\ndef run():\n    mid.go()\n",
+        "island.py": "def alone():\n    pass\n",
+    })
+    deps = graph.reverse_file_dependents({"helper.py"})
+    assert "mid.py" in deps and "top.py" in deps
+    assert "island.py" not in deps
+
+
+# ---------------------------------------------------------------------------
+# PR 9: interprocedural upgrades of the existing rules
+# ---------------------------------------------------------------------------
+
+CROSSFILE_HELPER = """
+import subprocess
+
+def slow_helper():
+    subprocess.run(["true"])
+
+def mid():
+    slow_helper()
+"""
+
+CROSSFILE_MAIN = """
+import threading
+from helper import mid, slow_helper
+
+_lock = threading.Lock()
+
+def flush_direct():
+    with _lock:
+        slow_helper()
+
+def flush_chain():
+    with _lock:
+        mid()
+"""
+
+
+def test_lock_blocking_crosses_files_with_chain(tmp_path):
+    """THE fixture the old file-local check was blind to: the blocking
+    call lives one import away from the `with lock:`."""
+    report = check_files(
+        tmp_path,
+        {"helper.py": CROSSFILE_HELPER, "serving/main.py": CROSSFILE_MAIN},
+        rules=["lock-blocking"],
+    )
+    msgs = sorted(f.message for f in report.findings)
+    assert len(msgs) == 2, msgs
+    assert any(
+        "slow_helper() reaches subprocess.run" in m and "[helper.py]" in m
+        for m in msgs
+    )
+    # the depth-2 chain prints every hop so the reader sees WHY; the
+    # file tag lands on the hop that leaves the calling file
+    assert any(
+        "mid() reaches subprocess.run" in m
+        and "mid() [helper.py] → slow_helper()" in m
+        for m in msgs
+    )
+
+
+def test_lock_blocking_same_file_keeps_short_message(tmp_path):
+    # depth-1 same-file findings keep the established message shape
+    # (the baseline format from previous rounds)
+    report = check_snippet(
+        tmp_path, "serving/x.py",
+        """
+        import subprocess
+        import threading
+
+        _lock = threading.Lock()
+
+        def _build():
+            subprocess.run(["true"])
+
+        def load():
+            with _lock:
+                _build()
+        """,
+        rules=["lock-blocking"],
+    )
+    assert len(report.findings) == 1
+    assert report.findings[0].message == (
+        "_build() runs subprocess.run — called while holding a lock"
+    )
+
+
+def test_host_sync_hidden_in_helper_file(tmp_path):
+    """A hot-path call into a utils/ helper that forces a device sync:
+    invisible to the old per-file scan, flagged with the chain now."""
+    report = check_files(
+        tmp_path,
+        {
+            "util_helpers.py": """
+                import jax
+
+                def fetch_scalar(x):
+                    return jax.device_get(x)
+                """,
+            "serving/hot.py": """
+                from util_helpers import fetch_scalar
+
+                def hot(batch):
+                    return fetch_scalar(batch)
+                """,
+        },
+        rules=["host-sync"],
+    )
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert f.path == "serving/hot.py"
+    assert "forces a device→host sync" in f.message
+    assert "util_helpers.py" in f.message
+
+
+def test_host_sync_sanctioned_executor_not_traversed(tmp_path):
+    # chains that terminate in the sanctioned synchronizer are the
+    # DispatchWindow protocol working as designed, not a finding
+    report = check_files(
+        tmp_path,
+        {
+            "engine/executor.py": """
+                import jax
+
+                def fetch(x):
+                    return jax.device_get(x)
+                """,
+            "serving/hot.py": """
+                from engine.executor import fetch
+
+                def hot(batch):
+                    return fetch(batch)
+                """,
+        },
+        rules=["host-sync"],
+    )
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+def test_recompile_hazard_transitive_anon_wrap(tmp_path):
+    report = check_files(
+        tmp_path,
+        {
+            "mathops.py": "def fwd(x):\n    return x\n",
+            "wraps.py": """
+                from sparkdl_tpu.engine import engine
+                from mathops import fwd
+
+                def make_program():
+                    return engine.function(fwd)
+                """,
+            "serving/hot.py": """
+                from wraps import make_program
+
+                def per_call(batch):
+                    return make_program()(batch)
+                """,
+        },
+        rules=["recompile-hazard"],
+    )
+    hot = [f for f in report.findings if f.path == "serving/hot.py"]
+    assert len(hot) == 1, [f.message for f in report.findings]
+    assert "make_program() wraps an engine program" in hot[0].message
+    assert "[wraps.py]" in hot[0].message
+
+
+# ---------------------------------------------------------------------------
+# PR 9: exception-safety
+# ---------------------------------------------------------------------------
+
+EXCEPTION_SAFETY_TP = """
+import threading
+
+_lock = threading.Lock()
+
+def bad_acquire():
+    _lock.acquire()
+    do_work()
+    _lock.release()
+
+def bad_span_no_finally(tracer):
+    sp = tracer.start_span("x")
+    do_work()
+    sp.end()
+
+def bad_span_never_ended(tracer):
+    sp = tracer.start_span("y")
+    do_work()
+
+def do_work():
+    pass
+"""
+
+
+def test_exception_safety_true_positives(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/x.py", EXCEPTION_SAFETY_TP,
+        rules=["exception-safety"],
+    )
+    msgs = sorted(f.message for f in report.findings)
+    assert len(msgs) == 3, msgs
+    assert any("_lock.acquire() without a try/finally" in m for m in msgs)
+    assert any("end()ed outside any finally" in m for m in msgs)
+    assert any("never end()ed and never handed off" in m for m in msgs)
+
+
+EXCEPTION_SAFETY_TN = """
+import threading
+
+_lock = threading.Lock()
+
+def ok_try_finally():
+    _lock.acquire()
+    try:
+        do_work()
+    finally:
+        _lock.release()
+
+def ok_with():
+    with _lock:
+        do_work()
+
+def ok_span_in_finally(tracer):
+    sp = tracer.start_span("x")
+    try:
+        do_work()
+    finally:
+        sp.end()
+
+def ok_span_immediate(tracer):
+    sp = tracer.start_span("x")
+    sp.end()
+    do_work()
+
+def ok_span_returned(tracer):
+    sp = tracer.start_span("x")
+    return sp
+
+def ok_span_handed_off(tracer, req, fut):
+    req.span = tracer.start_span("a")
+    sp = tracer.start_span("b")
+    fut.add_done_callback(lambda _: sp.end())
+
+def do_work():
+    pass
+"""
+
+
+def test_exception_safety_true_negatives(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/x.py", EXCEPTION_SAFETY_TN,
+        rules=["exception-safety"],
+    )
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# PR 9: resource-lifecycle
+# ---------------------------------------------------------------------------
+
+RESOURCE_TP = """
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from http.server import ThreadingHTTPServer
+
+def bad_thread():
+    t = threading.Thread(target=work)
+    t.start()
+
+def bad_pool():
+    pool = ThreadPoolExecutor(4)
+    return pool.submit(work)
+
+def bad_server(handler):
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    httpd.serve_forever()
+
+def work():
+    pass
+"""
+
+
+def test_resource_lifecycle_true_positives(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/x.py", RESOURCE_TP, rules=["resource-lifecycle"]
+    )
+    msgs = sorted(f.message for f in report.findings)
+    assert len(msgs) == 3, msgs
+    assert any("Thread created without daemon=True" in m for m in msgs)
+    assert any("ThreadPoolExecutor with no shutdown path" in m for m in msgs)
+    assert any("ThreadingHTTPServer with no shutdown()" in m for m in msgs)
+
+
+RESOURCE_TN = """
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from http.server import ThreadingHTTPServer
+
+class Svc:
+    def start(self, handler):
+        self._thread = threading.Thread(target=work)
+        self._thread.daemon = True
+        self._thread.start()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        t = self._thread
+        t.join(timeout=5)
+
+def ok_daemon_kwarg():
+    threading.Thread(target=work, daemon=True).start()
+
+def ok_pool_with():
+    with ThreadPoolExecutor(2) as pool:
+        pool.submit(work)
+
+def ok_pool_shutdown():
+    pool = ThreadPoolExecutor(2)
+    try:
+        return pool.submit(work)
+    finally:
+        pool.shutdown(wait=False)
+
+def work():
+    pass
+"""
+
+
+def test_resource_lifecycle_true_negatives(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/x.py", RESOURCE_TN, rules=["resource-lifecycle"]
+    )
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# PR 9: fault-site-coverage (cross-tree: scanned files vs tests/)
+# ---------------------------------------------------------------------------
+
+def test_fault_site_coverage_flags_untested_site(tmp_path):
+    report = check_files(
+        tmp_path,
+        {
+            "estimators/x.py": """
+                from sparkdl_tpu.resilience import inject
+
+                def run(name):
+                    inject.fire("estimator.step")
+                    inject.fire("estimator.custom")
+                    inject.fire(f"watchdog.{name}")
+                """,
+            "tests/test_faults.py": (
+                'PLAN = "estimator.step"  # covered site\n'
+            ),
+        },
+        rules=["fault-site-coverage"],
+    )
+    assert len(report.findings) == 1, [f.message for f in report.findings]
+    f = report.findings[0]
+    assert "'estimator.custom'" in f.message
+    assert f.path == "estimators/x.py"
+    # dynamic f-string sites are statically unknowable: exempt, and the
+    # covered site is silent
+
+
+def test_fault_site_coverage_silent_without_tests_tree(tmp_path):
+    report = check_files(
+        tmp_path,
+        {
+            "estimators/x.py": """
+                from sparkdl_tpu.resilience import inject
+
+                def run():
+                    inject.fire("estimator.step")
+                """,
+        },
+        rules=["fault-site-coverage"],
+    )
+    assert report.findings == []
+
+
+# ---------------------------------------------------------------------------
+# PR 9: --changed-only (only_paths) semantics
+# ---------------------------------------------------------------------------
+
+def test_changed_only_rechecks_reverse_dependents(tmp_path):
+    # only helper.py "changed", but serving/main.py calls into it: the
+    # cross-file finding must still surface
+    report = check_files(
+        tmp_path,
+        {"helper.py": CROSSFILE_HELPER, "serving/main.py": CROSSFILE_MAIN},
+        rules=["lock-blocking"],
+        only_paths=["helper.py"],
+    )
+    assert report.cache_status == "changed-only"
+    assert {f.path for f in report.findings} == {"serving/main.py"}
+
+
+def test_changed_only_skips_unrelated_files(tmp_path):
+    report = check_files(
+        tmp_path,
+        {
+            "helper.py": CROSSFILE_HELPER,
+            "serving/main.py": CROSSFILE_MAIN,
+            "island.py": "def alone():\n    pass\n",
+        },
+        rules=["lock-blocking"],
+        only_paths=["island.py"],
+    )
+    assert report.findings == []
+
+
+def test_changed_only_does_not_enforce_stale_baseline(tmp_path):
+    baseline = {"findings": [{
+        "rule": "lock-blocking", "path": "serving/other.py", "line": 1,
+        "message": "something that only fires on an unselected file",
+        "reason": "test",
+    }]}
+    report = check_files(
+        tmp_path,
+        {"island.py": "def alone():\n    pass\n"},
+        rules=["lock-blocking"], baseline=baseline,
+        only_paths=["island.py"],
+    )
+    assert report.stale_baseline == []
+    assert report.exit_code == 0
+
+
+# ---------------------------------------------------------------------------
+# PR 9: incremental result cache
+# ---------------------------------------------------------------------------
+
+HOT_SYNC_FIXTURE = {
+    "serving/x.py": """
+        import jax
+
+        def f(y):
+            return jax.device_get(y)
+        """,
+    "serving/clean.py": "def g():\n    return 1\n",
+}
+
+
+def test_cache_warm_run_replays_identical_findings(tmp_path):
+    cache = tmp_path / "cache.json"
+    first = check_files(
+        tmp_path, HOT_SYNC_FIXTURE, rules=["host-sync"], cache_path=cache
+    )
+    assert first.cache_status == "cold"
+    assert len(first.findings) == 1
+    again = run_check(tmp_path, rule_ids=["host-sync"], cache_path=cache)
+    assert again.cache_status == "warm"
+    assert [f.to_dict() for f in again.findings] == \
+        [f.to_dict() for f in first.findings]
+    assert again.exit_code == first.exit_code
+
+
+def test_cache_invalidated_by_file_edit(tmp_path):
+    cache = tmp_path / "cache.json"
+    check_files(
+        tmp_path, HOT_SYNC_FIXTURE, rules=["host-sync"], cache_path=cache
+    )
+    (tmp_path / "serving/x.py").write_text(
+        "import jax\n\ndef f(y):\n    return jax.device_get(y)\n\n"
+        "def f2(y):\n    return jax.device_get(y)\n"
+    )
+    report = run_check(tmp_path, rule_ids=["host-sync"], cache_path=cache)
+    assert report.cache_status in ("cold", "partial")
+    assert len(report.findings) == 2
+
+
+def test_cache_partial_reuse_keeps_unchanged_file_findings(tmp_path):
+    cache = tmp_path / "cache.json"
+    check_files(
+        tmp_path, HOT_SYNC_FIXTURE, rules=["host-sync"], cache_path=cache
+    )
+    # edit only the CLEAN file; the dirty one is replayed from cache
+    (tmp_path / "serving/clean.py").write_text("def g():\n    return 2\n")
+    report = run_check(tmp_path, rule_ids=["host-sync"], cache_path=cache)
+    assert report.cache_status == "partial"
+    assert len(report.findings) == 1
+    assert report.findings[0].path == "serving/x.py"
+
+
+def test_cache_invalidated_by_rule_set_and_toolchain(tmp_path, monkeypatch):
+    from ci.sparkdl_check import cache as cache_mod
+
+    cache = tmp_path / "cache.json"
+    check_files(
+        tmp_path, HOT_SYNC_FIXTURE, rules=["host-sync"], cache_path=cache
+    )
+    # a different rule selection misses the whole-run key
+    other = run_check(
+        tmp_path, rule_ids=["host-sync", "lock-blocking"], cache_path=cache
+    )
+    assert other.cache_status != "warm"
+    # a toolchain change (edited checker source) orphans the cache file
+    monkeypatch.setattr(cache_mod, "_toolchain_memo", "something-else")
+    cold = run_check(tmp_path, rule_ids=["host-sync"], cache_path=cache)
+    assert cold.cache_status == "cold"
+    assert len(cold.findings) == 1
+
+
+def test_cache_invalidated_by_tests_tree_change(tmp_path):
+    cache = tmp_path / "cache.json"
+    files = {
+        "estimators/x.py": """
+            from sparkdl_tpu.resilience import inject
+
+            def run():
+                inject.fire("estimator.step")
+            """,
+        "tests/test_faults.py": 'PLAN = "estimator.step"\n',
+    }
+    first = check_files(
+        tmp_path, files, rules=["fault-site-coverage"], cache_path=cache
+    )
+    assert first.findings == []
+    # deleting the covering test MUST invalidate the warm replay
+    (tmp_path / "tests/test_faults.py").write_text("PLAN = None\n")
+    report = run_check(
+        tmp_path, rule_ids=["fault-site-coverage"], cache_path=cache
+    )
+    assert report.cache_status != "warm"
+    assert len(report.findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# PR 9: timings in the report
+# ---------------------------------------------------------------------------
+
+def test_report_carries_timings(tmp_path):
+    report = check_files(tmp_path, HOT_SYNC_FIXTURE, rules=["host-sync"])
+    assert set(report.timings) >= {
+        "rules", "parse_s", "graph_build_s", "total_s"
+    }
+    assert "host-sync" in report.timings["rules"]
+    doc = json.loads(json_report(report))
+    assert "timings" in doc and "cache_status" in doc
+    assert doc["timings"]["total_s"] >= 0
+
+
+def test_repo_warm_scan_is_fast(tmp_path):
+    """Acceptance: the warm incremental run over the real repo stays
+    well under the 10 s budget (it replays cached findings)."""
+    cache = tmp_path / "repo-cache.json"
+    run_check(_REPO / "sparkdl_tpu", cache_path=cache)
+    warm = run_check(_REPO / "sparkdl_tpu", cache_path=cache)
+    assert warm.cache_status == "warm"
+    assert warm.elapsed_s < 10.0, warm.elapsed_s
+    assert warm.exit_code in (0, 1)  # findings governed by the baseline
