@@ -222,6 +222,14 @@ def main():
         "persistent compile cache (two fresh processes sharing one "
         "temporary SPARKDL_COMPILE_CACHE) instead of throughput",
     )
+    ap.add_argument(
+        "--cpu-scale", type=int, default=None, metavar="N",
+        help="divide the featurizer workload by N for the CPU fallback "
+        "(default: SPARKDL_BENCH_CPU_SCALE, else auto — 32 when every "
+        "device is CPU, 1 on real accelerators); the r05-r09 wedge was "
+        "batch-512 scan-24 being unfinishable on CPU, ending runs at "
+        "rc=124 instead of a number",
+    )
     args = ap.parse_args()
 
     _arm_stall_dump()
@@ -257,12 +265,27 @@ def main():
             sink.flush()
         return 2
 
-    from sparkdl_tpu.utils.benchlib import measure_featurizer
+    from sparkdl_tpu.utils.benchlib import (
+        measure_featurizer,
+        resolve_cpu_scale,
+        scale_featurizer_workload,
+    )
 
+    cpu_scale = resolve_cpu_scale(args.cpu_scale)
+    batch, scan_len, repeats = scale_featurizer_workload(
+        BATCH, SCAN_LEN, REPEATS, cpu_scale
+    )
+    if cpu_scale > 1:
+        print(
+            f"# cpu-scale {cpu_scale}: featurizer workload shrunk to "
+            f"batch {batch} scan {scan_len} repeats {repeats} "
+            "(CPU-fallback number, NOT comparable to chip runs)",
+            file=sys.stderr, flush=True,
+        )
     with tracer.span(
-        "bench.featurizer", batch=BATCH, scan_len=SCAN_LEN, repeats=REPEATS
+        "bench.featurizer", batch=batch, scan_len=scan_len, repeats=repeats
     ):
-        out = measure_featurizer("InceptionV3", BATCH, SCAN_LEN, REPEATS)
+        out = measure_featurizer("InceptionV3", batch, scan_len, repeats)
     if sink is not None:
         sink.flush()
     print(
@@ -277,6 +300,9 @@ def main():
                 ),
                 "mfu": round(out["mfu"], 4) if out["mfu"] is not None
                 else None,
+                "cpu_scale": cpu_scale,
+                "batch": batch,
+                "scan": scan_len,
                 "ok": True,
             }
         )

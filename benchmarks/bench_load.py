@@ -1,0 +1,517 @@
+"""Closed-loop chaos load harness for the replica plane (ISSUE-10).
+
+Drives production-shaped traffic at a live
+:class:`~sparkdl_tpu.serving.supervisor.ReplicaSupervisor` stack and
+reports whether the delivery contract held while replicas died:
+
+- **multi-process generators** — each worker is a separate OS process
+  (spawn context) holding its own wire-protocol connection to the
+  router's front door, so generator GIL time can never flatter the
+  server's latency numbers.  Workers import the wire module *by file
+  path* — generator startup does not pay the jax import.
+- **heavy-tailed traffic** — endpoint choice is Zipf (a few hot models,
+  a long cold tail) and arrivals are Poisson bursts (exponential gaps
+  between bursts, geometric burst sizes) under a per-scenario rate
+  shape: ``steady``, ``ramp`` (0.3x -> 1.7x), ``spike`` (3x middle
+  third), ``kill`` (steady + a replica killed mid-run).
+- **chaos via FaultPlan** — the kill scenario arms
+  ``{"site": "supervisor.replica_serve", "kill": true, "at": K}`` on
+  slot 0 through the supervisor's ``fault_plans``, so the replica dies
+  mid-request (``os._exit(9)``) at a deterministic point — the stranded
+  request MUST fail over to a survivor or the run reports lost work.
+- **SLO autoscaler** (``--autoscale``) — wires the PR-8 burn-rate
+  engine to :class:`~sparkdl_tpu.serving.autoscale.Autoscaler` and logs
+  every control decision into the report.
+
+The report (``--out BENCH_LOAD_*.json``) carries p50/p95/p99 latency,
+shed rate, goodput, a per-second timeline, and — for kill runs — the
+loss count (accepted requests that failed: the number that must be 0),
+live-replica recovery time, p99 recovery time, and the restarted
+replica's warmup sources (``disk`` = compile-cache-warm restart).
+
+``--smoke`` is the CI mode (<60 s): 2 replicas, sustained load, one
+planned kill; exits non-zero unless zero accepted requests were lost
+and the dead replica came back.
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_load.py --smoke
+    JAX_PLATFORMS=cpu python benchmarks/bench_load.py \
+        --scenario kill --duration 40 --rate 120 --compile \
+        --out BENCH_LOAD_r10.json
+"""
+
+import argparse
+import importlib.util
+import json
+import multiprocessing as mp
+import os
+import random
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_WIRE_PATH = os.path.join(REPO, "sparkdl_tpu", "serving", "wire.py")
+
+#: shed replies — the router refusing work BEFORE accepting it; every
+#: other failure class is an accepted request that was lost
+_SHED_CLASSES = {"ServerOverloaded", "NoLiveReplicas"}
+
+
+def _load_wire():
+    """The wire module by file path — no ``sparkdl_tpu`` package import,
+    so generator processes start in milliseconds, not jax-import
+    seconds."""
+    spec = importlib.util.spec_from_file_location("_bench_wire", _WIRE_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _zipf_weights(n, s):
+    return [1.0 / (k + 1) ** s for k in range(n)]
+
+
+def _rate_factor(scenario, frac):
+    if scenario == "ramp":
+        return 0.3 + 1.4 * frac
+    if scenario == "spike":
+        return 3.0 if 1 / 3 <= frac < 2 / 3 else 1.0
+    return 1.0  # steady / kill
+
+
+def _worker(worker_id, host, port, args_dict, out_queue):
+    """One generator process: Poisson-burst arrivals, Zipf endpoints,
+    per-request round-trip timing over a persistent connection."""
+    wire = _load_wire()
+    import numpy as np
+
+    rng = random.Random(args_dict["seed"] * 1000 + worker_id)
+    endpoints = [f"ep{i}" for i in range(args_dict["endpoints"])]
+    weights = _zipf_weights(len(endpoints), args_dict["zipf_s"])
+    dim = args_dict["dim"]
+    value = np.ones(dim, dtype=np.float32)
+    duration = args_dict["duration_s"]
+    scenario = args_dict["scenario"]
+    # rate is per-worker; each arrival event is a burst, so the event
+    # rate is scaled down by the mean burst size to hold the target
+    burst_p = args_dict["burst_p"]
+    mean_burst = 1.0 / (1.0 - burst_p)
+    base_event_rate = max(args_dict["rate_per_worker"] / mean_burst, 0.1)
+
+    records = []  # (t_rel, latency_ms, outcome)
+    sock = None
+    start = time.monotonic()
+    while True:
+        t_rel = time.monotonic() - start
+        if t_rel >= duration:
+            break
+        rate = base_event_rate * _rate_factor(scenario, t_rel / duration)
+        gap = rng.expovariate(rate)
+        if gap > 0:
+            time.sleep(min(gap, duration - t_rel))
+        burst = 1
+        while rng.random() < burst_p and burst < args_dict["burst_max"]:
+            burst += 1
+        for _ in range(burst):
+            if time.monotonic() - start >= duration:
+                break
+            endpoint = rng.choices(endpoints, weights=weights)[0]
+            t0 = time.monotonic()
+            try:
+                if sock is None:
+                    sock = wire.connect(host, port, 5.0)
+                    sock.settimeout(args_dict["request_timeout_s"])
+                wire.send_msg(sock, {
+                    "op": "infer", "model_id": endpoint, "value": value,
+                })
+                reply = wire.recv_msg(sock)
+                if reply is None:
+                    raise ConnectionError("front door EOF")
+                outcome = (
+                    "ok" if reply.get("ok")
+                    else reply.get("error_class", "UnknownError")
+                )
+            except Exception as exc:
+                outcome = f"conn:{type(exc).__name__}"
+                try:
+                    if sock is not None:
+                        sock.close()
+                except OSError:
+                    pass
+                sock = None
+            latency_ms = (time.monotonic() - t0) * 1000.0
+            records.append(
+                (round(t0 - start, 4), round(latency_ms, 3), outcome)
+            )
+    if sock is not None:
+        try:
+            sock.close()
+        except OSError:
+            pass
+    out_queue.put((worker_id, records))
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def _latency_stats(latencies):
+    vals = sorted(latencies)
+    if not vals:
+        return {"count": 0}
+    return {
+        "count": len(vals),
+        "mean": round(sum(vals) / len(vals), 3),
+        "p50": round(_quantile(vals, 0.50), 3),
+        "p95": round(_quantile(vals, 0.95), 3),
+        "p99": round(_quantile(vals, 0.99), 3),
+        "max": round(vals[-1], 3),
+    }
+
+
+def _timeline(records, duration_s):
+    """Per-second buckets: sent/ok/shed/lost + ok-latency p99."""
+    buckets = []
+    for sec in range(int(duration_s) + 1):
+        rows = [r for r in records if sec <= r[0] < sec + 1]
+        if not rows:
+            continue
+        ok_lat = sorted(lat for _, lat, out in rows if out == "ok")
+        shed = sum(1 for r in rows if r[2] in _SHED_CLASSES)
+        lost = sum(
+            1 for r in rows if r[2] != "ok" and r[2] not in _SHED_CLASSES
+        )
+        buckets.append({
+            "t": sec,
+            "sent": len(rows),
+            "ok": len(ok_lat),
+            "shed": shed,
+            "lost": lost,
+            "p99_ms": round(_quantile(ok_lat, 0.99), 3) if ok_lat else None,
+        })
+    return buckets
+
+
+def _recovery(timeline, events, kill_t, replicas):
+    """Live-count and p99 recovery after the kill, from the event poll
+    and the per-second timeline."""
+    if kill_t is None:
+        return {}
+    live_back = next(
+        (e["t"] for e in events
+         if e["t"] > kill_t and e["live"] >= replicas),
+        None,
+    )
+    pre = [
+        b["p99_ms"] for b in timeline
+        if b["t"] < int(kill_t) and b["p99_ms"] is not None
+    ]
+    pre_p99 = max(pre) if pre else None
+    p99_back = None
+    if pre_p99 is not None:
+        for b in timeline:
+            if b["t"] <= kill_t or b["p99_ms"] is None:
+                continue
+            if b["p99_ms"] <= 1.5 * pre_p99:
+                p99_back = b["t"] + 1 - kill_t
+                break
+    return {
+        "kill_at_s": round(kill_t, 2),
+        "pre_kill_p99_ms": pre_p99,
+        "recovery_live_s": (
+            round(live_back - kill_t, 2) if live_back is not None else None
+        ),
+        "recovery_p99_s": round(p99_back, 2) if p99_back is not None else None,
+    }
+
+
+def run(args):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.cache_dir:
+        os.makedirs(args.cache_dir, exist_ok=True)
+        os.environ["SPARKDL_COMPILE_CACHE"] = args.cache_dir
+
+    from sparkdl_tpu.serving.replica import ReplicaSpec
+    from sparkdl_tpu.serving.supervisor import ReplicaSupervisor
+
+    factory = (
+        "sparkdl_tpu.serving.replica:demo_server"
+        if args.compile else
+        "sparkdl_tpu.serving.replica:demo_server_plain"
+    )
+    fault_plans = None
+    if args.scenario == "kill":
+        fault_plans = {0: [{
+            "site": "supervisor.replica_serve",
+            "kill": True,
+            "at": args.kill_at_requests,
+        }]}
+    spec = ReplicaSpec(factory=factory)
+    supervisor = ReplicaSupervisor(
+        spec,
+        replicas=args.replicas,
+        monitor_interval_s=0.1,
+        health_interval_s=1.0,
+        spawn_timeout_s=args.spawn_timeout_s,
+        fault_plans=fault_plans,
+    ).start()
+    autoscaler = None
+    report = {
+        "benchmark": "bench_load",
+        "scenario": args.scenario,
+        "replicas": args.replicas,
+        "duration_s": args.duration,
+        "target_rps": args.rate,
+        "workers": args.workers,
+        "endpoints": args.endpoints,
+        "zipf_s": args.zipf_s,
+        "burst_p": args.burst_p,
+        "compile": bool(args.compile),
+        "compile_cache": bool(args.cache_dir),
+        "autoscale": None,
+        "fault_plan": fault_plans[0] if fault_plans else None,
+        "seed": args.seed,
+    }
+    try:
+        if not supervisor.wait_live(args.replicas, args.spawn_timeout_s):
+            raise RuntimeError(
+                f"replicas failed to come up: {supervisor.status()}"
+            )
+        gen0_warmup = {
+            h.slot: h.warmup for h in supervisor.handles()
+        }
+        front_port = supervisor.router.serve()
+        if args.autoscale:
+            from sparkdl_tpu.serving.autoscale import Autoscaler
+
+            supervisor.start_telemetry(
+                sample_interval_s=0.5, slo_interval_s=1.0,
+                latency_threshold_ms=args.slo_p99_ms,
+                fast_window_s=5.0, slow_window_s=30.0,
+            )
+            autoscaler = Autoscaler(
+                supervisor, supervisor.slo_engine,
+                min_replicas=args.replicas,
+                max_replicas=args.replicas + 2,
+                interval_s=1.0, cooldown_s=5.0, ok_streak=8,
+            ).start()
+
+        # event poller: live count + per-slot generation, 10 Hz — how
+        # the report timestamps the death and the recovery
+        events = []
+        stop_events = threading.Event()
+
+        def poll_events():
+            start_poll = time.monotonic()
+            while not stop_events.wait(0.1):
+                status = supervisor.status()
+                events.append({
+                    "t": round(time.monotonic() - start_poll, 2),
+                    "live": status["live"],
+                    "generations": {
+                        r["slot"]: r["generation"]
+                        for r in status["replicas"]
+                    },
+                })
+
+        poller = threading.Thread(
+            target=poll_events, name="bench-load-events", daemon=True
+        )
+
+        ctx = mp.get_context("spawn")
+        out_queue = ctx.Queue()
+        worker_args = {
+            "seed": args.seed,
+            "endpoints": args.endpoints,
+            "zipf_s": args.zipf_s,
+            "dim": 64,
+            "duration_s": args.duration,
+            "scenario": args.scenario,
+            "rate_per_worker": args.rate / args.workers,
+            "burst_p": args.burst_p,
+            "burst_max": args.burst_max,
+            "request_timeout_s": 15.0,
+        }
+        procs = [
+            ctx.Process(
+                target=_worker,
+                args=(i, "127.0.0.1", front_port, worker_args, out_queue),
+                daemon=True,
+            )
+            for i in range(args.workers)
+        ]
+        bench_start = time.monotonic()
+        poller.start()
+        for p in procs:
+            p.start()
+        records = []
+        for _ in procs:
+            worker_id, rows = out_queue.get(
+                timeout=args.duration + args.spawn_timeout_s + 60
+            )
+            records.extend(rows)
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=10)
+        stop_events.set()
+        poller.join(timeout=5)
+        wall_s = time.monotonic() - bench_start
+
+        # --- aggregate -------------------------------------------------
+        records.sort(key=lambda r: r[0])
+        ok = [r for r in records if r[2] == "ok"]
+        shed = [r for r in records if r[2] in _SHED_CLASSES]
+        lost = [
+            r for r in records
+            if r[2] != "ok" and r[2] not in _SHED_CLASSES
+        ]
+        kill_t = None
+        if args.scenario == "kill":
+            # the moment the poller first saw a replica missing
+            kill_t = next(
+                (e["t"] for e in events if e["live"] < args.replicas),
+                None,
+            )
+        timeline = _timeline(records, args.duration)
+        final = supervisor.status()
+        restarted = [
+            r for r in final["replicas"] if r["generation"] > 1
+        ]
+        report.update({
+            "wall_s": round(wall_s, 2),
+            "sent": len(records),
+            "ok": len(ok),
+            "shed": len(shed),
+            "lost_accepted": len(lost),
+            "lost_detail": sorted({r[2] for r in lost}),
+            "shed_rate": round(len(shed) / len(records), 4) if records
+            else None,
+            "goodput_rps": round(len(ok) / wall_s, 2),
+            "offered_rps": round(len(records) / wall_s, 2),
+            "latency_ms": _latency_stats([r[1] for r in ok]),
+            "timeline": timeline,
+            "kill": _recovery(timeline, events, kill_t, args.replicas),
+            "restarts": {
+                r["slot"]: {
+                    "generation": r["generation"],
+                    # "disk" sources == the restart warmed from the
+                    # persistent compile cache instead of recompiling
+                    "warmup_sources": r["warmup"].get("sources"),
+                } for r in restarted
+            },
+            "first_boot_warmup": {
+                slot: w.get("sources") for slot, w in gen0_warmup.items()
+            },
+            "supervisor": {
+                "live": final["live"],
+                "breakers": {
+                    s: b["state"] for s, b in final["breakers"].items()
+                },
+            },
+        })
+        if autoscaler is not None:
+            report["autoscale"] = {
+                "target": autoscaler.target,
+                "decisions": autoscaler.decisions(),
+            }
+    finally:
+        if autoscaler is not None:
+            autoscaler.close()
+        supervisor.close()
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--scenario", default="kill",
+                    choices=["steady", "ramp", "spike", "kill"])
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="target aggregate requests/sec")
+    ap.add_argument("--workers", type=int, default=4,
+                    help="generator processes")
+    ap.add_argument("--endpoints", type=int, default=3)
+    ap.add_argument("--zipf-s", type=float, default=1.1)
+    ap.add_argument("--burst-p", type=float, default=0.3,
+                    help="geometric burst continuation probability")
+    ap.add_argument("--burst-max", type=int, default=8)
+    ap.add_argument("--kill-at-requests", type=int, default=200,
+                    help="kill scenario: slot-0 dies mid-request at its "
+                    "Nth served request (FaultPlan supervisor."
+                    "replica_serve)")
+    ap.add_argument("--compile", action="store_true",
+                    help="jitted demo endpoints (+ compile cache when "
+                    "--cache-dir is set) instead of plain-python")
+    ap.add_argument("--cache-dir", default=None,
+                    help="SPARKDL_COMPILE_CACHE dir replicas inherit — "
+                    "makes restarts disk-warm")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run the SLO autoscaler control loop")
+    ap.add_argument("--slo-p99-ms", type=float, default=250.0)
+    ap.add_argument("--spawn-timeout-s", type=float, default=120.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the JSON report here (stdout always)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI mode: short kill run, assert zero "
+                    "accepted-request loss + recovery, exit non-zero "
+                    "on violation")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.scenario = "kill"
+        args.replicas = 2
+        args.duration = 12.0
+        args.rate = 60.0
+        args.workers = 2
+        args.kill_at_requests = 100
+        args.compile = False
+
+    report = run(args)
+    print(json.dumps(report, indent=2, default=str))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+    if args.smoke:
+        problems = []
+        if report["lost_accepted"] != 0:
+            problems.append(
+                f"lost {report['lost_accepted']} accepted requests "
+                f"({report['lost_detail']})"
+            )
+        kill = report.get("kill") or {}
+        if kill.get("kill_at_s") is None:
+            problems.append("planned kill never observed")
+        if kill.get("recovery_live_s") is None:
+            problems.append("killed replica never came back")
+        if report["ok"] == 0:
+            problems.append("no successful requests at all")
+        if problems:
+            print("SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
+            return 1
+        print(
+            "SMOKE PASS: "
+            f"{report['ok']} ok / {report['sent']} sent, 0 lost, "
+            f"replica back in {kill['recovery_live_s']}s",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,11 +41,22 @@ def main():
     ap.add_argument("--scan", type=int, default=24)
     ap.add_argument("-k", type=int, default=3,
                     help="trials per model; JSON reports median + IQR")
+    ap.add_argument("--cpu-scale", type=int, default=None, metavar="N",
+                    help="divide the workload by N on the CPU fallback "
+                    "(auto when every device is CPU; see bench.py)")
     args = ap.parse_args()
+    from sparkdl_tpu.utils.benchlib import (
+        resolve_cpu_scale,
+        scale_featurizer_workload,
+    )
+
+    batch, scan, _ = scale_featurizer_workload(
+        args.batch, args.scan, 1, resolve_cpu_scale(args.cpu_scale)
+    )
     names = args.models or sorted(SUPPORTED_MODELS)
     for name in names:
         # one compile per model; k timed trial groups share the program
-        out = measure_featurizer(name, args.batch, args.scan, trials=args.k)
+        out = measure_featurizer(name, batch, scan, trials=args.k)
         summary = summarize_samples(out["samples"])
         # mfu from the trial closest to the median, so the two headline
         # numbers come from the same measurement

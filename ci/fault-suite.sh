@@ -41,11 +41,22 @@ if ! python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
   exit 1
 fi
 
+# replica-kill smoke (<60 s, ISSUE-10): 2 replica processes under
+# sustained load, a FaultPlan SIGKILL-equivalent takes one out
+# mid-request, and the harness itself asserts zero accepted-request
+# loss (the stranded request retried on the survivor) plus supervisor
+# recovery.  --smoke exits non-zero on any violated invariant.
+if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke; then
+  echo "replica-kill smoke FAILED (accepted-request loss, no recovery," >&2
+  echo "or >60s wall — see the report line above)" >&2
+  exit 1
+fi
+
 # full static-analysis pass (replaces the per-script lints: one AST
 # parse per file, all nine rules); on failure print the JSON report so
 # CI logs carry the machine-readable findings, not just the exit code
 CHECK_REPORT="$(mktemp -t fault-suite-check.XXXXXX.json)"
-trap 'rm -f "$TRACE_OUT" "$CHECK_REPORT"' EXIT
+trap 'rm -rf "$TRACE_OUT" "$BLACKBOX_DIR" "$CHECK_REPORT"' EXIT
 if ! ci/check.sh "$CHECK_REPORT"; then
   echo "--- sparkdl_check JSON report ---" >&2
   cat "$CHECK_REPORT" >&2 || true

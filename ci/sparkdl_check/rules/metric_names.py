@@ -19,10 +19,12 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: one entry per subsystem that owns metrics; grow this list when a new
 #: subsystem earns a namespace, not to whitelist a one-off name.
 #: "slo" (burn-rate gauges/transitions) and "ts" (time-series recorder
-#: self-metrics) joined with the PR-8 telemetry plane.
+#: self-metrics) joined with the PR-8 telemetry plane; "supervisor"
+#: (replica lifecycle) and "router" (request plane) with the ISSUE-10
+#: replica supervisor.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
-    "streaming", "slo", "ts",
+    "streaming", "slo", "ts", "supervisor", "router",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

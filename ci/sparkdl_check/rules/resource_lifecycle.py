@@ -17,10 +17,17 @@ lexically, file-wide:
     its spelling somewhere in the file — otherwise worker threads (and
     their queued work) outlive the owner.
 
-``ThreadingHTTPServer(...)`` / ``HTTPServer(...)``
+``ThreadingHTTPServer(...)`` / ``HTTPServer(...)`` /
+``ThreadingTCPServer(...)`` / ``TCPServer(...)``
     Must have ``.shutdown(`` or ``.server_close(`` reachable on its
     spelling — a serve-forever loop with no stop path holds the port
     until the process dies.
+
+``subprocess.Popen(...)``
+    Must be used as a context manager or have ``.wait(`` /
+    ``.communicate(`` reachable on its spelling — spawned replica
+    processes need a reap path or every supervisor restart cycle
+    leaves a zombie.
 
 "Somewhere in the file under the same spelling" is deliberately
 generous: lifecycle protocols legitimately split across methods
@@ -37,7 +44,14 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 from ci.sparkdl_check.rules._util import dotted_name, keyword, target_name
 
 _EXECUTOR_CTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
-_SERVER_CTORS = {"ThreadingHTTPServer", "HTTPServer"}
+_SERVER_CTORS = {
+    "ThreadingHTTPServer", "HTTPServer",
+    # the replica plane's wire-protocol servers (ISSUE-10)
+    "ThreadingTCPServer", "TCPServer",
+}
+#: spawned OS processes must have a reap path — a Popen nobody waits on
+#: is a zombie on every supervisor restart cycle
+_PROCESS_CTORS = {"Popen"}
 
 
 def _ctor(call: ast.Call) -> Optional[str]:
@@ -154,5 +168,18 @@ class ResourceLifecycleRule(Rule):
                     f"{ctor} with no shutdown()/server_close() path — "
                     "a serve-forever loop with no stop holds the port "
                     "until the process dies",
+                ))
+            elif ctor in _PROCESS_CTORS:
+                if in_with:
+                    continue
+                if spelling is not None and reclaimed(
+                        spelling, ("wait", "communicate")):
+                    continue
+                findings.append(self.finding(
+                    ctx, node,
+                    f"{ctor} with no wait()/communicate() reap path — "
+                    "an unreaped child is a zombie on every restart "
+                    "cycle; every spawned process needs a spelled-out "
+                    "wait",
                 ))
         return findings

@@ -43,6 +43,37 @@ from sparkdl_tpu.resilience.errors import (
 
 ENV_VAR = "SPARKDL_FAULT_PLAN"
 
+#: every :func:`fire` site the package instruments, by subsystem — the
+#: authoritative list a chaos plan can target (``bench_load.py`` checks
+#: scenario sites against it, and it documents what the
+#: ``fault-site-coverage`` rule will demand a kill test for).  Register
+#: new sites here when instrumenting new code.
+KNOWN_SITES = {
+    "data": ("data.map", "data.source"),
+    "serving": ("serving.forward",),
+    "streaming": (
+        "streaming.poll", "streaming.sink", "streaming.commit",
+    ),
+    "estimator": (
+        "estimator.step", "estimator.epoch", "estimator.checkpoint_saved",
+    ),
+    "supervisor": (
+        # supervisor process
+        "supervisor.spawn", "supervisor.health", "supervisor.restart",
+        # replica process (these two fire in the spawned child, so a
+        # kill rule here takes out ONE replica, not the supervisor)
+        "supervisor.replica_warm", "supervisor.replica_serve",
+    ),
+    "router": ("router.route",),
+}
+
+
+def known_sites() -> tuple:
+    """Flat, sorted tuple of every registered fault site."""
+    return tuple(sorted(
+        site for sites in KNOWN_SITES.values() for site in sites
+    ))
+
 
 class InjectedTransientError(TransientError):
     """A planned transient fault (distinguishable from real ones)."""

@@ -8,6 +8,13 @@ program cache with explicit ``warmup()``, admission control with typed
 load-shedding and deadline propagation, and ``serving.*`` metrics
 (requests, batches, occupancy, queue depth, latency quantiles) in
 :mod:`sparkdl_tpu.utils.metrics`.
+
+On top of the single-process :class:`ModelServer` sits the replica
+plane (ISSUE-10): :class:`ReplicaSupervisor` runs N ``ModelServer``
+processes as killable OS replicas behind a :class:`Router` that
+load-balances, drains, and retries stranded requests, with an
+:class:`Autoscaler` closing the loop off SLO burn rates.  The heavy
+pieces import lazily — ``import sparkdl_tpu.serving`` stays cheap.
 """
 
 from sparkdl_tpu.serving.admission import AdmissionQueue, Request
@@ -15,6 +22,9 @@ from sparkdl_tpu.serving.batcher import MicroBatcher, ServingConfig
 from sparkdl_tpu.serving.cache import ProgramCache
 from sparkdl_tpu.serving.errors import (
     DeadlineExceeded,
+    NoLiveReplicas,
+    RemoteReplicaError,
+    ReplicaDraining,
     ServerClosed,
     ServerOverloaded,
     ServingError,
@@ -23,13 +33,44 @@ from sparkdl_tpu.serving.server import ModelServer
 
 __all__ = [
     "AdmissionQueue",
+    "Autoscaler",
     "DeadlineExceeded",
     "MicroBatcher",
     "ModelServer",
+    "NoLiveReplicas",
     "ProgramCache",
+    "RemoteReplicaError",
+    "ReplicaDraining",
+    "ReplicaSpec",
+    "ReplicaSupervisor",
     "Request",
+    "Router",
     "ServerClosed",
     "ServerOverloaded",
     "ServingConfig",
     "ServingError",
 ]
+
+
+def __getattr__(name):
+    # replica-plane classes pull in subprocess/socketserver machinery;
+    # load them only when asked for
+    if name in ("ReplicaSupervisor",):
+        from sparkdl_tpu.serving.supervisor import ReplicaSupervisor
+
+        return ReplicaSupervisor
+    if name in ("ReplicaSpec",):
+        from sparkdl_tpu.serving.replica import ReplicaSpec
+
+        return ReplicaSpec
+    if name in ("Router",):
+        from sparkdl_tpu.serving.router import Router
+
+        return Router
+    if name in ("Autoscaler",):
+        from sparkdl_tpu.serving.autoscale import Autoscaler
+
+        return Autoscaler
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

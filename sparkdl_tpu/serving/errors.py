@@ -43,3 +43,22 @@ class DeadlineExceeded(ServingError, _DeadlineExpired):
 class ServerClosed(ServingError, PermanentError):
     """The endpoint was closed: submissions are rejected and any requests
     still queued at close time fail with this error.  (Permanent.)"""
+
+
+class ReplicaDraining(ServingError, TransientError):
+    """The replica is draining after SIGTERM: it is finishing in-flight
+    work but admits nothing new.  The router treats this exactly like a
+    connection-level failure — re-route to a live replica.  (Transient.)"""
+
+
+class NoLiveReplicas(ServingError, TransientError):
+    """The router has no live replica to place the request on — every
+    replica is dead, draining, or evicted.  The supervisor is restarting
+    them; callers should back off and retry.  (Transient.)"""
+
+
+class RemoteReplicaError(ServingError, PermanentError):
+    """A replica reported a failure class the wire protocol does not
+    recognise.  Permanent on purpose: the router must not blind-retry a
+    failure it cannot classify (it might be a real model error that
+    would fail identically everywhere)."""

@@ -1,0 +1,351 @@
+"""One serving replica as a spawnable/killable OS process.
+
+The PR-1 :class:`~sparkdl_tpu.serving.server.ModelServer` is a library
+object — a SIGKILL aimed at it takes out the whole host process.  This
+module wraps it in a process boundary so the supervisor can treat
+replicas like cattle: ``python -m sparkdl_tpu.serving.replica`` builds a
+server from a :class:`ReplicaSpec` (a dotted ``module:callable`` factory
+— the only thing that crosses the spawn boundary is a name, never a
+pickled closure), **pre-warms from the PR-5 persistent compile cache**
+(the spawned process inherits ``SPARKDL_COMPILE_CACHE``, so a restarted
+replica's warmup *loads* executables instead of recompiling — scale-up
+is cache-load-fast), reports liveness via the PR-8
+:class:`~sparkdl_tpu.obs.server.ObsServer` ``/healthz``, and serves the
+:mod:`~sparkdl_tpu.serving.wire` protocol on a loopback TCP port.
+
+Lifecycle contract (what the supervisor and router rely on):
+
+- **ready line** — exactly one JSON line on stdout once warm and
+  listening: ``{"ready": true, "pid", "port", "obs_port", "warmup"}``;
+  everything after goes to stderr.
+- **SIGTERM = drain** — stop admitting (new requests get the transient
+  :class:`~sparkdl_tpu.serving.errors.ReplicaDraining`, which the router
+  re-routes), finish every in-flight request, flush/close the server,
+  exit 0.  Accepted work is never dropped by a graceful stop.
+- **SIGKILL = crash** — in-flight requests surface router-side as
+  connection errors and are retried on a surviving replica; the
+  supervisor restarts the process with backoff.
+
+Fault sites (``resilience.inject``): ``supervisor.replica_warm`` fires
+once before warmup, ``supervisor.replica_serve`` before each handled
+request — a ``SPARKDL_FAULT_PLAN`` kill rule at either is the
+deterministic stand-in for a replica dying at that point.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import signal
+import socket as socketmod
+import socketserver
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.serving import wire
+from sparkdl_tpu.serving.errors import ReplicaDraining
+from sparkdl_tpu.utils.metrics import metrics
+
+ENV_SPEC = "SPARKDL_REPLICA_SPEC"
+
+#: how long a SIGTERM'd replica waits for in-flight work before exiting
+#: anyway (a wedged forward must not make "graceful" mean "forever")
+DRAIN_TIMEOUT_S = float(os.environ.get("SPARKDL_REPLICA_DRAIN_S", "15"))
+
+
+@dataclass
+class ReplicaSpec:
+    """Everything a replica process needs, JSON-serializable.
+
+    ``factory`` is ``"package.module:callable"`` resolving to a
+    zero-arg callable that returns a configured
+    :class:`~sparkdl_tpu.serving.server.ModelServer` (register your
+    endpoints with durable ``fingerprint=`` there and restarts become
+    cache-warm).  ``pythonpath`` entries are prepended to ``sys.path``
+    before the import — how tests and benches ship ad-hoc factories."""
+
+    factory: str
+    warmup: bool = True
+    host: str = "127.0.0.1"
+    port: int = 0
+    obs_port: int = 0
+    request_timeout_s: float = 30.0
+    pythonpath: Tuple[str, ...] = field(default_factory=tuple)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "factory": self.factory,
+            "warmup": self.warmup,
+            "host": self.host,
+            "port": self.port,
+            "obs_port": self.obs_port,
+            "request_timeout_s": self.request_timeout_s,
+            "pythonpath": list(self.pythonpath),
+        })
+
+    @classmethod
+    def from_json(cls, text: str) -> "ReplicaSpec":
+        raw = json.loads(text)
+        raw["pythonpath"] = tuple(raw.get("pythonpath", ()))
+        return cls(**raw)
+
+    @classmethod
+    def from_env(cls) -> "ReplicaSpec":
+        text = os.environ.get(ENV_SPEC, "")
+        if not text:
+            raise RuntimeError(
+                f"{ENV_SPEC} is not set — replica processes are spawned "
+                "by ReplicaSupervisor, not run by hand"
+            )
+        return cls.from_json(text)
+
+    def build_server(self):
+        """Import and call the factory (pythonpath applied first)."""
+        for entry in self.pythonpath:
+            if entry and entry not in sys.path:
+                sys.path.insert(0, entry)
+        modname, _, attr = self.factory.partition(":")
+        if not attr:
+            raise ValueError(
+                f"factory {self.factory!r} must be 'module:callable'"
+            )
+        fn = getattr(importlib.import_module(modname), attr)
+        return fn()
+
+
+def demo_server(endpoints: int = 3, compile: bool = True):
+    """The built-in demo factory (``sparkdl_tpu.serving.replica:
+    demo_server``): ``endpoints`` tiny jitted matmul models with durable
+    fingerprints — enough model diversity for Zipf endpoint traffic and
+    cheap enough that CPU-only chaos runs measure the *plane*, not the
+    matmul."""
+    import jax.numpy as jnp
+
+    from sparkdl_tpu.serving.batcher import ServingConfig
+    from sparkdl_tpu.serving.server import ModelServer
+
+    dim = 64
+    server = ModelServer(config=ServingConfig(
+        max_batch=16, max_wait_ms=1.0, queue_capacity=512,
+    ))
+    for i in range(int(endpoints)):
+        weight = np.linspace(
+            -1.0, 1.0, dim * dim, dtype=np.float32
+        ).reshape(dim, dim) * (i + 1)
+
+        def forward(x, _w=jnp.asarray(weight)):
+            return jnp.tanh(x @ _w)
+
+        server.register(
+            f"ep{i}",
+            forward,
+            item_shape=(dim,),
+            compile=compile,
+            fingerprint=f"demo:ep{i}:dim{dim}:v1" if compile else None,
+        )
+    return server
+
+
+def demo_server_plain():
+    """``demo_server`` with plain-Python forwards (no compile) — the
+    deterministic, import-cheap flavor the fault-injection tests use."""
+    return demo_server(compile=False)
+
+
+class ReplicaService:
+    """Serve a :class:`ModelServer` over the wire protocol.
+
+    Usable in-process (router unit tests run one per thread) and as the
+    body of the replica process.  One connection handler thread per
+    router connection; each loops request frames:
+
+    - ``{"op": "ping"}`` -> ``{"ok": true, "pid", "draining"}``
+    - ``{"op": "infer", "model_id", "value", "deadline_ms"}`` ->
+      ``{"ok": true, "result"}`` or a typed error reply
+    """
+
+    def __init__(
+        self,
+        server,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        request_timeout_s: float = 30.0,
+    ):
+        self._server = server
+        self._request_timeout_s = float(request_timeout_s)
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._inflight = 0
+        self._draining = False
+        self._m_requests = metrics.counter("supervisor.replica_requests")
+        self._m_inflight = metrics.gauge("supervisor.replica_inflight")
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # one thread per router connection
+                self.request.setsockopt(
+                    socketmod.IPPROTO_TCP, socketmod.TCP_NODELAY, 1
+                )
+                while True:
+                    try:
+                        msg = wire.recv_msg(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    if msg is None:
+                        return
+                    try:
+                        reply = outer._handle_one(msg)
+                    except Exception as exc:
+                        reply = wire.encode_error(exc)
+                    try:
+                        wire.send_msg(self.request, reply)
+                    except (ConnectionError, OSError):
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._tcp = Server((host, int(port)), Handler)
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="sparkdl-replica-serve",
+            daemon=True,
+        )
+
+    # ------------------------------------------------------------------
+    def start(self) -> "ReplicaService":
+        self._thread.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._tcp.server_address[1]
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def _handle_one(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True, "pid": os.getpid(),
+                    "draining": self.draining}
+        if op != "infer":
+            raise ValueError(f"unknown wire op {op!r}")
+        with self._lock:
+            if self._draining:
+                raise ReplicaDraining(
+                    f"replica pid={os.getpid()} is draining"
+                )
+            self._inflight += 1
+            self._m_inflight.set(self._inflight)
+        try:
+            inject.fire("supervisor.replica_serve")
+            self._m_requests.add(1)
+            fut = self._server.submit(
+                msg["value"],
+                model_id=msg.get("model_id"),
+                deadline_ms=msg.get("deadline_ms"),
+            )
+            result = fut.result(timeout=self._request_timeout_s)
+            return {"ok": True, "result": np.asarray(result)}
+        finally:
+            with self._idle:
+                self._inflight -= 1
+                self._m_inflight.set(self._inflight)
+                if self._inflight == 0:
+                    self._idle.notify_all()
+
+    # ------------------------------------------------------------------
+    def drain(self, timeout_s: float = DRAIN_TIMEOUT_S) -> bool:
+        """Stop admitting, wait for in-flight requests to finish (bounded
+        by ``timeout_s``), then close the underlying server.  Returns
+        True when the drain completed clean."""
+        with self._idle:
+            self._draining = True
+            metrics.gauge("supervisor.replica_draining").set(1.0)
+            deadline = time.monotonic() + timeout_s
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._idle.wait(remaining)
+            clean = self._inflight == 0
+        self.close()
+        return clean
+
+    def close(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        self._server.close()
+
+    def __enter__(self) -> "ReplicaService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def main() -> int:
+    """Replica process entry: build, warm, serve, drain on SIGTERM."""
+    spec = ReplicaSpec.from_env()
+    server = spec.build_server()
+    warmup_report: Dict[str, Any] = {}
+    if spec.warmup:
+        inject.fire("supervisor.replica_warm")
+        warmed = server.warmup()
+        # per-bucket compile-vs-disk-load sources — what the supervisor
+        # asserts when it claims a restart came up cache-warm
+        cache_stats = server.status().get("program_cache", {})
+        warmup_report = {
+            "buckets": {m: list(b) for m, b in warmed.items()},
+            "sources": cache_stats.get("warmup", cache_stats),
+        }
+
+    service = ReplicaService(
+        server, host=spec.host, port=spec.port,
+        request_timeout_s=spec.request_timeout_s,
+    ).start()
+
+    from sparkdl_tpu.obs.server import ObsServer
+
+    obs = ObsServer(
+        port=spec.obs_port, host=spec.host, health_fn=server.status
+    ).start()
+
+    stop = threading.Event()
+
+    def on_sigterm(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+
+    print(json.dumps({
+        "ready": True,
+        "pid": os.getpid(),
+        "port": service.port,
+        "obs_port": obs.port,
+        "warmup": warmup_report,
+    }), flush=True)
+
+    while not stop.wait(0.5):
+        pass
+    clean = service.drain()
+    obs.close()
+    return 0 if clean else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,363 @@
+"""Request router: spread traffic across live replicas, lose nothing.
+
+The front half of the replica plane: the supervisor registers replicas
+as they come up (and removes them the moment a drain or death begins),
+and :meth:`Router.route` places each request on the live replica with
+the fewest in-flight requests (least-loaded — with one router process
+this measures true queue pressure, which power-of-two-choices only
+approximates).
+
+**Delivery contract** (what the kill-matrix test asserts): once
+:meth:`route` accepts a request, it returns a result or a *typed* error
+— a replica dying mid-request surfaces here as a connection error and
+the request is transparently re-sent to a surviving replica
+(``router.retries``).  Inference is idempotent, so at-least-once
+re-execution is safe; replies classified *transient*
+(:class:`~sparkdl_tpu.serving.errors.ReplicaDraining`, a replica-side
+``ServerOverloaded``) are also re-routed, while permanent model errors
+propagate untouched.  Only when no live replica remains does the typed
+:class:`~sparkdl_tpu.serving.errors.NoLiveReplicas` surface.
+
+Admission control sits in front: ``max_inflight`` bounds the router's
+total in-flight work (beyond it requests shed with the transient
+``ServerOverloaded``, counted in ``router.shed``) — the knob the SLO
+autoscaler turns together with the replica count.
+
+:meth:`Router.serve` opens the wire-protocol front door the multi-
+process load generators (``benchmarks/bench_load.py``) connect to.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.serving import wire
+from sparkdl_tpu.serving.errors import (
+    NoLiveReplicas,
+    ServerOverloaded,
+)
+from sparkdl_tpu.utils.metrics import metrics
+
+
+class _Backend:
+    """One registered replica: address + a small pool of idle persistent
+    connections + the in-flight count the balancer reads."""
+
+    def __init__(self, name: str, host: str, port: int,
+                 max_idle: int = 8):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.max_idle = int(max_idle)
+        self.lock = threading.Lock()
+        self.idle: List[socket.socket] = []
+        self.inflight = 0
+        self.removed = False
+
+    def checkout(self, timeout_s: float) -> socket.socket:
+        with self.lock:
+            sock = self.idle.pop() if self.idle else None
+        if sock is not None:
+            return sock
+        return wire.connect(self.host, self.port, timeout_s)
+
+    def checkin(self, sock: socket.socket) -> None:
+        with self.lock:
+            if not self.removed and len(self.idle) < self.max_idle:
+                self.idle.append(sock)
+                return
+        _close_quietly(sock)
+
+    def close(self) -> None:
+        with self.lock:
+            self.removed = True
+            doomed, self.idle = self.idle, []
+        for sock in doomed:
+            _close_quietly(sock)
+
+
+def _close_quietly(sock: socket.socket) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class Router:
+    """Least-loaded placement + stranded-request retry over the
+    registered replica set (see module docstring for the contract)."""
+
+    def __init__(
+        self,
+        max_inflight: Optional[int] = None,
+        request_timeout_s: float = 30.0,
+        connect_timeout_s: float = 2.0,
+    ):
+        self._lock = threading.Lock()
+        self._backends: Dict[str, _Backend] = {}
+        self._max_inflight = (
+            int(max_inflight) if max_inflight is not None else None
+        )
+        self._total_inflight = 0
+        self._request_timeout_s = float(request_timeout_s)
+        self._connect_timeout_s = float(connect_timeout_s)
+        self._closed = False
+        self._m_requests = metrics.counter("router.requests")
+        self._m_retries = metrics.counter("router.retries")
+        self._m_errors = metrics.counter("router.errors")
+        self._m_shed = metrics.counter("router.shed")
+        self._m_latency = metrics.histogram("router.latency_ms")
+        self._m_inflight = metrics.gauge("router.inflight")
+        self._m_replicas = metrics.gauge("router.replicas")
+
+    # ------------------------------------------------------------------
+    # membership (the supervisor's side of the interface)
+    # ------------------------------------------------------------------
+    def add(self, name: str, host: str, port: int) -> None:
+        with self._lock:
+            old = self._backends.pop(name, None)
+            self._backends[name] = _Backend(name, host, port)
+            self._m_replicas.set(len(self._backends))
+        if old is not None:
+            old.close()
+
+    def remove(self, name: str) -> None:
+        """Stop placing on ``name`` (drain-begin or death).  In-flight
+        requests on its sockets fail over on their own."""
+        with self._lock:
+            backend = self._backends.pop(name, None)
+            self._m_replicas.set(len(self._backends))
+        if backend is not None:
+            backend.close()
+
+    def names(self) -> Tuple[str, ...]:
+        with self._lock:
+            return tuple(self._backends)
+
+    def set_max_inflight(self, n: Optional[int]) -> None:
+        """The admission limit — the autoscaler's second knob."""
+        with self._lock:
+            self._max_inflight = int(n) if n is not None else None
+
+    @property
+    def max_inflight(self) -> Optional[int]:
+        with self._lock:
+            return self._max_inflight
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+    def _admit(self) -> None:
+        with self._lock:
+            limit = self._max_inflight
+            if limit is not None and self._total_inflight >= limit:
+                self._m_shed.add(1)
+                raise ServerOverloaded(
+                    f"router at admission limit ({limit} in flight); "
+                    "load-shedding"
+                )
+            self._total_inflight += 1
+            self._m_inflight.set(self._total_inflight)
+
+    def _release(self) -> None:
+        with self._lock:
+            self._total_inflight -= 1
+            self._m_inflight.set(self._total_inflight)
+
+    def _pick(self, tried) -> Optional[_Backend]:
+        """Live backend with the fewest in-flight, excluding ``tried``."""
+        with self._lock:
+            candidates = [
+                b for b in self._backends.values()
+                if b.name not in tried and not b.removed
+            ]
+            if not candidates:
+                return None
+            best = min(candidates, key=lambda b: b.inflight)
+            best.inflight += 1
+            return best
+
+    def _unpick(self, backend: _Backend) -> None:
+        with self._lock:
+            backend.inflight -= 1
+
+    def route(
+        self,
+        value: Any,
+        model_id: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        timeout_s: Optional[float] = None,
+    ):
+        """Place one request; returns the model output row or raises a
+        typed error.  Retries connection failures and transient replies
+        on other live replicas until the replica set is exhausted."""
+        self._admit()
+        start = time.monotonic()
+        budget = (
+            timeout_s if timeout_s is not None else self._request_timeout_s
+        )
+        deadline = start + budget
+        try:
+            inject.fire("router.route")
+            self._m_requests.add(1)
+            tried: set = set()
+            last_exc: Optional[BaseException] = None
+            while True:
+                backend = self._pick(tried)
+                if backend is None:
+                    self._m_errors.add(1)
+                    if last_exc is not None:
+                        raise last_exc
+                    raise NoLiveReplicas(
+                        "no live replica to place the request on "
+                        f"(tried {sorted(tried) or 'none'})"
+                    )
+                try:
+                    result = self._send_one(
+                        backend, value, model_id, deadline_ms,
+                        max(0.05, deadline - time.monotonic()),
+                    )
+                except (ConnectionError, OSError, socket.timeout) as exc:
+                    # the stranded-request case: the replica died (or
+                    # wedged) under this request — re-place it
+                    tried.add(backend.name)
+                    last_exc = exc
+                    self._m_retries.add(1)
+                    continue
+                except Exception as exc:
+                    from sparkdl_tpu.resilience.errors import is_transient
+
+                    if is_transient(exc):
+                        # draining / replica-side shed: try elsewhere
+                        tried.add(backend.name)
+                        last_exc = exc
+                        self._m_retries.add(1)
+                        continue
+                    self._m_errors.add(1)
+                    raise
+                finally:
+                    self._unpick(backend)
+                self._m_latency.observe(
+                    (time.monotonic() - start) * 1000.0
+                )
+                return result
+        finally:
+            self._release()
+
+    def _send_one(self, backend, value, model_id, deadline_ms,
+                  timeout_s: float):
+        sock = backend.checkout(self._connect_timeout_s)
+        try:
+            sock.settimeout(timeout_s)
+            wire.send_msg(sock, {
+                "op": "infer",
+                "model_id": model_id,
+                "value": value,
+                "deadline_ms": deadline_ms,
+            })
+            reply = wire.recv_msg(sock)
+        except BaseException:
+            _close_quietly(sock)
+            raise
+        if reply is None:
+            _close_quietly(sock)
+            raise ConnectionError(
+                f"replica {backend.name!r} closed the connection "
+                "mid-request"
+            )
+        backend.checkin(sock)
+        if reply.get("ok"):
+            return reply["result"]
+        raise wire.decode_error(reply)
+
+    # ------------------------------------------------------------------
+    # front door (what the load generators connect to)
+    # ------------------------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Open the wire-protocol front door; returns the bound port.
+        Each generator connection gets a handler thread that loops
+        ``infer`` frames through :meth:`route`."""
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                self.request.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+                while True:
+                    try:
+                        msg = wire.recv_msg(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    if msg is None:
+                        return
+                    if msg.get("op") == "ping":
+                        reply: Dict[str, Any] = {
+                            "ok": True, "replicas": outer.names(),
+                        }
+                    else:
+                        try:
+                            reply = {"ok": True, "result": outer.route(
+                                msg["value"],
+                                model_id=msg.get("model_id"),
+                                deadline_ms=msg.get("deadline_ms"),
+                            )}
+                        except Exception as exc:
+                            reply = wire.encode_error(exc)
+                    try:
+                        wire.send_msg(self.request, reply)
+                    except (ConnectionError, OSError):
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("router is closed")
+            if getattr(self, "_front", None) is not None:
+                return self._front.server_address[1]
+            self._front = Server((host, int(port)), Handler)
+            self._front_thread = threading.Thread(
+                target=self._front.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name="sparkdl-router-front",
+                daemon=True,
+            )
+            self._front_thread.start()
+            return self._front.server_address[1]
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            backends = list(self._backends.values())
+            self._backends.clear()
+            front = getattr(self, "_front", None)
+            front_thread = getattr(self, "_front_thread", None)
+            self._front = None
+            self._front_thread = None
+        for backend in backends:
+            backend.close()
+        if front is not None:
+            front.shutdown()
+            front.server_close()
+        if front_thread is not None and front_thread.is_alive():
+            front_thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __repr__(self):
+        return (
+            f"Router(replicas={sorted(self.names())}, "
+            f"max_inflight={self.max_inflight})"
+        )

@@ -1,0 +1,129 @@
+"""Framed request/response wire protocol between router and replicas.
+
+The replica plane is process-per-replica (a SIGKILL must take out ONE
+replica, not the server), so requests cross a process boundary.  This
+module is the one definition of that boundary: length-prefixed pickle
+frames over a loopback TCP socket — no new dependencies, ndarray
+payloads round-trip at memcpy speed, and a half-written frame from a
+killed replica surfaces as a clean ``ConnectionError`` the router can
+retry, never a torn object.
+
+Security note: frames are **pickle** and the sockets bind loopback by
+default — this is an intra-host data plane between processes the
+supervisor itself spawned, not an internet-facing protocol.  Anything
+that can reach the port can already signal the processes.
+
+Typed errors cross the boundary by *class name*: a replica encodes an
+exception as ``{"error_class": ..., "error": ...}`` and the router
+re-raises the same class when it is one of the sanctioned serving /
+resilience types (so ``isinstance`` retry decisions — transient vs
+permanent — survive the hop), falling back to
+:class:`~sparkdl_tpu.serving.errors.RemoteReplicaError` otherwise.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any, Dict, Optional
+
+_LEN = struct.Struct(">I")
+
+#: refuse frames beyond this (a torn length prefix must not allocate GBs)
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    """Serialize ``obj`` as one length-prefixed frame."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> Optional[Any]:
+    """One frame, or None on clean EOF.  A connection that dies mid-frame
+    raises ``ConnectionError`` (the router's retry trigger)."""
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (length,) = _LEN.unpack(head)
+    if length > MAX_FRAME_BYTES:
+        raise ConnectionError(
+            f"frame of {length} bytes exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}) — torn or hostile stream"
+        )
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        raise ConnectionError("connection closed mid-frame")
+    return pickle.loads(payload)
+
+
+def _error_registry() -> Dict[str, type]:
+    """Class-name -> class for the typed errors sanctioned to cross the
+    wire (lazy: errors modules import this one's siblings)."""
+    from sparkdl_tpu.resilience.errors import (
+        CircuitOpen,
+        DeadlineExceeded,
+        PermanentError,
+        TransientError,
+    )
+    from sparkdl_tpu.serving import errors as serving_errors
+
+    registry: Dict[str, type] = {
+        cls.__name__: cls
+        for cls in (CircuitOpen, DeadlineExceeded, PermanentError,
+                    TransientError)
+    }
+    for name in serving_errors.__dict__:
+        obj = serving_errors.__dict__[name]
+        if isinstance(obj, type) and issubclass(obj, Exception):
+            registry[name] = obj
+    return registry
+
+
+def encode_error(exc: BaseException) -> Dict[str, str]:
+    return {
+        "ok": False,
+        "error_class": type(exc).__name__,
+        "error": str(exc),
+    }
+
+
+def decode_error(reply: Dict[str, Any]) -> BaseException:
+    """Re-hydrate a typed error reply; unknown classes come back as the
+    catch-all :class:`~sparkdl_tpu.serving.errors.RemoteReplicaError`
+    (permanent — the router must not blind-retry a failure it cannot
+    classify)."""
+    from sparkdl_tpu.serving.errors import RemoteReplicaError
+
+    cls = _error_registry().get(reply.get("error_class", ""))
+    message = reply.get("error", "remote replica error")
+    if cls is None:
+        return RemoteReplicaError(
+            f"{reply.get('error_class', 'UnknownError')}: {message}"
+        )
+    try:
+        return cls(message)
+    except Exception:  # exotic __init__ signature
+        return RemoteReplicaError(
+            f"{reply.get('error_class')}: {message}"
+        )
+
+
+def connect(host: str, port: int, timeout_s: float) -> socket.socket:
+    """A connected loopback socket with TCP_NODELAY (the frames are
+    small and latency-bound; Nagle would serialize the micro-batcher's
+    linger window behind the kernel's)."""
+    sock = socket.create_connection((host, port), timeout=timeout_s)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock

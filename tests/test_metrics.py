@@ -308,3 +308,28 @@ def test_timer_add_seconds_accumulates():
     t.add_seconds(0.25)
     t.add_seconds(0.75)
     assert t.seconds == 1.0 and t.entries == 2
+
+
+def test_cpu_scale_shrinks_featurizer_workload(monkeypatch):
+    """benchlib CPU-fallback scaling (the r05-r09 bench wedge fix):
+    explicit > env > auto-detect precedence, and the scaled workload
+    keeps scan >= 2 so the anti-caching methodology survives."""
+    from sparkdl_tpu.utils import benchlib
+
+    # identity below/at 1
+    assert benchlib.scale_featurizer_workload(512, 24, 3, 1) == (512, 24, 3)
+    # the headline shape at the default CPU scale: small but still a
+    # real scan over distinct batches
+    b, s, r = benchlib.scale_featurizer_workload(512, 24, 3, 32)
+    assert b == 16 and s >= 2 and r == 2
+    # never degenerates to zero
+    b, s, r = benchlib.scale_featurizer_workload(1, 2, 1, 1000)
+    assert b >= 1 and s >= 2 and r >= 1
+
+    # precedence: explicit beats env beats auto
+    monkeypatch.setenv(benchlib.CPU_SCALE_ENV, "7")
+    assert benchlib.resolve_cpu_scale(3) == 3
+    assert benchlib.resolve_cpu_scale() == 7
+    monkeypatch.delenv(benchlib.CPU_SCALE_ENV)
+    # this environment is CPU-only, so auto-detect engages the default
+    assert benchlib.resolve_cpu_scale() == benchlib.DEFAULT_CPU_SCALE

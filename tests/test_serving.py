@@ -484,3 +484,129 @@ class TestConstructors:
                 )
         finally:
             del tpu_session.udf._udfs["plain_py_udf"]
+
+
+# ----------------------------------------------------------------------
+# offer_wait races (ISSUE-10 satellite): a blocked backpressure poller
+# must wake with a TYPED error when the server closes or the breaker
+# opens underneath it — never hang on a queue nobody will drain again
+# ----------------------------------------------------------------------
+class TestOfferWaitRaces:
+    def _full_queue(self, capacity=1):
+        from sparkdl_tpu.serving.admission import AdmissionQueue, Request
+
+        q = AdmissionQueue(capacity)
+        for _ in range(capacity):
+            q.offer(Request(value=np.zeros(4, np.float32)))
+        return q, Request
+
+    def test_blocked_offer_wait_wakes_on_close_with_typed_error(self):
+        q, Request = self._full_queue()
+        outcome = {}
+        blocked = threading.Event()
+
+        def poller():
+            blocked.set()
+            try:
+                q.offer_wait(Request(value=np.zeros(4, np.float32)))
+                outcome["returned"] = True
+            except BaseException as exc:  # noqa: BLE001
+                outcome["error"] = exc
+
+        t = threading.Thread(target=poller, daemon=True)
+        t.start()
+        assert blocked.wait(5)
+        time.sleep(0.1)  # let the poller reach the Condition wait
+        assert not outcome, "poller should be blocked on the full queue"
+        q.close()
+        t.join(timeout=5)
+        assert not t.is_alive(), "offer_wait hung across close()"
+        assert isinstance(outcome.get("error"), ServerClosed)
+
+    def test_offer_wait_timeout_on_full_queue_returns_false(self):
+        q, Request = self._full_queue()
+        t0 = time.monotonic()
+        admitted = q.offer_wait(
+            Request(value=np.zeros(4, np.float32)), timeout_s=0.2
+        )
+        assert admitted is False
+        assert time.monotonic() - t0 < 5.0
+
+    def test_offer_wait_unblocks_when_take_frees_space(self):
+        q, Request = self._full_queue()
+        result = {}
+
+        def poller():
+            result["admitted"] = q.offer_wait(
+                Request(value=np.zeros(4, np.float32)), timeout_s=10.0
+            )
+
+        t = threading.Thread(target=poller, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert q.take(1, max_wait_s=0.0)  # frees one slot
+        t.join(timeout=5)
+        assert result.get("admitted") is True
+
+    def test_blocked_offer_wait_drains_through_open_breaker(self):
+        """End to end: the forward starts failing, the breaker trips,
+        the worker fast-fails the backlog — and the poller blocked in
+        ``offer_wait`` is admitted (queue drained) with its request
+        resolved as a typed ``CircuitOpen``, not stranded."""
+        from sparkdl_tpu.resilience.errors import CircuitOpen
+        from sparkdl_tpu.serving.admission import Request
+
+        gate = threading.Event()
+
+        def failing_forward(x):
+            gate.wait(30.0)
+            raise RuntimeError("forward is down")
+
+        server = ModelServer(ServingConfig(
+            max_batch=1, max_wait_ms=1.0, queue_capacity=2,
+            breaker_threshold=2,
+        ))
+        server.register(
+            "down", failing_forward, item_shape=(4,), compile=False
+        )
+        batcher = server._endpoints["down"]
+        try:
+            # r1 is taken by the worker (blocked in forward on the
+            # gate); r2 + r3 then fill the queue to capacity
+            futures = [server.submit(np.zeros(4, np.float32))]
+            deadline = time.monotonic() + 10.0
+            while len(batcher._queue) and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not len(batcher._queue), "worker never took r1"
+            futures += [
+                server.submit(np.zeros(4, np.float32)) for _ in range(2)
+            ]
+            blocked_req = Request(value=np.zeros(4, np.float32))
+            admitted = {}
+
+            def poller():
+                admitted["ok"] = batcher._queue.offer_wait(
+                    blocked_req, timeout_s=30.0
+                )
+
+            t = threading.Thread(target=poller, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            assert not admitted, "queue should be full, poller blocked"
+
+            gate.set()  # failures flow: 2 failed batches open the breaker
+            t.join(timeout=20)
+            assert admitted.get("ok") is True, (
+                "poller not admitted after the breaker drained the queue"
+            )
+            assert batcher.breaker.state == "open"
+            # the admitted request is resolved, typed — not stranded
+            assert isinstance(
+                blocked_req.future.exception(timeout=10), CircuitOpen
+            )
+            # the backlog got typed failures too, not hangs
+            for fut in futures:
+                assert fut.exception(timeout=10) is not None
+        finally:
+            gate.set()
+            server.close()

@@ -1563,3 +1563,85 @@ def test_repo_warm_scan_is_fast(tmp_path):
     assert warm.cache_status == "warm"
     assert warm.elapsed_s < 10.0, warm.elapsed_s
     assert warm.exit_code in (0, 1)  # findings governed by the baseline
+
+
+# ---------------------------------------------------------------------------
+# PR 10: replica-plane scope (TCP servers, spawned processes, new
+# metric prefixes)
+# ---------------------------------------------------------------------------
+
+def test_resource_lifecycle_covers_tcp_servers_and_popen(tmp_path):
+    """The supervisor plane's resources are in scope: a wire-protocol
+    ``ThreadingTCPServer`` needs a shutdown path and a spawned replica
+    ``Popen`` needs a reap path (wait/communicate) or every restart
+    cycle leaves a zombie."""
+    report = check_snippet(
+        tmp_path, "serving/x.py",
+        """
+        import socketserver
+        import subprocess
+
+        def bad_tcp(handler):
+            srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), handler)
+            srv.serve_forever()
+
+        def bad_spawn(cmd):
+            proc = subprocess.Popen(cmd)
+            return proc.pid
+        """,
+        rules=["resource-lifecycle"],
+    )
+    msgs = sorted(f.message for f in report.findings)
+    assert len(msgs) == 2, msgs
+    assert any("ThreadingTCPServer with no shutdown()" in m for m in msgs)
+    assert any("Popen with no wait()/communicate() reap path" in m
+               for m in msgs)
+
+
+def test_resource_lifecycle_tcp_and_popen_reclaim_paths(tmp_path):
+    """Split lifecycles are honored: the server shut down in ``stop()``
+    and the child reaped in another method are clean, as is a Popen
+    context manager."""
+    report = check_snippet(
+        tmp_path, "serving/x.py",
+        """
+        import socketserver
+        import subprocess
+
+        class Sup:
+            def start(self, handler, cmd):
+                self._tcp = socketserver.ThreadingTCPServer(
+                    ("127.0.0.1", 0), handler)
+                self._proc = subprocess.Popen(cmd)
+
+            def stop(self):
+                self._tcp.shutdown()
+                self._tcp.server_close()
+                self._proc.wait(timeout=10)
+
+        def ok_with(cmd):
+            with subprocess.Popen(cmd) as proc:
+                return proc.communicate()
+        """,
+        rules=["resource-lifecycle"],
+    )
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+def test_metric_name_rule_sanctions_replica_plane_prefixes(tmp_path):
+    """``supervisor.`` (replica lifecycle) and ``router.`` (request
+    plane) are sanctioned; a lookalike is not."""
+    report = check_snippet(
+        tmp_path, "serving/x.py",
+        """
+        from sparkdl_tpu.utils.metrics import metrics
+        metrics.gauge("supervisor.replicas").set(2)
+        metrics.counter("supervisor.restarts").add(1)
+        metrics.counter("router.retries").add(1)
+        metrics.histogram("router.latency_ms").observe(1.0)
+        metrics.counter("routers.requests").add(1)
+        """,
+        rules=["metric-name"],
+    )
+    assert len(report.findings) == 1, [f.message for f in report.findings]
+    assert "routers.requests" in report.findings[0].message
