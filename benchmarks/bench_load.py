@@ -29,6 +29,15 @@ loss count (accepted requests that failed: the number that must be 0),
 live-replica recovery time, p99 recovery time, and the restarted
 replica's warmup sources (``disk`` = compile-cache-warm restart).
 
+Since ISSUE-11 the report also breaks down what the data plane itself
+costs: ``server_ms`` (replica-reported queue+forward time),
+``router_overhead_ms`` (front-door round trip minus ``server_ms`` —
+the number the zero-copy wire is meant to shrink), and a ``wire``
+section with router-side serialize/copy/deserialize timers and lane
+counters.  ``--transport tcp|shm`` pins the router->replica lane and
+``--assert-lane`` turns the negotiated outcome into an exit code (CI
+proves shm engaged, and that disabling shm falls back to tcp).
+
 ``--smoke`` is the CI mode (<60 s): 2 replicas, sustained load, one
 planned kill; exits non-zero unless zero accepted requests were lost
 and the dead replica came back.
@@ -36,7 +45,7 @@ and the dead replica came back.
     JAX_PLATFORMS=cpu python benchmarks/bench_load.py --smoke
     JAX_PLATFORMS=cpu python benchmarks/bench_load.py \
         --scenario kill --duration 40 --rate 120 --compile \
-        --out BENCH_LOAD_r10.json
+        --transport shm --out BENCH_LOAD_r11.json
 """
 
 import argparse
@@ -100,7 +109,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
     mean_burst = 1.0 / (1.0 - burst_p)
     base_event_rate = max(args_dict["rate_per_worker"] / mean_burst, 0.1)
 
-    records = []  # (t_rel, latency_ms, outcome)
+    records = []  # (t_rel, latency_ms, outcome, server_ms)
     sock = None
     start = time.monotonic()
     while True:
@@ -119,6 +128,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                 break
             endpoint = rng.choices(endpoints, weights=weights)[0]
             t0 = time.monotonic()
+            server_ms = None
             try:
                 if sock is None:
                     sock = wire.connect(host, port, 5.0)
@@ -129,10 +139,11 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                 reply = wire.recv_msg(sock)
                 if reply is None:
                     raise ConnectionError("front door EOF")
-                outcome = (
-                    "ok" if reply.get("ok")
-                    else reply.get("error_class", "UnknownError")
-                )
+                if reply.get("ok"):
+                    outcome = "ok"
+                    server_ms = reply.get("server_ms")
+                else:
+                    outcome = reply.get("error_class", "UnknownError")
             except Exception as exc:
                 outcome = f"conn:{type(exc).__name__}"
                 try:
@@ -142,9 +153,10 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                     pass
                 sock = None
             latency_ms = (time.monotonic() - t0) * 1000.0
-            records.append(
-                (round(t0 - start, 4), round(latency_ms, 3), outcome)
-            )
+            records.append((
+                round(t0 - start, 4), round(latency_ms, 3), outcome,
+                server_ms,
+            ))
     if sock is not None:
         try:
             sock.close()
@@ -186,7 +198,7 @@ def _timeline(records, duration_s):
         rows = [r for r in records if sec <= r[0] < sec + 1]
         if not rows:
             continue
-        ok_lat = sorted(lat for _, lat, out in rows if out == "ok")
+        ok_lat = sorted(r[1] for r in rows if r[2] == "ok")
         shed = sum(1 for r in rows if r[2] in _SHED_CLASSES)
         lost = sum(
             1 for r in rows if r[2] != "ok" and r[2] not in _SHED_CLASSES
@@ -240,6 +252,10 @@ def run(args):
     if args.cache_dir:
         os.makedirs(args.cache_dir, exist_ok=True)
         os.environ["SPARKDL_COMPILE_CACHE"] = args.cache_dir
+    if args.transport:
+        # before the supervisor starts: the router builds one transport
+        # per backend at replica-ready time
+        os.environ["SPARKDL_WIRE_TRANSPORT"] = args.transport
 
     from sparkdl_tpu.serving.replica import ReplicaSpec
     from sparkdl_tpu.serving.supervisor import ReplicaSupervisor
@@ -278,6 +294,9 @@ def run(args):
         "burst_p": args.burst_p,
         "compile": bool(args.compile),
         "compile_cache": bool(args.cache_dir),
+        "transport_mode": args.transport or os.environ.get(
+            "SPARKDL_WIRE_TRANSPORT", "auto"
+        ),
         "autoscale": None,
         "fault_plan": fault_plans[0] if fault_plans else None,
         "seed": args.seed,
@@ -389,6 +408,34 @@ def run(args):
         restarted = [
             r for r in final["replicas"] if r["generation"] > 1
         ]
+        # router-added overhead: front-door round trip minus the time
+        # the replica itself spent on the request (queue + forward) —
+        # what the data plane costs on top of the model
+        server_vals = [r[3] for r in ok if r[3] is not None]
+        overhead_vals = [
+            r[1] - r[3] for r in ok if r[3] is not None
+        ]
+        # wire.* codec accounting from the router process (the replica
+        # side keeps its own registry; the router's is what the front
+        # door adds per hop)
+        from sparkdl_tpu.utils.metrics import metrics
+        breakdown = {}
+        for stage in ("serialize", "copy", "deserialize"):
+            t = metrics.timer(f"wire.{stage}_seconds")
+            breakdown[stage] = {
+                "total_s": round(t.seconds, 4),
+                "entries": t.entries,
+                "mean_us": round(1e6 * t.seconds / t.entries, 2)
+                if t.entries else None,
+            }
+        wire_total_s = sum(d["total_s"] for d in breakdown.values())
+        wire_counters = {
+            k: v for k, v in metrics.snapshot(prefix="wire").items()
+            if not k.endswith("_seconds.seconds")
+        }
+        server_mean = (
+            sum(server_vals) / len(server_vals) if server_vals else None
+        )
         report.update({
             "wall_s": round(wall_s, 2),
             "sent": len(records),
@@ -401,6 +448,22 @@ def run(args):
             "goodput_rps": round(len(ok) / wall_s, 2),
             "offered_rps": round(len(records) / wall_s, 2),
             "latency_ms": _latency_stats([r[1] for r in ok]),
+            "server_ms": _latency_stats(server_vals),
+            "router_overhead_ms": _latency_stats(overhead_vals),
+            "wire": {
+                "breakdown": breakdown,
+                "total_s": round(wire_total_s, 4),
+                # router-side codec time amortized per successful
+                # request, and its share of replica time — the
+                # "<10% of forward" acceptance number
+                "ms_per_ok": round(1e3 * wire_total_s / len(ok), 4)
+                if ok else None,
+                "share_of_server": round(
+                    (1e3 * wire_total_s / len(ok)) / server_mean, 4
+                ) if ok and server_mean else None,
+                "counters": wire_counters,
+            },
+            "router_lanes": final["router"]["lanes"],
             "timeline": timeline,
             "kill": _recovery(timeline, events, kill_t, args.replicas),
             "restarts": {
@@ -458,6 +521,16 @@ def main():
     ap.add_argument("--cache-dir", default=None,
                     help="SPARKDL_COMPILE_CACHE dir replicas inherit — "
                     "makes restarts disk-warm")
+    ap.add_argument("--transport", default=None,
+                    choices=["auto", "tcp", "shm"],
+                    help="router->replica lane (sets "
+                    "SPARKDL_WIRE_TRANSPORT); auto negotiates shm for "
+                    "colocated replicas with tcp fallback")
+    ap.add_argument("--assert-lane", default=None,
+                    choices=["tcp", "shm"], metavar="LANE",
+                    help="exit non-zero unless every backend ended the "
+                    "run on LANE (proves shm engaged, or that fallback "
+                    "to tcp happened)")
     ap.add_argument("--autoscale", action="store_true",
                     help="run the SLO autoscaler control loop")
     ap.add_argument("--slo-p99-ms", type=float, default=250.0)
@@ -486,6 +559,18 @@ def main():
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2, default=str)
         print(f"wrote {args.out}", file=sys.stderr)
+
+    if args.assert_lane:
+        lanes = set(report.get("router_lanes", {}).values())
+        if lanes != {args.assert_lane}:
+            print(
+                f"LANE FAIL: wanted every backend on "
+                f"{args.assert_lane!r}, got {report.get('router_lanes')}",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"LANE OK: all backends on {args.assert_lane!r}",
+              file=sys.stderr)
 
     if args.smoke:
         problems = []

@@ -41,14 +41,29 @@ if ! python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
   exit 1
 fi
 
-# replica-kill smoke (<60 s, ISSUE-10): 2 replica processes under
-# sustained load, a FaultPlan SIGKILL-equivalent takes one out
+# replica-kill smoke (<60 s total, ISSUE-10/11): 2 replica processes
+# under sustained load, a FaultPlan SIGKILL-equivalent takes one out
 # mid-request, and the harness itself asserts zero accepted-request
 # loss (the stranded request retried on the survivor) plus supervisor
-# recovery.  --smoke exits non-zero on any violated invariant.
-if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke; then
-  echo "replica-kill smoke FAILED (accepted-request loss, no recovery," >&2
-  echo "or >60s wall — see the report line above)" >&2
+# recovery.  --smoke exits non-zero on any violated invariant.  Run
+# once per wire lane (--assert-lane fails the run if the lane the
+# router actually negotiated isn't the one under test), then prove the
+# shm->tcp fallback: replicas refuse the shm handshake when
+# SPARKDL_WIRE_SHM_DISABLE=1, and the router must transparently land
+# every backend on tcp even though shm was requested.
+for lane in tcp shm; do
+  if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
+      --transport "$lane" --assert-lane "$lane"; then
+    echo "replica-kill smoke FAILED on the $lane lane (accepted-request" >&2
+    echo "loss, no recovery, wrong lane, or >60s wall — see above)" >&2
+    exit 1
+  fi
+done
+if ! timeout -k 10 60 env SPARKDL_WIRE_SHM_DISABLE=1 \
+    python benchmarks/bench_load.py --smoke \
+    --transport shm --assert-lane tcp; then
+  echo "shm->tcp fallback smoke FAILED: with shm disabled on the" >&2
+  echo "replicas, a shm-mode router must still serve on tcp" >&2
   exit 1
 fi
 

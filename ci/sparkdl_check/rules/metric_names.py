@@ -21,10 +21,11 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: "slo" (burn-rate gauges/transitions) and "ts" (time-series recorder
 #: self-metrics) joined with the PR-8 telemetry plane; "supervisor"
 #: (replica lifecycle) and "router" (request plane) with the ISSUE-10
-#: replica supervisor.
+#: replica supervisor; "wire" (frame codec + transport lanes) with the
+#: ISSUE-11 zero-copy data plane.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
-    "streaming", "slo", "ts", "supervisor", "router",
+    "streaming", "slo", "ts", "supervisor", "router", "wire",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

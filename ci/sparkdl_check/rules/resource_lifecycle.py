@@ -29,6 +29,12 @@ lexically, file-wide:
     processes need a reap path or every supervisor restart cycle
     leaves a zombie.
 
+``SharedMemory(...)``
+    Must have ``.close(`` or ``.unlink(`` reachable on its spelling —
+    a shm segment nobody closes pins kernel memory past the owner, and
+    one nobody ever unlinks leaks a ``/dev/shm`` file until reboot
+    (ISSUE-11 shm lane).
+
 "Somewhere in the file under the same spelling" is deliberately
 generous: lifecycle protocols legitimately split across methods
 (``start()`` assigns ``self._thread``, ``stop()`` joins it).  What the
@@ -52,6 +58,10 @@ _SERVER_CTORS = {
 #: spawned OS processes must have a reap path — a Popen nobody waits on
 #: is a zombie on every supervisor restart cycle
 _PROCESS_CTORS = {"Popen"}
+#: shm segments must have a close/unlink path — an unclosed mapping pins
+#: kernel memory, and a never-unlinked name leaks a /dev/shm file until
+#: reboot (the creator owns unlink; attachers at least close)
+_SHM_CTORS = {"SharedMemory"}
 
 
 def _ctor(call: ast.Call) -> Optional[str]:
@@ -181,5 +191,16 @@ class ResourceLifecycleRule(Rule):
                     "an unreaped child is a zombie on every restart "
                     "cycle; every spawned process needs a spelled-out "
                     "wait",
+                ))
+            elif ctor in _SHM_CTORS:
+                if spelling is not None and reclaimed(
+                        spelling, ("close", "unlink")):
+                    continue
+                findings.append(self.finding(
+                    ctx, node,
+                    f"{ctor} with no close()/unlink() path — an "
+                    "unclosed segment pins kernel memory and a "
+                    "never-unlinked one leaks a /dev/shm file until "
+                    "reboot",
                 ))
         return findings

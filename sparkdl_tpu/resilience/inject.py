@@ -65,6 +65,10 @@ KNOWN_SITES = {
         "supervisor.replica_warm", "supervisor.replica_serve",
     ),
     "router": ("router.route",),
+    # shm request path in the router's shm client channel — error/stall
+    # rules here exercise the lane's failure handling without killing
+    # the router process
+    "wire": ("wire.shm",),
 }
 
 

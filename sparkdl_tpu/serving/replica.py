@@ -16,8 +16,10 @@ is cache-load-fast), reports liveness via the PR-8
 Lifecycle contract (what the supervisor and router rely on):
 
 - **ready line** — exactly one JSON line on stdout once warm and
-  listening: ``{"ready": true, "pid", "port", "obs_port", "warmup"}``;
-  everything after goes to stderr.
+  listening: ``{"ready": true, "pid", "port", "obs_port", "lanes",
+  "warmup"}``; everything after goes to stderr.  ``lanes`` is the wire
+  transports this replica accepts (the supervisor forwards it to
+  ``router.add``, where lane selection happens).
 - **SIGTERM = drain** — stop admitting (new requests get the transient
   :class:`~sparkdl_tpu.serving.errors.ReplicaDraining`, which the router
   re-routes), finish every in-flight request, flush/close the server,
@@ -49,6 +51,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.serving import transport as transport_mod
 from sparkdl_tpu.serving import wire
 from sparkdl_tpu.serving.errors import ReplicaDraining
 from sparkdl_tpu.utils.metrics import metrics
@@ -168,7 +171,14 @@ class ReplicaService:
 
     - ``{"op": "ping"}`` -> ``{"ok": true, "pid", "draining"}``
     - ``{"op": "infer", "model_id", "value", "deadline_ms"}`` ->
-      ``{"ok": true, "result"}`` or a typed error reply
+      ``{"ok": true, "result", "server_ms"}`` or a typed error reply
+
+    Connections are served through
+    :func:`~sparkdl_tpu.serving.transport.serve_connection`, so a
+    router may upgrade any of them to the shared-memory lane and
+    coalesced ``KIND_BATCH`` frames fan out through :meth:`_handle_batch`
+    (submit-all-then-gather — the whole batch lands in one micro-batcher
+    window instead of serializing N round trips).
     """
 
     def __init__(
@@ -177,9 +187,11 @@ class ReplicaService:
         host: str = "127.0.0.1",
         port: int = 0,
         request_timeout_s: float = 30.0,
+        allow_shm: Optional[bool] = None,
     ):
         self._server = server
         self._request_timeout_s = float(request_timeout_s)
+        self._allow_shm = allow_shm
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
         self._inflight = 0
@@ -193,21 +205,12 @@ class ReplicaService:
                 self.request.setsockopt(
                     socketmod.IPPROTO_TCP, socketmod.TCP_NODELAY, 1
                 )
-                while True:
-                    try:
-                        msg = wire.recv_msg(self.request)
-                    except (ConnectionError, OSError):
-                        return
-                    if msg is None:
-                        return
-                    try:
-                        reply = outer._handle_one(msg)
-                    except Exception as exc:
-                        reply = wire.encode_error(exc)
-                    try:
-                        wire.send_msg(self.request, reply)
-                    except (ConnectionError, OSError):
-                        return
+                transport_mod.serve_connection(
+                    self.request,
+                    outer._handle_one,
+                    handle_batch=outer._handle_batch,
+                    allow_shm=outer._allow_shm,
+                )
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -235,11 +238,56 @@ class ReplicaService:
         with self._lock:
             return self._draining
 
+    @property
+    def lanes(self) -> Tuple[str, ...]:
+        """Wire lanes this replica will accept, advertised in the ready
+        line (shm honours ``SPARKDL_WIRE_SHM_DISABLE``)."""
+        allow = self._allow_shm
+        if allow is None:
+            allow = os.environ.get(
+                transport_mod.ENV_SHM_DISABLE, "0"
+            ) != "1"
+        if allow and transport_mod.shm_supported():
+            return ("tcp", "shm")
+        return ("tcp",)
+
     def _handle_one(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        staged = self._submit(msg)
+        if staged[0] == "reply":
+            return staged[1]
+        return self._finish(staged[1], staged[2])
+
+    def _handle_batch(
+        self, msgs: list
+    ) -> list:
+        """A coalesced ``KIND_BATCH`` frame: submit every request first
+        (they share one micro-batcher admission window), then gather the
+        futures in order.  Per-message failures become typed error
+        replies — one bad request never poisons its batchmates."""
+        staged = []
+        for msg in msgs:
+            try:
+                staged.append(self._submit(msg))
+            except Exception as exc:
+                staged.append(("error", wire.encode_error(exc)))
+        replies = []
+        for item in staged:
+            if item[0] == "reply" or item[0] == "error":
+                replies.append(item[1])
+                continue
+            try:
+                replies.append(self._finish(item[1], item[2]))
+            except Exception as exc:
+                replies.append(wire.encode_error(exc))
+        return replies
+
+    def _submit(self, msg: Dict[str, Any]):
+        """Admit + submit one request; returns ``("reply", dict)`` for
+        control ops or ``("future", fut, t0)`` for inference."""
         op = msg.get("op")
         if op == "ping":
-            return {"ok": True, "pid": os.getpid(),
-                    "draining": self.draining}
+            return ("reply", {"ok": True, "pid": os.getpid(),
+                              "draining": self.draining})
         if op != "infer":
             raise ValueError(f"unknown wire op {op!r}")
         with self._lock:
@@ -249,6 +297,7 @@ class ReplicaService:
                 )
             self._inflight += 1
             self._m_inflight.set(self._inflight)
+        ok = False
         try:
             inject.fire("supervisor.replica_serve")
             self._m_requests.add(1)
@@ -257,14 +306,31 @@ class ReplicaService:
                 model_id=msg.get("model_id"),
                 deadline_ms=msg.get("deadline_ms"),
             )
-            result = fut.result(timeout=self._request_timeout_s)
-            return {"ok": True, "result": np.asarray(result)}
+            ok = True
+            return ("future", fut, time.monotonic())
         finally:
-            with self._idle:
-                self._inflight -= 1
-                self._m_inflight.set(self._inflight)
-                if self._inflight == 0:
-                    self._idle.notify_all()
+            if not ok:
+                self._done_one()
+
+    def _finish(self, fut, t0: float) -> Dict[str, Any]:
+        try:
+            result = fut.result(timeout=self._request_timeout_s)
+            return {
+                "ok": True,
+                "result": np.asarray(result),
+                # submit->result time: what the bench subtracts from
+                # client latency to get router-added overhead
+                "server_ms": round((time.monotonic() - t0) * 1000.0, 3),
+            }
+        finally:
+            self._done_one()
+
+    def _done_one(self) -> None:
+        with self._idle:
+            self._inflight -= 1
+            self._m_inflight.set(self._inflight)
+            if self._inflight == 0:
+                self._idle.notify_all()
 
     # ------------------------------------------------------------------
     def drain(self, timeout_s: float = DRAIN_TIMEOUT_S) -> bool:
@@ -337,6 +403,7 @@ def main() -> int:
         "pid": os.getpid(),
         "port": service.port,
         "obs_port": obs.port,
+        "lanes": list(service.lanes),
         "warmup": warmup_report,
     }), flush=True)
 

@@ -33,9 +33,10 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.serving import transport as transport_mod
 from sparkdl_tpu.serving import wire
 from sparkdl_tpu.serving.errors import (
     NoLiveReplicas,
@@ -45,47 +46,28 @@ from sparkdl_tpu.utils.metrics import metrics
 
 
 class _Backend:
-    """One registered replica: address + a small pool of idle persistent
-    connections + the in-flight count the balancer reads."""
+    """One registered replica: a :class:`~sparkdl_tpu.serving.transport.
+    Transport` picked from the lanes it advertised at handshake, plus
+    the in-flight count the balancer reads."""
 
     def __init__(self, name: str, host: str, port: int,
-                 max_idle: int = 8):
+                 lanes: Tuple[str, ...] = ("tcp",),
+                 connect_timeout_s: float = 2.0,
+                 io_timeout_s: float = 30.0):
         self.name = name
         self.host = host
         self.port = int(port)
-        self.max_idle = int(max_idle)
-        self.lock = threading.Lock()
-        self.idle: List[socket.socket] = []
         self.inflight = 0
         self.removed = False
-
-    def checkout(self, timeout_s: float) -> socket.socket:
-        with self.lock:
-            sock = self.idle.pop() if self.idle else None
-        if sock is not None:
-            return sock
-        return wire.connect(self.host, self.port, timeout_s)
-
-    def checkin(self, sock: socket.socket) -> None:
-        with self.lock:
-            if not self.removed and len(self.idle) < self.max_idle:
-                self.idle.append(sock)
-                return
-        _close_quietly(sock)
+        self.transport = transport_mod.make_transport(
+            host, int(port), lanes=lanes,
+            connect_timeout_s=connect_timeout_s,
+            io_timeout_s=io_timeout_s,
+        )
 
     def close(self) -> None:
-        with self.lock:
-            self.removed = True
-            doomed, self.idle = self.idle, []
-        for sock in doomed:
-            _close_quietly(sock)
-
-
-def _close_quietly(sock: socket.socket) -> None:
-    try:
-        sock.close()
-    except OSError:
-        pass
+        self.removed = True
+        self.transport.close()
 
 
 class Router:
@@ -118,10 +100,19 @@ class Router:
     # ------------------------------------------------------------------
     # membership (the supervisor's side of the interface)
     # ------------------------------------------------------------------
-    def add(self, name: str, host: str, port: int) -> None:
+    def add(self, name: str, host: str, port: int,
+            lanes: Tuple[str, ...] = ("tcp",)) -> None:
+        """Register a replica.  ``lanes`` is what it advertised in its
+        ready line; the transport factory (and the
+        ``SPARKDL_WIRE_TRANSPORT`` override) picks the lane."""
+        backend = _Backend(
+            name, host, port, lanes=tuple(lanes),
+            connect_timeout_s=self._connect_timeout_s,
+            io_timeout_s=self._request_timeout_s,
+        )
         with self._lock:
             old = self._backends.pop(name, None)
-            self._backends[name] = _Backend(name, host, port)
+            self._backends[name] = backend
             self._m_replicas.set(len(self._backends))
         if old is not None:
             old.close()
@@ -138,6 +129,12 @@ class Router:
     def names(self) -> Tuple[str, ...]:
         with self._lock:
             return tuple(self._backends)
+
+    def lanes(self) -> Dict[str, str]:
+        """Backend name -> lane currently carrying its requests."""
+        with self._lock:
+            return {b.name: b.transport.lane
+                    for b in self._backends.values()}
 
     def set_max_inflight(self, n: Optional[int]) -> None:
         """The admission limit — the autoscaler's second knob."""
@@ -196,6 +193,21 @@ class Router:
         """Place one request; returns the model output row or raises a
         typed error.  Retries connection failures and transient replies
         on other live replicas until the replica set is exhausted."""
+        return self.route_reply(
+            value, model_id=model_id, deadline_ms=deadline_ms,
+            timeout_s=timeout_s,
+        )["result"]
+
+    def route_reply(
+        self,
+        value: Any,
+        model_id: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+        timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """:meth:`route`, but returning the full reply envelope (the
+        front door forwards ``server_ms`` so the bench can separate
+        router-added overhead from replica forward time)."""
         self._admit()
         start = time.monotonic()
         budget = (
@@ -218,7 +230,7 @@ class Router:
                         f"(tried {sorted(tried) or 'none'})"
                     )
                 try:
-                    result = self._send_one(
+                    reply = self._send_one(
                         backend, value, model_id, deadline_ms,
                         max(0.05, deadline - time.monotonic()),
                     )
@@ -245,34 +257,24 @@ class Router:
                 self._m_latency.observe(
                     (time.monotonic() - start) * 1000.0
                 )
-                return result
+                return reply
         finally:
             self._release()
 
-    def _send_one(self, backend, value, model_id, deadline_ms,
-                  timeout_s: float):
-        sock = backend.checkout(self._connect_timeout_s)
-        try:
-            sock.settimeout(timeout_s)
-            wire.send_msg(sock, {
-                "op": "infer",
-                "model_id": model_id,
-                "value": value,
-                "deadline_ms": deadline_ms,
-            })
-            reply = wire.recv_msg(sock)
-        except BaseException:
-            _close_quietly(sock)
-            raise
-        if reply is None:
-            _close_quietly(sock)
+    def _send_one(self, backend: _Backend, value, model_id, deadline_ms,
+                  timeout_s: float) -> Dict[str, Any]:
+        reply = backend.transport.request({
+            "op": "infer",
+            "model_id": model_id,
+            "value": value,
+            "deadline_ms": deadline_ms,
+        }, timeout_s)
+        if not isinstance(reply, dict):
             raise ConnectionError(
-                f"replica {backend.name!r} closed the connection "
-                "mid-request"
+                f"malformed reply from replica {backend.name!r}"
             )
-        backend.checkin(sock)
         if reply.get("ok"):
-            return reply["result"]
+            return reply
         raise wire.decode_error(reply)
 
     # ------------------------------------------------------------------
@@ -302,11 +304,16 @@ class Router:
                         }
                     else:
                         try:
-                            reply = {"ok": True, "result": outer.route(
+                            inner = outer.route_reply(
                                 msg["value"],
                                 model_id=msg.get("model_id"),
                                 deadline_ms=msg.get("deadline_ms"),
-                            )}
+                            )
+                            reply = {
+                                "ok": True,
+                                "result": inner["result"],
+                                "server_ms": inner.get("server_ms"),
+                            }
                         except Exception as exc:
                             reply = wire.encode_error(exc)
                     try:

@@ -48,7 +48,7 @@ import sys
 import threading
 import time
 import urllib.request
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.resilience.policy import CircuitBreaker, RetryPolicy
@@ -82,6 +82,7 @@ class ReplicaHandle:
         self.restart_at: Optional[float] = None
         self.port: Optional[int] = None
         self.obs_port: Optional[int] = None
+        self.lanes: Tuple[str, ...] = ("tcp",)
         self.warmup: Dict[str, Any] = {}
         self.health_bad = 0
         self.fault_armed = False
@@ -96,6 +97,7 @@ class ReplicaHandle:
             "pid": self.proc.pid if self.proc is not None else None,
             "port": self.port,
             "obs_port": self.obs_port,
+            "lanes": list(self.lanes),
             "generation": self.generation,
             "attempt": self.attempt,
             "last_exit": self.last_exit,
@@ -274,6 +276,7 @@ class ReplicaSupervisor:
         with self._lock:
             handle.port = int(ready["port"])
             handle.obs_port = int(ready["obs_port"])
+            handle.lanes = tuple(ready.get("lanes", ("tcp",)))
             handle.warmup = ready.get("warmup", {})
             handle.generation += 1
             handle.attempt = 0
@@ -284,7 +287,10 @@ class ReplicaSupervisor:
             )
             self._m_replicas.set(live)
         self._breakers[handle.slot].record_success()
-        self.router.add(handle.name, handle.spec.host, handle.port)
+        self.router.add(
+            handle.name, handle.spec.host, handle.port,
+            lanes=handle.lanes,
+        )
         self._m_spawn_time.add_seconds(time.monotonic() - started)
         logger.info(
             "%s live: pid=%d port=%d gen=%d (%.1fs)",
@@ -575,6 +581,7 @@ class ReplicaSupervisor:
             },
             "router": {
                 "replicas": list(self.router.names()),
+                "lanes": self.router.lanes(),
                 "max_inflight": self.router.max_inflight,
             },
         }

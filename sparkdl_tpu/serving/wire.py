@@ -1,14 +1,38 @@
-"""Framed request/response wire protocol between router and replicas.
+"""Typed zero-copy framing between router and replicas.
 
 The replica plane is process-per-replica (a SIGKILL must take out ONE
 replica, not the server), so requests cross a process boundary.  This
-module is the one definition of that boundary: length-prefixed pickle
-frames over a loopback TCP socket — no new dependencies, ndarray
-payloads round-trip at memcpy speed, and a half-written frame from a
-killed replica surfaces as a clean ``ConnectionError`` the router can
-retry, never a torn object.
+module is the one definition of that boundary.  The PR-10 wire was
+length-prefixed pickle — every ndarray paid pickle serialize + kernel
+copy + unpickle on both sides.  Frames are now *typed*: tensors travel
+as raw buffer bytes described by a compact (dtype, shape, contiguity)
+descriptor and come back via ``np.frombuffer`` over the receive buffer
+— zero-copy on encode (``sendmsg`` scatter-gathers the array's own
+memory) and one ``recv_into`` fill on decode.  Pickle is retained only
+for the small non-tensor control envelope.
 
-Security note: frames are **pickle** and the sockets bind loopback by
+Frame layout (big-endian)::
+
+    +-------+------+-------+----------+----------+=======+=========+
+    | magic | kind | flags | meta_len | body_len | meta  | body    |
+    | 4s    | u8   | u8    | u32      | u64      | ...   | ...     |
+    +-------+------+-------+----------+----------+=======+=========+
+
+``meta`` is a pickle of ``(envelope, descs)`` where every ndarray in
+the envelope has been replaced by a ``("\\x00sdw-tensor\\x00", i)``
+marker tuple and ``descs[i] = (dtype_str, shape, offset, nbytes,
+c_contiguous)`` locates its bytes inside ``body``.  Marker tuples (not
+classes) keep the meta pickle importable by the bench generators,
+which load this file standalone by path.  ``kind`` is ``KIND_MSG`` for
+one envelope or ``KIND_BATCH`` for a list of envelopes sharing one
+body (the TCP lane's request coalescer).
+
+A half-written frame from a killed replica surfaces as a clean
+``ConnectionError`` the router can retry — bad magic, truncated
+header, truncated body, or a descriptor that disagrees with the
+payload length all refuse loudly, never a torn or garbage array.
+
+Security note: meta is **pickle** and the sockets bind loopback by
 default — this is an intra-host data plane between processes the
 supervisor itself spawned, not an internet-facing protocol.  Anything
 that can reach the port can already signal the processes.
@@ -26,51 +50,306 @@ from __future__ import annotations
 import pickle
 import socket
 import struct
-from typing import Any, Dict, Optional
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-_LEN = struct.Struct(">I")
+import numpy as np
 
-#: refuse frames beyond this (a torn length prefix must not allocate GBs)
+MAGIC = b"SDW2"
+KIND_MSG = 1
+KIND_BATCH = 2
+
+_PREFIX = struct.Struct(">4sBBIQ")  # magic, kind, flags, meta_len, body_len
+
+#: refuse frames beyond this (a torn prefix must not allocate GBs)
 MAX_FRAME_BYTES = 256 * 1024 * 1024
+#: the control envelope is small by design; a huge meta is a torn stream
+MAX_META_BYTES = 64 * 1024 * 1024
+
+#: ndarrays in the envelope are swapped for (_TENSOR_MARK, index) tuples
+_TENSOR_MARK = "\x00sdw-tensor\x00"
+
+
+def _timer(name: str):
+    """``wire.*`` timer when the package's metrics registry is already
+    loaded, else None.  This module must stay importable standalone
+    (the bench generators load it by file path to dodge the package's
+    jax import), so it must never *trigger* the package import."""
+    mod = sys.modules.get("sparkdl_tpu.utils.metrics")
+    if mod is None:
+        return None
+    metrics = mod.metrics
+    # every call site passes a "wire." literal; the indirection exists
+    # only for the sys.modules guard above
+    return metrics.timer(name)  # sparkdl: disable=metric-name
+
+
+def _count(name: str, n: float) -> None:
+    mod = sys.modules.get("sparkdl_tpu.utils.metrics")
+    if mod is None:
+        return
+    metrics = mod.metrics
+    metrics.counter(name).add(n)  # sparkdl: disable=metric-name
+
+
+# ---------------------------------------------------------------------------
+# encode
+
+
+def encode_parts(obj: Any, kind: int = KIND_MSG) -> List[Any]:
+    """Encode ``obj`` into frame parts ``[prefix+meta, buf, buf, ...]``
+    where the trailing parts are zero-copy memoryviews over the
+    envelope's own ndarray memory (scatter-gather them with
+    :func:`sendall_parts`, or concatenate for a shm ring record)."""
+    t0 = time.perf_counter()
+    descs: List[Tuple[str, tuple, int, int, bool]] = []
+    buffers: List[memoryview] = []
+    offset = 0
+
+    def walk(x: Any) -> Any:
+        nonlocal offset
+        if isinstance(x, np.ndarray) and not x.dtype.hasobject:
+            was_c = bool(x.flags.c_contiguous)
+            arr = x if was_c else np.ascontiguousarray(x)
+            try:
+                raw = memoryview(arr.reshape(-1)).cast("B")  # reshape: view
+            except (BufferError, TypeError, ValueError):
+                return x  # exotic dtype — ride the pickle envelope
+            descs.append((arr.dtype.str, arr.shape, offset, arr.nbytes, was_c))
+            buffers.append(raw)
+            offset += arr.nbytes
+            return (_TENSOR_MARK, len(descs) - 1)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        if isinstance(x, list):
+            return [walk(v) for v in x]
+        if isinstance(x, tuple):
+            return tuple(walk(v) for v in x)
+        return x
+
+    envelope = walk(obj)
+    meta = pickle.dumps((envelope, descs), protocol=pickle.HIGHEST_PROTOCOL)
+    head = _PREFIX.pack(MAGIC, kind, 0, len(meta), offset)
+    timer = _timer("wire.serialize_seconds")
+    if timer is not None:
+        timer.add_seconds(time.perf_counter() - t0)
+        _count("wire.frames_out", 1)
+        _count("wire.bytes_out", len(head) + len(meta) + offset)
+    return [head + meta, *buffers]
+
+
+def parts_len(parts: Sequence[Any]) -> int:
+    return sum(len(p) for p in parts)
+
+
+def sendall_parts(sock: socket.socket, parts: Sequence[Any]) -> None:
+    """Vectored send of frame parts — one ``sendmsg`` syscall for the
+    common case, advancing memoryviews across partial sends (and
+    falling back past IOV_MAX) so no flattening copy is ever made."""
+    views = [memoryview(p).cast("B") if not isinstance(p, memoryview) else p
+             for p in parts if len(p)]
+    while views:
+        sent = sock.sendmsg(views[:64])
+        while sent:
+            if sent >= len(views[0]):
+                sent -= len(views[0])
+                views.pop(0)
+            else:
+                views[0] = views[0][sent:]
+                sent = 0
+
+
+# ---------------------------------------------------------------------------
+# decode
+
+
+def _fill(sock: socket.socket, view: memoryview,
+          eof_ok_at_start: bool = False) -> bool:
+    """``recv_into`` until ``view`` is full.  Returns False on a clean
+    EOF before the first byte (only when allowed); EOF mid-fill raises
+    ``ConnectionError`` — the router's retry trigger."""
+    got = 0
+    n = len(view)
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
+            if got == 0 and eof_ok_at_start:
+                return False
+            raise ConnectionError("connection closed mid-frame")
+        got += r
+    return True
+
+
+def _parse_prefix(head: bytes) -> Tuple[int, int, int]:
+    magic, kind, _flags, meta_len, body_len = _PREFIX.unpack(head)
+    if magic != MAGIC:
+        raise ConnectionError(
+            f"bad frame magic {magic!r} — torn or foreign stream"
+        )
+    if kind not in (KIND_MSG, KIND_BATCH):
+        raise ConnectionError(f"unknown frame kind {kind}")
+    if meta_len > MAX_META_BYTES or meta_len + body_len > MAX_FRAME_BYTES:
+        raise ConnectionError(
+            f"frame of {meta_len + body_len} bytes exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}) — torn or hostile stream"
+        )
+    return kind, meta_len, body_len
+
+
+def _decode(meta: bytes, body: memoryview) -> Any:
+    """Rebuild the envelope: unpickle meta, then point each tensor
+    marker at a ``np.frombuffer`` view of ``body``.  Every descriptor
+    is validated against the payload before any array is built."""
+    t0 = time.perf_counter()
+    try:
+        envelope, descs = pickle.loads(meta)
+    except Exception as exc:
+        raise ConnectionError(f"undecodable frame meta: {exc}") from exc
+    if not isinstance(descs, list):
+        raise ConnectionError("malformed frame meta: descriptor table")
+
+    arrays: List[np.ndarray] = []
+    for desc in descs:
+        try:
+            dtype_str, shape, off, nbytes, was_c = desc
+            dt = np.dtype(dtype_str)
+            shape = tuple(int(d) for d in shape)
+            off = int(off)
+            nbytes = int(nbytes)
+        except Exception as exc:
+            raise ConnectionError(
+                f"malformed tensor descriptor {desc!r}"
+            ) from exc
+        count = 1
+        for d in shape:
+            if d < 0:
+                raise ConnectionError(f"negative dim in shape {shape}")
+            count *= d
+        if dt.itemsize * count != nbytes:
+            raise ConnectionError(
+                f"tensor descriptor mismatch: dtype {dt.str} shape {shape} "
+                f"wants {dt.itemsize * count} bytes, descriptor says {nbytes}"
+            )
+        if off < 0 or off + nbytes > len(body):
+            raise ConnectionError(
+                f"tensor descriptor overruns body: offset {off} + {nbytes} "
+                f"> {len(body)}"
+            )
+        arr = np.frombuffer(body[off:off + nbytes], dtype=dt)
+        arrays.append(arr.reshape(shape))
+
+    def restore(x: Any) -> Any:
+        if (isinstance(x, tuple) and len(x) == 2 and x[0] == _TENSOR_MARK):
+            idx = x[1]
+            if not isinstance(idx, int) or not 0 <= idx < len(arrays):
+                raise ConnectionError(f"tensor marker out of range: {x!r}")
+            return arrays[idx]
+        if isinstance(x, dict):
+            return {k: restore(v) for k, v in x.items()}
+        if isinstance(x, list):
+            return [restore(v) for v in x]
+        if isinstance(x, tuple):
+            return tuple(restore(v) for v in x)
+        return x
+
+    out = restore(envelope)
+    timer = _timer("wire.deserialize_seconds")
+    if timer is not None:
+        timer.add_seconds(time.perf_counter() - t0)
+    return out
+
+
+def recv_any(sock: socket.socket,
+             first: bytes = b"") -> Optional[Tuple[int, Any]]:
+    """One frame as ``(kind, obj)``, or None on clean EOF between
+    frames.  The body lands in a single preallocated buffer via
+    ``recv_into`` — no per-chunk copies — and reconstructed arrays are
+    writable views over it.  ``first`` holds prefix bytes the caller
+    already consumed (the shm side-channel reads one byte to tell a
+    doorbell from a spilled frame); EOF after a partial prefix is a
+    torn frame, not a clean close."""
+    head = bytearray(_PREFIX.size)
+    if first:
+        head[:len(first)] = first
+        _fill(sock, memoryview(head)[len(first):])
+    elif not _fill(sock, memoryview(head), eof_ok_at_start=True):
+        return None
+    kind, meta_len, body_len = _parse_prefix(bytes(head))
+    t0 = time.perf_counter()
+    meta = bytearray(meta_len)
+    body = bytearray(body_len)
+    _fill(sock, memoryview(meta))
+    _fill(sock, memoryview(body))
+    timer = _timer("wire.copy_seconds")
+    if timer is not None:
+        timer.add_seconds(time.perf_counter() - t0)
+        _count("wire.frames_in", 1)
+        _count("wire.bytes_in", _PREFIX.size + meta_len + body_len)
+    return kind, _decode(bytes(meta), memoryview(body))
+
+
+def decode_frame(frame: bytearray) -> Tuple[int, Any]:
+    """Decode one complete frame held in memory (the shm ring hands
+    records over whole).  Torn or inconsistent frames raise
+    ``ConnectionError`` exactly like the socket path."""
+    if len(frame) < _PREFIX.size:
+        raise ConnectionError(
+            f"truncated frame: {len(frame)} bytes < prefix"
+        )
+    kind, meta_len, body_len = _parse_prefix(bytes(frame[:_PREFIX.size]))
+    if len(frame) != _PREFIX.size + meta_len + body_len:
+        raise ConnectionError(
+            f"frame length mismatch: have {len(frame)}, prefix declares "
+            f"{_PREFIX.size + meta_len + body_len}"
+        )
+    view = memoryview(frame)
+    meta = bytes(view[_PREFIX.size:_PREFIX.size + meta_len])
+    body = view[_PREFIX.size + meta_len:]
+    return kind, _decode(meta, body)
+
+
+# ---------------------------------------------------------------------------
+# message-level API (the generators and the router front door use this)
 
 
 def send_msg(sock: socket.socket, obj: Any) -> None:
-    """Serialize ``obj`` as one length-prefixed frame."""
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    """Serialize ``obj`` as one typed frame."""
+    sendall_parts(sock, encode_parts(obj, KIND_MSG))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf.extend(chunk)
-    return bytes(buf)
+def send_batch(sock: socket.socket, msgs: Sequence[Any]) -> None:
+    """N envelopes in one KIND_BATCH frame sharing a single body — the
+    TCP lane's coalescer amortizes prefix + syscall across them."""
+    sendall_parts(sock, encode_parts(list(msgs), KIND_BATCH))
 
 
 def recv_msg(sock: socket.socket) -> Optional[Any]:
-    """One frame, or None on clean EOF.  A connection that dies mid-frame
-    raises ``ConnectionError`` (the router's retry trigger)."""
-    head = _recv_exact(sock, _LEN.size)
-    if head is None:
+    """One message frame, or None on clean EOF.  A connection that dies
+    mid-frame raises ``ConnectionError`` (the router's retry trigger)."""
+    got = recv_any(sock)
+    if got is None:
         return None
-    (length,) = _LEN.unpack(head)
-    if length > MAX_FRAME_BYTES:
-        raise ConnectionError(
-            f"frame of {length} bytes exceeds MAX_FRAME_BYTES "
-            f"({MAX_FRAME_BYTES}) — torn or hostile stream"
-        )
-    payload = _recv_exact(sock, length)
-    if payload is None:
-        raise ConnectionError("connection closed mid-frame")
-    return pickle.loads(payload)
+    kind, obj = got
+    if kind != KIND_MSG:
+        raise ConnectionError("unexpected batch frame on message channel")
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# typed errors
+
+_REGISTRY: Optional[Dict[str, type]] = None
 
 
 def _error_registry() -> Dict[str, type]:
     """Class-name -> class for the typed errors sanctioned to cross the
-    wire (lazy: errors modules import this one's siblings)."""
+    wire, built once and cached at module level (lazy: errors modules
+    import this one's siblings, and decode_error is an error path that
+    must not pay two imports + a dict scan per call)."""
+    global _REGISTRY
+    if _REGISTRY is not None:
+        return _REGISTRY
     from sparkdl_tpu.resilience.errors import (
         CircuitOpen,
         DeadlineExceeded,
@@ -88,6 +367,7 @@ def _error_registry() -> Dict[str, type]:
         obj = serving_errors.__dict__[name]
         if isinstance(obj, type) and issubclass(obj, Exception):
             registry[name] = obj
+    _REGISTRY = registry
     return registry
 
 

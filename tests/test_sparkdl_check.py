@@ -1645,3 +1645,73 @@ def test_metric_name_rule_sanctions_replica_plane_prefixes(tmp_path):
     )
     assert len(report.findings) == 1, [f.message for f in report.findings]
     assert "routers.requests" in report.findings[0].message
+
+
+def test_resource_lifecycle_flags_unreclaimed_shared_memory(tmp_path):
+    """A shm segment with no close()/unlink() anywhere on its spelling
+    is a /dev/shm leak — the mapping pins kernel memory past the owner
+    and the name survives until reboot."""
+    report = check_snippet(
+        tmp_path, "serving/x.py",
+        """
+        from multiprocessing import shared_memory
+
+        def bad_create(name):
+            seg = shared_memory.SharedMemory(create=True, name=name,
+                                             size=1 << 20)
+            return seg.buf
+        """,
+        rules=["resource-lifecycle"],
+    )
+    assert len(report.findings) == 1, [f.message for f in report.findings]
+    assert "SharedMemory with no close()/unlink() path" in \
+        report.findings[0].message
+
+
+def test_resource_lifecycle_shared_memory_reclaim_paths(tmp_path):
+    """Split shm lifecycles are honored: the creator that unlinks in
+    ``close()`` (through the one-hop ``seg = self._seg`` alias the rule
+    follows) and the attacher that only close()s are both clean."""
+    report = check_snippet(
+        tmp_path, "serving/x.py",
+        """
+        from multiprocessing import shared_memory
+
+        class Creator:
+            def open(self, name):
+                self._seg = shared_memory.SharedMemory(
+                    create=True, name=name, size=1 << 20)
+
+            def close(self):
+                seg = self._seg
+                self._seg = None
+                seg.close()
+                seg.unlink()
+
+        def attach_once(name):
+            seg = shared_memory.SharedMemory(name=name)
+            try:
+                return bytes(seg.buf[:4])
+            finally:
+                seg.close()
+        """,
+        rules=["resource-lifecycle"],
+    )
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+def test_metric_name_rule_sanctions_wire_prefix(tmp_path):
+    """``wire.`` (frame codec + transport lanes) is sanctioned; a
+    lookalike is not."""
+    report = check_snippet(
+        tmp_path, "serving/x.py",
+        """
+        from sparkdl_tpu.utils.metrics import metrics
+        metrics.timer("wire.serialize_seconds")
+        metrics.counter("wire.shm.fallback").add(1)
+        metrics.counter("wires.frames_out").add(1)
+        """,
+        rules=["metric-name"],
+    )
+    assert len(report.findings) == 1, [f.message for f in report.findings]
+    assert "wires.frames_out" in report.findings[0].message

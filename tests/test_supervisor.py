@@ -26,6 +26,7 @@ deliberately minimal.
 """
 
 import json
+import os
 import socket
 import struct
 import threading
@@ -108,8 +109,10 @@ class TestWire:
     def test_mid_frame_close_is_connection_error(self):
         a, b = socket.socketpair()
         try:
-            # a length prefix promising 100 bytes, then death
-            a.sendall(struct.pack(">I", 100) + b"only-a-few")
+            # a frame prefix promising 100 body bytes, then death
+            a.sendall(struct.pack(
+                ">4sBBIQ", wire.MAGIC, wire.KIND_MSG, 0, 10, 100
+            ) + b"only-a-few")
             a.close()
             with pytest.raises(ConnectionError):
                 wire.recv_msg(b)
@@ -119,7 +122,10 @@ class TestWire:
     def test_oversized_frame_refused(self):
         a, b = socket.socketpair()
         try:
-            a.sendall(struct.pack(">I", wire.MAX_FRAME_BYTES + 1))
+            a.sendall(struct.pack(
+                ">4sBBIQ", wire.MAGIC, wire.KIND_MSG, 0, 16,
+                wire.MAX_FRAME_BYTES + 1,
+            ))
             with pytest.raises(ConnectionError):
                 wire.recv_msg(b)
         finally:
@@ -311,7 +317,11 @@ class TestReplicaSpec:
 # supervisor.replica_serve under sustained traffic
 # ----------------------------------------------------------------------
 class TestKillMatrix:
-    def test_replica_kill_under_load_loses_nothing(self):
+    @pytest.mark.parametrize("lane", ["tcp", "shm"])
+    def test_replica_kill_under_load_loses_nothing(
+        self, lane, monkeypatch
+    ):
+        monkeypatch.setenv("SPARKDL_WIRE_TRANSPORT", lane)
         sup = fast_supervisor(
             replicas=2,
             fault_plans={0: [{
@@ -325,6 +335,10 @@ class TestKillMatrix:
         stop = threading.Event()
         with sup:
             assert sup.wait_live(2, 120), sup.status()
+            # the requested lane must actually be the one carrying
+            # traffic (replicas advertise shm unless disabled)
+            lanes = sup.status()["router"]["lanes"]
+            assert set(lanes.values()) == {lane}, lanes
             start = time.monotonic()
 
             def generate():
@@ -395,6 +409,37 @@ class TestKillMatrix:
             f"p99 did not recover: pre={pre_p99:.4f}s "
             f"post={post_p99:.4f}s"
         )
+
+        # shm lane hygiene: every segment this router created was
+        # unlinked — a SIGKILLed replica must not leak /dev/shm entries
+        from sparkdl_tpu.serving import transport as transport_mod
+
+        assert transport_mod.active_segments() == []
+        shm_dir = "/dev/shm"
+        if os.path.isdir(shm_dir):
+            mine = [f for f in os.listdir(shm_dir)
+                    if f.startswith(f"sdw_{os.getpid()}_")]
+            assert mine == [], f"leaked shm segments: {mine}"
+
+    def test_shm_disabled_replica_falls_back_to_tcp(self, monkeypatch):
+        """Transparent fallback, process-level: the operator asks for
+        shm but replicas refuse (SPARKDL_WIRE_SHM_DISABLE) — traffic
+        must flow over TCP with no caller-visible difference."""
+        monkeypatch.setenv("SPARKDL_WIRE_TRANSPORT", "shm")
+        monkeypatch.setenv("SPARKDL_WIRE_SHM_DISABLE", "1")
+        fallback_before = metrics.counter("wire.shm.fallback").value
+        sup = fast_supervisor(replicas=1)
+        with sup:
+            assert sup.wait_live(1, 120), sup.status()
+            lanes = sup.status()["router"]["lanes"]
+            assert set(lanes.values()) == {"tcp"}, lanes
+            out = sup.router.route(
+                np.ones(64, np.float32), model_id="ep0", timeout_s=15.0
+            )
+            assert np.asarray(out).shape == (64,)
+        assert metrics.counter(
+            "wire.shm.fallback"
+        ).value > fallback_before
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,432 @@
+"""Wire codec + transport seam tests (ISSUE-11).
+
+Two halves:
+
+- **Torn-frame fuzz** — every way a frame can arrive damaged
+  (truncated prefix, truncated meta/body, descriptor/payload
+  disagreement, hostile sizes, bad magic) must surface as a typed
+  ``ConnectionError``/``RemoteReplicaError``, never a garbage array.
+  The codec is the trust boundary between a healthy router and a
+  replica that died mid-write.
+- **Transport seam** — the TCP lane (pooled + coalesced) and the
+  shared-memory lane against a real ``serve_connection`` loop:
+  roundtrips, lane negotiation/refusal fallback, big-frame spill onto
+  the TCP side-channel, peer-death detection, and ``/dev/shm`` leak
+  hygiene.  The ``wire.shm`` fault site registered in
+  ``resilience.inject`` is exercised here (fault-site-coverage rule).
+"""
+
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.serving import transport, wire
+from sparkdl_tpu.serving.errors import RemoteReplicaError
+from sparkdl_tpu.utils.metrics import metrics
+
+PREFIX = struct.Struct(">4sBBIQ")
+
+
+def frame_bytes(obj, kind=wire.KIND_MSG) -> bytearray:
+    return bytearray(
+        b"".join(bytes(p) for p in wire.encode_parts(obj, kind))
+    )
+
+
+# ----------------------------------------------------------------------
+# codec roundtrips
+# ----------------------------------------------------------------------
+class TestCodec:
+    @pytest.mark.parametrize("dtype", [
+        np.float32, np.float64, np.int32, np.int64, np.uint8,
+        np.bool_, np.float16,
+    ])
+    def test_dtype_roundtrip(self, dtype):
+        a, b = socket.socketpair()
+        try:
+            x = np.arange(24).astype(dtype).reshape(2, 3, 4)
+            wire.send_msg(a, {"value": x})
+            got = wire.recv_msg(b)
+            np.testing.assert_array_equal(got["value"], x)
+            assert got["value"].dtype == x.dtype
+        finally:
+            a.close()
+            b.close()
+
+    def test_nested_containers_and_scalars(self):
+        x = np.linspace(0, 1, 8, dtype=np.float32)
+        msg = {
+            "op": "infer", "model_id": "ep0", "deadline_ms": 12.5,
+            "value": x,
+            "nest": [x * 2, {"k": (x, 7, "s")}, None, True],
+        }
+        kind, got = wire.decode_frame(frame_bytes(msg))
+        assert kind == wire.KIND_MSG
+        np.testing.assert_array_equal(got["value"], x)
+        np.testing.assert_array_equal(got["nest"][0], x * 2)
+        np.testing.assert_array_equal(got["nest"][1]["k"][0], x)
+        assert got["nest"][1]["k"][1:] == (7, "s")
+        assert got["nest"][2] is None and got["nest"][3] is True
+        assert got["deadline_ms"] == 12.5
+
+    def test_noncontiguous_zero_d_and_empty(self):
+        base = np.arange(24, dtype=np.float32).reshape(4, 6)
+        for arr in (base[:, ::2], np.array(3.5), np.empty((0, 4))):
+            _, got = wire.decode_frame(frame_bytes({"a": arr}))
+            np.testing.assert_array_equal(got["a"], arr)
+            assert got["a"].shape == arr.shape
+
+    def test_received_arrays_are_writable(self):
+        # np.frombuffer over the receive *bytearray*: views must be
+        # writable or every consumer pays a defensive copy
+        _, got = wire.decode_frame(
+            frame_bytes({"a": np.ones(4, np.float32)})
+        )
+        got["a"][0] = 7.0
+        assert got["a"][0] == 7.0
+
+    def test_object_dtype_rides_the_pickle_envelope(self):
+        # raw bytes of an object array are pointers — must NOT be
+        # zero-copy framed
+        arr = np.array([{"k": 1}, [2]], dtype=object)
+        _, got = wire.decode_frame(frame_bytes({"a": arr}))
+        assert got["a"][0] == {"k": 1} and got["a"][1] == [2]
+
+    def test_batch_frame_shares_one_body(self):
+        msgs = [{"i": i, "v": np.full(4, i, np.float32)}
+                for i in range(5)]
+        kind, got = wire.decode_frame(
+            frame_bytes(msgs, kind=wire.KIND_BATCH), )
+        assert kind == wire.KIND_BATCH
+        assert [m["i"] for m in got] == list(range(5))
+        np.testing.assert_array_equal(got[3]["v"], np.full(4, 3.0))
+
+    def test_batch_frame_on_message_channel_is_refused(self):
+        a, b = socket.socketpair()
+        try:
+            wire.send_batch(a, [{"i": 0}])
+            with pytest.raises(ConnectionError):
+                wire.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+# ----------------------------------------------------------------------
+# torn-frame fuzz: damaged input must never become a garbage array
+# ----------------------------------------------------------------------
+class TestTornFrames:
+    def recv_raises(self, raw: bytes):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(raw)
+            a.close()
+            with pytest.raises(ConnectionError):
+                wire.recv_msg(b)
+        finally:
+            b.close()
+
+    def test_truncated_prefix(self):
+        whole = bytes(frame_bytes({"v": np.ones(4, np.float32)}))
+        for cut in (1, 5, PREFIX.size - 1):
+            self.recv_raises(whole[:cut])
+
+    def test_truncated_meta_and_body(self):
+        whole = bytes(frame_bytes({"v": np.ones(64, np.float32)}))
+        for cut in (PREFIX.size + 3, len(whole) - 1, len(whole) - 100):
+            self.recv_raises(whole[:cut])
+
+    def test_bad_magic(self):
+        whole = bytearray(frame_bytes({"v": np.ones(4, np.float32)}))
+        whole[:4] = b"XXXX"
+        self.recv_raises(bytes(whole))
+
+    def test_unknown_kind(self):
+        whole = bytearray(frame_bytes({"v": 1}))
+        whole[4] = 99
+        self.recv_raises(bytes(whole))
+
+    def test_oversized_frame_refused_before_allocation(self):
+        self.recv_raises(PREFIX.pack(
+            wire.MAGIC, wire.KIND_MSG, 0, 16, wire.MAX_FRAME_BYTES + 1
+        ))
+
+    def test_oversized_meta_refused(self):
+        self.recv_raises(PREFIX.pack(
+            wire.MAGIC, wire.KIND_MSG, 0, wire.MAX_META_BYTES + 1, 0
+        ))
+
+    def _forged(self, desc, body: bytes) -> bytearray:
+        meta = pickle.dumps(((wire._TENSOR_MARK, 0), [desc]))
+        return bytearray(
+            PREFIX.pack(wire.MAGIC, wire.KIND_MSG, 0, len(meta),
+                        len(body)) + meta + body
+        )
+
+    def test_dtype_shape_payload_length_mismatch(self):
+        body = np.ones(8, np.float32).tobytes()
+        # descriptor claims 8 float64s (64 bytes) over a 32-byte body
+        forged = self._forged(("<f8", (8,), 0, 32, True), body)
+        with pytest.raises(ConnectionError):
+            wire.decode_frame(forged)
+
+    def test_descriptor_overruns_body(self):
+        body = np.ones(8, np.float32).tobytes()
+        forged = self._forged(("<f4", (16,), 0, 64, True), body)
+        with pytest.raises(ConnectionError):
+            wire.decode_frame(forged)
+        forged = self._forged(("<f4", (8,), 16, 32, True), body)
+        with pytest.raises(ConnectionError):
+            wire.decode_frame(forged)
+
+    def test_invalid_dtype_string(self):
+        forged = self._forged(("not-a-dtype", (8,), 0, 32, True),
+                              bytes(32))
+        with pytest.raises(ConnectionError):
+            wire.decode_frame(forged)
+
+    def test_tensor_marker_out_of_range(self):
+        meta = pickle.dumps(((wire._TENSOR_MARK, 3), []))
+        raw = bytearray(
+            PREFIX.pack(wire.MAGIC, wire.KIND_MSG, 0, len(meta), 0)
+            + meta
+        )
+        with pytest.raises(ConnectionError):
+            wire.decode_frame(raw)
+
+    def test_garbage_meta_pickle(self):
+        raw = bytearray(
+            PREFIX.pack(wire.MAGIC, wire.KIND_MSG, 0, 8, 0)
+            + b"\x00garbage"
+        )
+        with pytest.raises(ConnectionError):
+            wire.decode_frame(raw)
+
+    def test_unknown_remote_error_is_typed(self):
+        exc = wire.decode_error(
+            {"ok": False, "error_class": "Weird", "error": "boom"}
+        )
+        assert isinstance(exc, RemoteReplicaError)
+
+    def test_error_registry_is_cached(self):
+        assert wire._error_registry() is wire._error_registry()
+
+
+# ----------------------------------------------------------------------
+# transport seam against a live serve_connection loop
+# ----------------------------------------------------------------------
+class _EchoServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def start_echo(allow_shm=True):
+    """A serve_connection loop that doubles ``value`` — the transport
+    mechanics without a ModelServer underneath."""
+
+    def handle_one(msg):
+        if msg.get("op") == "boom":
+            raise ValueError("planned failure")
+        return {"ok": True, "result": msg["value"] * 2, "server_ms": 0.1}
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            srv.conns.append(self.request)
+            transport.serve_connection(
+                self.request, handle_one, allow_shm=allow_shm
+            )
+
+    srv = _EchoServer(("127.0.0.1", 0), Handler)
+    srv.conns = []
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, srv.server_address[1]
+
+
+def my_shm_entries():
+    shm_dir = "/dev/shm"
+    if not os.path.isdir(shm_dir):
+        return []
+    return [f for f in os.listdir(shm_dir)
+            if f.startswith(f"sdw_{os.getpid()}_")]
+
+
+class TestTransports:
+    def test_pooled_tcp_roundtrip(self):
+        srv, port = start_echo()
+        try:
+            t = transport.TcpTransport("127.0.0.1", port, coalesce=False)
+            x = np.arange(8, dtype=np.float32)
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+            assert t.lane == "tcp"
+            t.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_coalescer_batches_concurrent_requests(self):
+        srv, port = start_echo()
+        try:
+            t = transport.TcpTransport("127.0.0.1", port, coalesce=True)
+            before = metrics.counter("wire.coalesced_msgs").value
+            x = np.ones(8, np.float32)
+            errs = []
+
+            def hit(i):
+                try:
+                    reply = t.request({"op": "infer", "value": x + i}, 10.0)
+                    np.testing.assert_array_equal(
+                        reply["result"], (x + i) * 2
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    errs.append(exc)
+
+            threads = [threading.Thread(target=hit, args=(i,))
+                       for i in range(32)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=30)
+            assert not errs, errs[:3]
+            # 32 concurrent requests over one socket MUST have shared
+            # frames (greedy group commit while an RTT is in flight)
+            assert metrics.counter("wire.coalesced_msgs").value > before
+            t.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_coalesced_error_reply_stays_per_message(self):
+        srv, port = start_echo()
+        try:
+            t = transport.TcpTransport("127.0.0.1", port, coalesce=True)
+            reply = t.request({"op": "boom", "value": 1}, 5.0)
+            assert reply["ok"] is False
+            assert reply["error_class"] == "ValueError"
+            t.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_shm_roundtrip_and_lane(self):
+        srv, port = start_echo()
+        try:
+            t = transport.ShmTransport("127.0.0.1", port)
+            x = np.arange(16, dtype=np.float32)
+            for i in range(8):
+                reply = t.request({"op": "infer", "value": x + i}, 5.0)
+                np.testing.assert_array_equal(reply["result"], (x + i) * 2)
+            assert t.lane == "shm"
+            assert transport.active_segments()
+            t.close()
+            assert transport.active_segments() == []
+            assert my_shm_entries() == []
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_shm_big_frame_spills_to_tcp_sidechannel(self):
+        srv, port = start_echo()
+        try:
+            t = transport.ShmTransport("127.0.0.1", port)
+            before = metrics.counter("wire.shm.spill").value
+            big = np.ones((700, 700), np.float32)  # ~1.9MB > 1MB ring
+            reply = t.request({"op": "infer", "value": big}, 15.0)
+            np.testing.assert_array_equal(reply["result"], big * 2)
+            assert metrics.counter("wire.shm.spill").value > before
+            t.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_shm_refusal_falls_back_to_tcp(self):
+        srv, port = start_echo(allow_shm=False)
+        try:
+            before = metrics.counter("wire.shm.fallback").value
+            t = transport.ShmTransport("127.0.0.1", port)
+            x = np.ones(4, np.float32)
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+            assert t.lane == "tcp"
+            assert metrics.counter("wire.shm.fallback").value > before
+            t.close()
+            assert my_shm_entries() == []
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_peer_death_is_connection_error(self):
+        # SIGKILL equivalent at channel level: the peer's socket dies
+        # and the client must turn that into a typed ConnectionError
+        # instead of spinning on a ring no one will ever answer
+        srv, port = start_echo()
+        t = transport.ShmTransport("127.0.0.1", port)
+        try:
+            x = np.ones(4, np.float32)
+            t.request({"op": "infer", "value": x}, 5.0)
+            for conn in list(srv.conns):
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            with pytest.raises((ConnectionError, OSError)):
+                t.request({"op": "infer", "value": x}, 2.0)
+        finally:
+            t.close()
+            srv.shutdown()
+            srv.server_close()
+        assert transport.active_segments() == []
+        assert my_shm_entries() == []
+
+    def test_make_transport_mode_matrix(self):
+        srv, port = start_echo()
+        try:
+            t = transport.make_transport(
+                "127.0.0.1", port, lanes=("tcp", "shm"), mode="tcp"
+            )
+            assert isinstance(t, transport.TcpTransport)
+            t.close()
+            t = transport.make_transport(
+                "127.0.0.1", port, lanes=("tcp",), mode="shm"
+            )
+            assert isinstance(t, transport.TcpTransport)  # fell back
+            t.close()
+            t = transport.make_transport(
+                "127.0.0.1", port, lanes=("tcp", "shm"), mode="auto"
+            )
+            assert isinstance(t, transport.ShmTransport)
+            t.close()
+            with pytest.raises(ValueError):
+                transport.make_transport(
+                    "127.0.0.1", port, mode="carrier-pigeon"
+                )
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_wire_shm_fault_site_fires(self):
+        assert "wire.shm" in inject.known_sites()
+        srv, port = start_echo()
+        try:
+            t = transport.ShmTransport("127.0.0.1", port)
+            plan = inject.FaultPlan().add(
+                "wire.shm", error="transient", at=1
+            )
+            with inject.active_plan(plan):
+                with pytest.raises(inject.InjectedTransientError):
+                    t.request(
+                        {"op": "infer", "value": np.ones(4, np.float32)},
+                        5.0,
+                    )
+            t.close()
+            assert my_shm_entries() == []
+        finally:
+            srv.shutdown()
+            srv.server_close()
