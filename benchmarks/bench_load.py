@@ -38,9 +38,24 @@ counters.  ``--transport tcp|shm`` pins the router->replica lane and
 ``--assert-lane`` turns the negotiated outcome into an exit code (CI
 proves shm engaged, and that disabling shm falls back to tcp).
 
+Since ISSUE-12 ``--scenario rollout`` runs a live-traffic blue/green
+shift: a v2 fleet deploys next to v1 and a
+:class:`~sparkdl_tpu.serving.rollout.RolloutController` walks it
+through the canary stages while generators keep sending.  By default
+v2 carries an injected latency regression
+(``--rollout-regress-ms``), so the run proves the guard: the canary's
+``rollout.v2.*`` SLOs page, the controller rolls back, and the report
+carries the detection latency (breach-exposing shift -> rollback) and
+the goodput timeline through the transition.  ``--rollout-regress-ms
+0`` proves the other half — a clean v2 reaches 100% and v1 drains with
+exit 0.  ``--tenants a,b`` makes workers send tenant labels
+(per-tenant admission + ``router.tenant.*`` series).
+
 ``--smoke`` is the CI mode (<60 s): 2 replicas, sustained load, one
 planned kill; exits non-zero unless zero accepted requests were lost
-and the dead replica came back.
+and the dead replica came back.  ``--smoke --scenario rollout`` is the
+rollout twin: breach -> auto-rollback -> zero accepted loss, v1 still
+serving.
 
     JAX_PLATFORMS=cpu python benchmarks/bench_load.py --smoke
     JAX_PLATFORMS=cpu python benchmarks/bench_load.py \
@@ -65,7 +80,8 @@ _WIRE_PATH = os.path.join(REPO, "sparkdl_tpu", "serving", "wire.py")
 
 #: shed replies — the router refusing work BEFORE accepting it; every
 #: other failure class is an accepted request that was lost
-_SHED_CLASSES = {"ServerOverloaded", "NoLiveReplicas"}
+#: (TenantThrottled is the per-tenant fair-share refusal — ISSUE-12)
+_SHED_CLASSES = {"ServerOverloaded", "NoLiveReplicas", "TenantThrottled"}
 
 
 def _load_wire():
@@ -97,6 +113,8 @@ def _worker(worker_id, host, port, args_dict, out_queue):
     import numpy as np
 
     rng = random.Random(args_dict["seed"] * 1000 + worker_id)
+    tenants = args_dict.get("tenants")
+    tenant = tenants[worker_id % len(tenants)] if tenants else None
     endpoints = [f"ep{i}" for i in range(args_dict["endpoints"])]
     weights = _zipf_weights(len(endpoints), args_dict["zipf_s"])
     dim = args_dict["dim"]
@@ -133,9 +151,12 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                 if sock is None:
                     sock = wire.connect(host, port, 5.0)
                     sock.settimeout(args_dict["request_timeout_s"])
-                wire.send_msg(sock, {
+                msg = {
                     "op": "infer", "model_id": endpoint, "value": value,
-                })
+                }
+                if tenant is not None:
+                    msg["tenant"] = tenant
+                wire.send_msg(sock, msg)
                 reply = wire.recv_msg(sock)
                 if reply is None:
                     raise ConnectionError("front door EOF")
@@ -282,6 +303,7 @@ def run(args):
         fault_plans=fault_plans,
     ).start()
     autoscaler = None
+    rollout = None
     report = {
         "benchmark": "bench_load",
         "scenario": args.scenario,
@@ -310,14 +332,29 @@ def run(args):
             h.slot: h.warmup for h in supervisor.handles()
         }
         front_port = supervisor.router.serve()
+        if args.autoscale or args.scenario == "rollout":
+            extra_slos = None
+            if args.scenario == "rollout":
+                # the canary pair: tight windows so a bad v2 pages
+                # within seconds of its first weighted traffic
+                from sparkdl_tpu.obs.slo import rollout_slos
+
+                extra_slos = rollout_slos(
+                    "v2",
+                    latency_threshold_ms=args.rollout_slo_ms,
+                    fast_window_s=3.0, slow_window_s=10.0,
+                )
+            supervisor.start_telemetry(
+                sample_interval_s=0.25 if args.scenario == "rollout"
+                else 0.5,
+                slo_interval_s=0.5 if args.scenario == "rollout" else 1.0,
+                latency_threshold_ms=args.slo_p99_ms,
+                fast_window_s=5.0, slow_window_s=30.0,
+                extra_slos=extra_slos,
+            )
         if args.autoscale:
             from sparkdl_tpu.serving.autoscale import Autoscaler
 
-            supervisor.start_telemetry(
-                sample_interval_s=0.5, slo_interval_s=1.0,
-                latency_threshold_ms=args.slo_p99_ms,
-                fast_window_s=5.0, slow_window_s=30.0,
-            )
             autoscaler = Autoscaler(
                 supervisor, supervisor.slo_engine,
                 min_replicas=args.replicas,
@@ -360,6 +397,9 @@ def run(args):
             "burst_p": args.burst_p,
             "burst_max": args.burst_max,
             "request_timeout_s": 15.0,
+            "tenants": (
+                args.tenants.split(",") if args.tenants else None
+            ),
         }
         procs = [
             ctx.Process(
@@ -373,6 +413,34 @@ def run(args):
         poller.start()
         for p in procs:
             p.start()
+        if args.scenario == "rollout":
+            # a blue/green shift under live traffic: v2 comes up next
+            # to v1 and takes 1% -> 50% -> 100% unless its canary SLOs
+            # page first.  A regression is injected by deploying the
+            # deliberately-slow demo factory (SPARKDL_DEMO_DELAY_MS is
+            # read at v2 build time; the already-running v1 fleet never
+            # sees it).
+            from sparkdl_tpu.serving.rollout import RolloutController
+
+            if args.rollout_regress_ms > 0:
+                os.environ["SPARKDL_DEMO_DELAY_MS"] = str(
+                    args.rollout_regress_ms
+                )
+                v2_factory = "sparkdl_tpu.serving.replica:demo_server_slow"
+            else:
+                v2_factory = factory
+            rollout = RolloutController(
+                supervisor, supervisor.slo_engine,
+                "v2", ReplicaSpec(factory=v2_factory),
+                replicas=args.replicas,
+                stages=tuple(
+                    float(s) for s in args.rollout_stages.split(",")
+                ),
+                bake_s=args.rollout_bake_s,
+                interval_s=0.25,
+                spawn_timeout_s=args.spawn_timeout_s,
+                autoscaler=autoscaler,
+            ).start()
         records = []
         for _ in procs:
             worker_id, rows = out_queue.get(
@@ -384,6 +452,16 @@ def run(args):
             if p.is_alive():
                 p.terminate()
                 p.join(timeout=10)
+        rollout_report = None
+        if rollout is not None:
+            # let an in-flight promotion/rollback finish draining
+            rollout.wait(timeout_s=60.0)
+            rollout.close()
+            rollout_report = rollout.report()
+            rollout_report["events"] = [
+                {**e, "t_rel": round(e["at"] - bench_start, 2)}
+                for e in rollout_report["events"]
+            ]
         stop_events.set()
         poller.join(timeout=5)
         wall_s = time.monotonic() - bench_start
@@ -479,17 +557,23 @@ def run(args):
             },
             "supervisor": {
                 "live": final["live"],
+                "versions": final.get("versions"),
+                "primary_version": final.get("primary_version"),
                 "breakers": {
                     s: b["state"] for s, b in final["breakers"].items()
                 },
             },
         })
+        if rollout_report is not None:
+            report["rollout"] = rollout_report
         if autoscaler is not None:
             report["autoscale"] = {
                 "target": autoscaler.target,
                 "decisions": autoscaler.decisions(),
             }
     finally:
+        if rollout is not None:
+            rollout.close()
         if autoscaler is not None:
             autoscaler.close()
         supervisor.close()
@@ -499,7 +583,8 @@ def run(args):
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--scenario", default="kill",
-                    choices=["steady", "ramp", "spike", "kill"])
+                    choices=["steady", "ramp", "spike", "kill",
+                             "rollout"])
     ap.add_argument("--replicas", type=int, default=2)
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--rate", type=float, default=100.0,
@@ -533,6 +618,20 @@ def main():
                     "to tcp happened)")
     ap.add_argument("--autoscale", action="store_true",
                     help="run the SLO autoscaler control loop")
+    ap.add_argument("--tenants", default=None, metavar="A,B",
+                    help="comma list; worker i sends tenant i%%len — "
+                    "exercises per-tenant admission + router labels")
+    ap.add_argument("--rollout-regress-ms", type=float, default=80.0,
+                    help="rollout scenario: v2's injected per-request "
+                    "latency regression (0 = clean v2, proves the "
+                    "promotion path)")
+    ap.add_argument("--rollout-stages", default="0.01,0.5,1.0",
+                    help="rollout scenario: comma canary weights")
+    ap.add_argument("--rollout-bake-s", type=float, default=10.0,
+                    help="rollout scenario: per-stage bake window")
+    ap.add_argument("--rollout-slo-ms", type=float, default=50.0,
+                    help="rollout scenario: canary p99 threshold "
+                    "(rollout.v2.latency SLO)")
     ap.add_argument("--slo-p99-ms", type=float, default=250.0)
     ap.add_argument("--spawn-timeout-s", type=float, default=120.0)
     ap.add_argument("--seed", type=int, default=0)
@@ -544,7 +643,17 @@ def main():
                     "on violation")
     args = ap.parse_args()
 
-    if args.smoke:
+    if args.smoke and args.scenario == "rollout":
+        # CI rollout smoke (<60 s): 1+1 replicas, injected v2
+        # regression, 5% first stage so the canary sees traffic fast
+        args.replicas = 1
+        args.duration = 30.0
+        args.rate = 80.0
+        args.workers = 2
+        args.compile = False
+        args.rollout_stages = "0.05,0.5,1.0"
+        args.rollout_bake_s = 4.0
+    elif args.smoke:
         args.scenario = "kill"
         args.replicas = 2
         args.duration = 12.0
@@ -572,7 +681,61 @@ def main():
         print(f"LANE OK: all backends on {args.assert_lane!r}",
               file=sys.stderr)
 
-    if args.smoke:
+    if args.smoke and args.scenario == "rollout":
+        problems = []
+        rr = report.get("rollout") or {}
+        versions = (report.get("supervisor") or {}).get("versions") or {}
+        if report["lost_accepted"] != 0:
+            problems.append(
+                f"lost {report['lost_accepted']} accepted requests "
+                f"({report['lost_detail']})"
+            )
+        if args.rollout_regress_ms > 0:
+            if rr.get("verdict") != "rolled_back":
+                problems.append(
+                    f"expected auto-rollback, got verdict "
+                    f"{rr.get('verdict')!r} in state {rr.get('state')!r}"
+                )
+            det = rr.get("detection_s")
+            if det is None or det > 20.0:
+                problems.append(
+                    f"breach detection took {det}s (want <= 20s)"
+                )
+            if versions.get("v2", 0) != 0:
+                problems.append(
+                    f"v2 not drained out: versions={versions}"
+                )
+        else:
+            if rr.get("verdict") != "promoted":
+                problems.append(
+                    f"expected promotion, got verdict "
+                    f"{rr.get('verdict')!r} in state {rr.get('state')!r}"
+                )
+            dirty = {
+                s: c for s, c in (rr.get("old_exits") or {}).items()
+                if c != 0
+            }
+            if dirty:
+                problems.append(f"v1 drains were dirty: {dirty}")
+        survivor = "v1" if args.rollout_regress_ms > 0 else "v2"
+        if versions.get(survivor, 0) < args.replicas:
+            problems.append(
+                f"{survivor} fleet not intact at end: {versions}"
+            )
+        if report["ok"] == 0:
+            problems.append("no successful requests at all")
+        if problems:
+            print("ROLLOUT SMOKE FAIL: " + "; ".join(problems),
+                  file=sys.stderr)
+            return 1
+        print(
+            "ROLLOUT SMOKE PASS: "
+            f"{report['ok']} ok / {report['sent']} sent, 0 lost, "
+            f"verdict={rr.get('verdict')}, "
+            f"detection={rr.get('detection_s')}s",
+            file=sys.stderr,
+        )
+    elif args.smoke:
         problems = []
         if report["lost_accepted"] != 0:
             problems.append(

@@ -67,6 +67,20 @@ if ! timeout -k 10 60 env SPARKDL_WIRE_SHM_DISABLE=1 \
   exit 1
 fi
 
+# blue/green rollout smoke (<60 s, ISSUE-12): a v2 fleet with an
+# injected latency regression deploys next to v1 under live traffic;
+# the canary's rollout.v2.* SLOs must page, the RolloutController must
+# auto-roll-back, and the harness asserts zero accepted-request loss
+# with the v1 fleet still serving at the end (plus bounded
+# breach-detection latency).  --smoke exits non-zero on any violation.
+if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
+    --scenario rollout; then
+  echo "rollout smoke FAILED: canary breach did not auto-roll-back" >&2
+  echo "cleanly (accepted-request loss, no rollback, v1 gone, or" >&2
+  echo ">60s wall — see above)" >&2
+  exit 1
+fi
+
 # full static-analysis pass (replaces the per-script lints: one AST
 # parse per file, all nine rules); on failure print the JSON report so
 # CI logs carry the machine-readable findings, not just the exit code

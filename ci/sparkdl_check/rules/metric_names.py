@@ -22,10 +22,13 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: self-metrics) joined with the PR-8 telemetry plane; "supervisor"
 #: (replica lifecycle) and "router" (request plane) with the ISSUE-10
 #: replica supervisor; "wire" (frame codec + transport lanes) with the
-#: ISSUE-11 zero-copy data plane.
+#: ISSUE-11 zero-copy data plane; "rollout" (blue/green shift state)
+#: and "tenant" (per-tenant fair-share admission) with the ISSUE-12
+#: zero-downtime fleet.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
     "streaming", "slo", "ts", "supervisor", "router", "wire",
+    "rollout", "tenant",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

@@ -409,6 +409,98 @@ def serving_slos(
     ]
 
 
+def _router_label(name: str) -> str:
+    """The router's metric-segment form of a version/tenant label
+    (``[a-z0-9_]`` — no dots, unlike :func:`sanitize_name`): the series
+    these factories watch must match what the router actually emits."""
+    out = re.sub(r"[^a-z0-9_]", "_", str(name).lower())
+    return out or "unknown"
+
+
+def rollout_slos(
+    version: str,
+    latency_quantile: str = "p99",
+    latency_threshold_ms: float = 250.0,
+    latency_objective: float = 0.95,
+    error_objective: float = 0.99,
+    **overrides,
+) -> List[SLO]:
+    """The canary pair a :class:`~sparkdl_tpu.serving.rollout
+    .RolloutController` watches: a latency-quantile objective over the
+    router's *per-version* attempt series
+    (``router.latency_ms.<version>.p99``) and an error-rate objective
+    over ``router.errors.<version>`` / ``router.requests.<version>``.
+    Per-version series are attempt-level, so a 1%-weight canary is
+    measurable on its own traffic.  Objectives default looser than the
+    fleet SLOs (0.95 / 0.99): a canary page must mean the *new
+    version* is bad, not that one slow request landed on it.  Names are
+    ``rollout.<version>.latency`` / ``rollout.<version>.errors`` — the
+    ``rollout.<version>.`` prefix is what the controller's default
+    watch list matches."""
+    ver = _router_label(version)
+    return [
+        SLO(
+            name=f"rollout.{ver}.latency",
+            kind="threshold",
+            series=f"router.latency_ms.{ver}.{latency_quantile}",
+            threshold=latency_threshold_ms,
+            objective=latency_objective,
+            description=(
+                f"{latency_quantile} attempt latency of version "
+                f"{version!r} under {latency_threshold_ms:g} ms"
+            ),
+            **overrides,
+        ),
+        SLO(
+            name=f"rollout.{ver}.errors",
+            kind="error_rate",
+            numerator=f"router.errors.{ver}",
+            denominator=f"router.requests.{ver}",
+            objective=error_objective,
+            description=f"attempt success rate of version {version!r}",
+            **overrides,
+        ),
+    ]
+
+
+def tenant_slos(
+    tenant: str,
+    latency_quantile: str = "p99",
+    latency_threshold_ms: float = 250.0,
+    latency_objective: float = 0.95,
+    error_objective: float = 0.99,
+    **overrides,
+) -> List[SLO]:
+    """Per-tenant objectives over the router's tenant-labelled series
+    (``router.tenant.<tenant>.*``) — what the fairness harness asserts:
+    tenant B's pair must stay ``ok`` while tenant A saturates its
+    share."""
+    ten = _router_label(tenant)
+    return [
+        SLO(
+            name=f"tenant.{ten}.latency",
+            kind="threshold",
+            series=f"router.tenant.{ten}.latency_ms.{latency_quantile}",
+            threshold=latency_threshold_ms,
+            objective=latency_objective,
+            description=(
+                f"{latency_quantile} latency for tenant {tenant!r} "
+                f"under {latency_threshold_ms:g} ms"
+            ),
+            **overrides,
+        ),
+        SLO(
+            name=f"tenant.{ten}.errors",
+            kind="error_rate",
+            numerator=f"router.tenant.{ten}.errors",
+            denominator=f"router.tenant.{ten}.requests",
+            objective=error_objective,
+            description=f"request success rate for tenant {tenant!r}",
+            **overrides,
+        ),
+    ]
+
+
 def streaming_slos(
     max_watermark_lag_ms: float = 5000.0,
     lag_objective: float = 0.95,

@@ -65,6 +65,12 @@ KNOWN_SITES = {
         "supervisor.replica_warm", "supervisor.replica_serve",
     ),
     "router": ("router.route",),
+    # blue/green rollout transitions (RolloutController): shift fires
+    # before each weight change, bake before each canary evaluation,
+    # rollback before the rollback executes.  Errors at shift/bake are
+    # treated as canary-health-unknown and fail SAFE (roll back); an
+    # error at rollback must never stop the rollback itself.
+    "rollout": ("rollout.shift", "rollout.bake", "rollout.rollback"),
     # shm request path in the router's shm client channel — error/stall
     # rules here exercise the lane's failure handling without killing
     # the router process

@@ -13,11 +13,20 @@ On top of the single-process :class:`ModelServer` sits the replica
 plane (ISSUE-10): :class:`ReplicaSupervisor` runs N ``ModelServer``
 processes as killable OS replicas behind a :class:`Router` that
 load-balances, drains, and retries stranded requests, with an
-:class:`Autoscaler` closing the loop off SLO burn rates.  The heavy
-pieces import lazily — ``import sparkdl_tpu.serving`` stays cheap.
+:class:`Autoscaler` closing the loop off SLO burn rates.  ISSUE-12
+adds the deploy-safety layer: versioned endpoints with weighted
+blue/green traffic shifting, an SLO-guarded :class:`RolloutController`
+that auto-rolls-back a paging canary, and per-tenant weighted-fair
+admission (:class:`TenantPolicy`, typed :class:`TenantThrottled`
+shedding).  The heavy pieces import lazily — ``import
+sparkdl_tpu.serving`` stays cheap.
 """
 
-from sparkdl_tpu.serving.admission import AdmissionQueue, Request
+from sparkdl_tpu.serving.admission import (
+    AdmissionQueue,
+    Request,
+    TenantPolicy,
+)
 from sparkdl_tpu.serving.batcher import MicroBatcher, ServingConfig
 from sparkdl_tpu.serving.cache import ProgramCache
 from sparkdl_tpu.serving.errors import (
@@ -28,6 +37,7 @@ from sparkdl_tpu.serving.errors import (
     ServerClosed,
     ServerOverloaded,
     ServingError,
+    TenantThrottled,
 )
 from sparkdl_tpu.serving.server import ModelServer
 
@@ -44,11 +54,14 @@ __all__ = [
     "ReplicaSpec",
     "ReplicaSupervisor",
     "Request",
+    "RolloutController",
     "Router",
     "ServerClosed",
     "ServerOverloaded",
     "ServingConfig",
     "ServingError",
+    "TenantPolicy",
+    "TenantThrottled",
 ]
 
 
@@ -71,6 +84,10 @@ def __getattr__(name):
         from sparkdl_tpu.serving.autoscale import Autoscaler
 
         return Autoscaler
+    if name in ("RolloutController",):
+        from sparkdl_tpu.serving.rollout import RolloutController
+
+        return RolloutController
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )

@@ -115,6 +115,7 @@ class Autoscaler:
         )
         self._clean_evals = 0
         self._last_move_at: Optional[float] = None
+        self._paused = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._decisions: List[Dict[str, Any]] = []
@@ -132,6 +133,24 @@ class Autoscaler:
         """The decision log (what ``BENCH_LOAD_*.json`` embeds)."""
         return list(self._decisions)
 
+    @property
+    def paused(self) -> bool:
+        return self._paused
+
+    def pause(self) -> None:
+        """Freeze the control loop (evaluations become no-op records).
+        The :class:`~sparkdl_tpu.serving.rollout.RolloutController`
+        pauses scaling while a rollout is shifting traffic — a mid-shift
+        scale move would change the very denominators the canary SLOs
+        are judged on."""
+        self._paused = True
+
+    def resume(self) -> None:
+        """Un-freeze; the clean-eval streak restarts so a pause can
+        never queue up an immediate scale-down."""
+        self._paused = False
+        self._clean_evals = 0
+
     def _apply_admission(self) -> None:
         self._supervisor.router.set_max_inflight(
             self._replicas * self.per_replica_inflight
@@ -141,6 +160,18 @@ class Autoscaler:
         """One control step: read states, maybe move.  Returns the
         decision record (also appended to :meth:`decisions`)."""
         now = self._clock() if now is None else now
+        if self._paused:
+            decision = {
+                "at": now, "worst": "paused", "states": {},
+                "replicas_before": self._replicas,
+                "replicas_after": self._replicas,
+                "moved": False, "in_cooldown": False,
+                "max_inflight": (
+                    self._replicas * self.per_replica_inflight
+                ),
+            }
+            self._decisions.append(decision)
+            return decision
         states = self._engine.states()
         worst = "ok"
         for state in states.values():

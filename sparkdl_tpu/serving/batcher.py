@@ -32,7 +32,11 @@ from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.resilience.errors import CircuitOpen
 from sparkdl_tpu.resilience.policy import CircuitBreaker, Deadline, RetryPolicy
-from sparkdl_tpu.serving.admission import AdmissionQueue, Request
+from sparkdl_tpu.serving.admission import (
+    AdmissionQueue,
+    Request,
+    TenantPolicy,
+)
 from sparkdl_tpu.serving.cache import ProgramCache
 from sparkdl_tpu.serving.errors import DeadlineExceeded, ServerClosed
 from sparkdl_tpu.transformers.utils import (
@@ -59,6 +63,7 @@ class ServingConfig:
         retry: Optional[RetryPolicy] = None,
         breaker_threshold: int = 5,
         breaker_recovery_s: float = 30.0,
+        tenant_policy: Optional[TenantPolicy] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -77,6 +82,9 @@ class ServingConfig:
         self.retry = retry
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_recovery_s = float(breaker_recovery_s)
+        # per-tenant fair-share admission (ISSUE-12); None falls back to
+        # the SPARKDL_TENANT_* env knobs at endpoint construction
+        self.tenant_policy = tenant_policy
 
     def __repr__(self):
         return (
@@ -87,7 +95,8 @@ class ServingConfig:
             f"default_deadline_ms={self.default_deadline_ms}, "
             f"retry={self.retry}, "
             f"breaker_threshold={self.breaker_threshold}, "
-            f"breaker_recovery_s={self.breaker_recovery_s})"
+            f"breaker_recovery_s={self.breaker_recovery_s}, "
+            f"tenant_policy={self.tenant_policy})"
         )
 
 
@@ -154,6 +163,11 @@ class MicroBatcher:
             config.queue_capacity,
             depth_gauge=metrics.gauge(f"serving.queue_depth.{model_id}"),
             shed_counter=metrics.counter("serving.shed"),
+            tenant_policy=(
+                config.tenant_policy
+                if config.tenant_policy is not None
+                else TenantPolicy.from_env()
+            ),
         )
         self._breaker = CircuitBreaker(
             name=f"serving.{model_id}",
@@ -171,11 +185,14 @@ class MicroBatcher:
         self,
         value,
         deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> Future:
         """Admit one item; returns a Future resolving to the model output
-        row.  Raises :class:`ServerOverloaded` when the queue is full and
-        :class:`ServerClosed` after :meth:`close`; a deadline that expires
-        while queued fails the future with :class:`DeadlineExceeded`."""
+        row.  Raises :class:`ServerOverloaded` when the queue is full
+        (``TenantThrottled`` when only ``tenant`` is over its fair-share
+        cap) and :class:`ServerClosed` after :meth:`close`; a deadline
+        that expires while queued fails the future with
+        :class:`DeadlineExceeded`."""
         if self._closed:
             raise ServerClosed(f"endpoint {self.model_id!r} is closed")
         arr = np.asarray(value, dtype=self._dtype)
@@ -197,7 +214,7 @@ class MicroBatcher:
             if deadline_ms is not None
             else None
         )
-        req = Request(value=arr, deadline=deadline)
+        req = Request(value=arr, deadline=deadline, tenant=tenant)
         if tracer.enabled:
             # one span per request, child of the caller's current span;
             # it ends when the future resolves (on the worker thread),
@@ -214,9 +231,12 @@ class MicroBatcher:
         return req.future
 
     def predict(self, value, timeout: Optional[float] = None,
-                deadline_ms: Optional[float] = None):
+                deadline_ms: Optional[float] = None,
+                tenant: Optional[str] = None):
         """Blocking convenience: ``submit(...).result(timeout)``."""
-        return self.submit(value, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(
+            value, deadline_ms=deadline_ms, tenant=tenant
+        ).result(timeout)
 
     # ------------------------------------------------------------------
     # warmup
@@ -546,4 +566,9 @@ class MicroBatcher:
             "closed": self._closed,
             "degraded": self.degraded,
             "breaker": self._breaker.snapshot(),
+            "tenants": (
+                self._queue.tenants()
+                if self._queue.tenant_policy is not None
+                else None
+            ),
         }

@@ -32,6 +32,16 @@ class ServerOverloaded(ServingError, TransientError):
     should back off and retry; the server is alive.  (Transient.)"""
 
 
+class TenantThrottled(ServerOverloaded):
+    """One tenant is over its fair share — its inflight cap or queue
+    slice is exhausted — while the server as a whole still has headroom.
+    Raised only at *admission* (never for a request already admitted:
+    admitted work always resolves through its future).  Subclasses
+    :class:`ServerOverloaded` so existing shed handling applies, but the
+    distinct type lets a front-end throttle the one noisy tenant instead
+    of backing everyone off.  (Transient.)"""
+
+
 class DeadlineExceeded(ServingError, _DeadlineExpired):
     """The request's deadline expired while it waited in the queue; it was
     dropped before being padded into a batch (an expired answer would

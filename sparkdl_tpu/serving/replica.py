@@ -162,6 +162,34 @@ def demo_server_plain():
     return demo_server(compile=False)
 
 
+def demo_server_slow(endpoints: int = 3):
+    """A deliberately *regressed* demo build: every forward stalls
+    ``SPARKDL_DEMO_DELAY_MS`` (default 80) before answering.  This is
+    the canary-breach stand-in for the rollout chaos scenarios — deploy
+    it as v2 and the per-version p99 blows the canary SLO within one
+    burn window, without faking any metric."""
+    from sparkdl_tpu.serving.batcher import ServingConfig
+    from sparkdl_tpu.serving.server import ModelServer
+
+    delay_s = float(os.environ.get("SPARKDL_DEMO_DELAY_MS", "80")) / 1000.0
+    dim = 64
+    server = ModelServer(config=ServingConfig(
+        max_batch=16, max_wait_ms=1.0, queue_capacity=512,
+    ))
+    for i in range(int(endpoints)):
+        weight = np.linspace(
+            -1.0, 1.0, dim * dim, dtype=np.float32
+        ).reshape(dim, dim) * (i + 1)
+
+        def forward(x, _w=weight):
+            time.sleep(delay_s)
+            return np.tanh(np.asarray(x) @ _w)
+
+        server.register(f"ep{i}", forward, item_shape=(dim,),
+                        compile=False)
+    return server
+
+
 class ReplicaService:
     """Serve a :class:`ModelServer` over the wire protocol.
 
@@ -305,6 +333,7 @@ class ReplicaService:
                 msg["value"],
                 model_id=msg.get("model_id"),
                 deadline_ms=msg.get("deadline_ms"),
+                tenant=msg.get("tenant"),
             )
             ok = True
             return ("future", fut, time.monotonic())

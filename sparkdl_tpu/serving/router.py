@@ -18,6 +18,21 @@ re-execution is safe; replies classified *transient*
 propagate untouched.  Only when no live replica remains does the typed
 :class:`~sparkdl_tpu.serving.errors.NoLiveReplicas` surface.
 
+**Versioned placement** (ISSUE-12): every backend carries a deployment
+``version`` ("v1" by default) and :meth:`set_weights` splits traffic
+across versions by weight — the blue/green dial the
+:class:`~sparkdl_tpu.serving.rollout.RolloutController` turns through
+1% → 50% → 100%.  A request may pin a version explicitly with the
+``name@version`` endpoint form (``"ep0@v2"``); unpinned requests follow
+the weights.  A zero-weight version receives *no* unpinned traffic
+(the rollback guarantee) — unless every candidate version is
+zero-weighted, in which case availability wins over the split and the
+fallback is counted in ``router.weight_fallback``.  Per-version series
+(``router.requests.<v>`` / ``router.errors.<v>`` /
+``router.latency_ms.<v>``) are *attempt*-level so a misbehaving canary
+at 1% weight is measurable on its own, and per-tenant series
+(``router.tenant.<t>.*``) give the SLO engine a per-tenant page signal.
+
 Admission control sits in front: ``max_inflight`` bounds the router's
 total in-flight work (beyond it requests shed with the transient
 ``ServerOverloaded``, counted in ``router.shed``) — the knob the SLO
@@ -29,6 +44,7 @@ process load generators (``benchmarks/bench_load.py``) connect to.
 
 from __future__ import annotations
 
+import random
 import socket
 import socketserver
 import threading
@@ -44,6 +60,27 @@ from sparkdl_tpu.serving.errors import (
 )
 from sparkdl_tpu.utils.metrics import metrics
 
+#: version every backend belongs to unless told otherwise
+DEFAULT_VERSION = "v1"
+
+
+def split_versioned(model_id: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    """``"ep0@v2"`` -> ``("ep0", "v2")``; ``"ep0"`` -> ``("ep0", None)``.
+    The version half never reaches the replica — its endpoints are
+    version-unaware; the pin only constrains router placement."""
+    if model_id is None or "@" not in model_id:
+        return model_id, None
+    base, _, version = model_id.rpartition("@")
+    return (base or None), (version or None)
+
+
+def _sanitize_label(label: str) -> str:
+    """Metric-segment-safe form of a tenant/version label."""
+    return "".join(
+        ch if (ch.isalnum() or ch == "_") else "_"
+        for ch in label.lower()
+    ) or "unknown"
+
 
 class _Backend:
     """One registered replica: a :class:`~sparkdl_tpu.serving.transport.
@@ -52,11 +89,13 @@ class _Backend:
 
     def __init__(self, name: str, host: str, port: int,
                  lanes: Tuple[str, ...] = ("tcp",),
+                 version: str = DEFAULT_VERSION,
                  connect_timeout_s: float = 2.0,
                  io_timeout_s: float = 30.0):
         self.name = name
         self.host = host
         self.port = int(port)
+        self.version = str(version)
         self.inflight = 0
         self.removed = False
         self.transport = transport_mod.make_transport(
@@ -70,18 +109,46 @@ class _Backend:
         self.transport.close()
 
 
+class _VersionInstruments:
+    """Cached per-version counters/histogram (hot path: no registry
+    lookup per request)."""
+
+    __slots__ = ("requests", "errors", "latency")
+
+    def __init__(self, version: str):
+        label = _sanitize_label(version)
+        self.requests = metrics.counter(f"router.requests.{label}")
+        self.errors = metrics.counter(f"router.errors.{label}")
+        self.latency = metrics.histogram(f"router.latency_ms.{label}")
+
+
+class _TenantInstruments:
+    __slots__ = ("requests", "errors", "shed", "latency")
+
+    def __init__(self, tenant: str):
+        label = _sanitize_label(tenant)
+        self.requests = metrics.counter(f"router.tenant.{label}.requests")
+        self.errors = metrics.counter(f"router.tenant.{label}.errors")
+        self.shed = metrics.counter(f"router.tenant.{label}.shed")
+        self.latency = metrics.histogram(f"router.tenant.{label}.latency_ms")
+
+
 class Router:
-    """Least-loaded placement + stranded-request retry over the
-    registered replica set (see module docstring for the contract)."""
+    """Weighted version split + least-loaded placement + stranded-request
+    retry over the registered replica set (see module docstring for the
+    contract)."""
 
     def __init__(
         self,
         max_inflight: Optional[int] = None,
         request_timeout_s: float = 30.0,
         connect_timeout_s: float = 2.0,
+        seed: int = 0,
     ):
         self._lock = threading.Lock()
         self._backends: Dict[str, _Backend] = {}
+        self._weights: Dict[str, float] = {}
+        self._rng = random.Random(seed)
         self._max_inflight = (
             int(max_inflight) if max_inflight is not None else None
         )
@@ -96,17 +163,23 @@ class Router:
         self._m_latency = metrics.histogram("router.latency_ms")
         self._m_inflight = metrics.gauge("router.inflight")
         self._m_replicas = metrics.gauge("router.replicas")
+        self._m_weight_fallback = metrics.counter("router.weight_fallback")
+        self._vm: Dict[str, _VersionInstruments] = {}
+        self._tm: Dict[str, _TenantInstruments] = {}
 
     # ------------------------------------------------------------------
     # membership (the supervisor's side of the interface)
     # ------------------------------------------------------------------
     def add(self, name: str, host: str, port: int,
-            lanes: Tuple[str, ...] = ("tcp",)) -> None:
+            lanes: Tuple[str, ...] = ("tcp",),
+            version: str = DEFAULT_VERSION) -> None:
         """Register a replica.  ``lanes`` is what it advertised in its
         ready line; the transport factory (and the
-        ``SPARKDL_WIRE_TRANSPORT`` override) picks the lane."""
+        ``SPARKDL_WIRE_TRANSPORT`` override) picks the lane.
+        ``version`` is the deployment group weighted placement splits
+        over."""
         backend = _Backend(
-            name, host, port, lanes=tuple(lanes),
+            name, host, port, lanes=tuple(lanes), version=version,
             connect_timeout_s=self._connect_timeout_s,
             io_timeout_s=self._request_timeout_s,
         )
@@ -136,6 +209,40 @@ class Router:
             return {b.name: b.transport.lane
                     for b in self._backends.values()}
 
+    def versions(self) -> Dict[str, int]:
+        """Deployment version -> registered backend count."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for b in self._backends.values():
+                out[b.version] = out.get(b.version, 0) + 1
+            return out
+
+    # ------------------------------------------------------------------
+    # traffic split (the rollout controller's side of the interface)
+    # ------------------------------------------------------------------
+    def set_weights(self, weights: Dict[str, float]) -> None:
+        """Replace the version traffic split.  Unlisted versions keep
+        the implicit weight 1.0 (a fresh fleet needs no configuration);
+        an explicit 0.0 starves the version of unpinned traffic."""
+        clean = {}
+        for version, w in weights.items():
+            w = float(w)
+            if w < 0:
+                raise ValueError(
+                    f"weight for {version!r} must be >= 0, got {w}"
+                )
+            clean[str(version)] = w
+        with self._lock:
+            self._weights = clean
+        for version, w in clean.items():
+            metrics.gauge(
+                f"router.weight.{_sanitize_label(version)}"
+            ).set(w)
+
+    def weights(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._weights)
+
     def set_max_inflight(self, n: Optional[int]) -> None:
         """The admission limit — the autoscaler's second knob."""
         with self._lock:
@@ -149,11 +256,13 @@ class Router:
     # ------------------------------------------------------------------
     # request path
     # ------------------------------------------------------------------
-    def _admit(self) -> None:
+    def _admit(self, tm: Optional[_TenantInstruments]) -> None:
         with self._lock:
             limit = self._max_inflight
             if limit is not None and self._total_inflight >= limit:
                 self._m_shed.add(1)
+                if tm is not None:
+                    tm.shed.add(1)
                 raise ServerOverloaded(
                     f"router at admission limit ({limit} in flight); "
                     "load-shedding"
@@ -166,15 +275,63 @@ class Router:
             self._total_inflight -= 1
             self._m_inflight.set(self._total_inflight)
 
-    def _pick(self, tried) -> Optional[_Backend]:
-        """Live backend with the fewest in-flight, excluding ``tried``."""
+    def _version_instruments(self, version: str) -> _VersionInstruments:
+        vm = self._vm.get(version)
+        if vm is None:
+            vm = self._vm.setdefault(version, _VersionInstruments(version))
+        return vm
+
+    def _tenant_instruments(
+        self, tenant: Optional[str]
+    ) -> Optional[_TenantInstruments]:
+        if tenant is None:
+            return None
+        tm = self._tm.get(tenant)
+        if tm is None:
+            tm = self._tm.setdefault(tenant, _TenantInstruments(tenant))
+        return tm
+
+    def _pick(self, tried, pin: Optional[str] = None) -> Optional[_Backend]:
+        """Choose a version by weight (or honour ``pin``), then the
+        backend with the fewest in-flight within it, excluding
+        ``tried``."""
         with self._lock:
             candidates = [
                 b for b in self._backends.values()
                 if b.name not in tried and not b.removed
+                and (pin is None or b.version == pin)
             ]
             if not candidates:
                 return None
+            by_version: Dict[str, list] = {}
+            for b in candidates:
+                by_version.setdefault(b.version, []).append(b)
+            if pin is None and len(by_version) > 1:
+                weighted = [
+                    (v, self._weights.get(v, 1.0)) for v in by_version
+                ]
+                total = sum(w for _, w in weighted)
+                if total > 0:
+                    roll = self._rng.random() * total
+                    acc = 0.0
+                    chosen = weighted[-1][0]
+                    for v, w in weighted:
+                        acc += w
+                        if roll < acc:
+                            chosen = v
+                            break
+                    candidates = by_version[chosen]
+                else:
+                    # every candidate version is weighted to zero —
+                    # serve anyway (availability > split fidelity) and
+                    # make the breach countable
+                    self._m_weight_fallback.add(1)
+            elif pin is None and len(by_version) == 1:
+                only = next(iter(by_version))
+                if self._weights.get(only, 1.0) == 0.0:
+                    # the sole surviving version is the starved one:
+                    # availability wins, but visibly
+                    self._m_weight_fallback.add(1)
             best = min(candidates, key=lambda b: b.inflight)
             best.inflight += 1
             return best
@@ -189,13 +346,14 @@ class Router:
         model_id: Optional[str] = None,
         deadline_ms: Optional[float] = None,
         timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ):
         """Place one request; returns the model output row or raises a
         typed error.  Retries connection failures and transient replies
         on other live replicas until the replica set is exhausted."""
         return self.route_reply(
             value, model_id=model_id, deadline_ms=deadline_ms,
-            timeout_s=timeout_s,
+            timeout_s=timeout_s, tenant=tenant,
         )["result"]
 
     def route_reply(
@@ -204,11 +362,14 @@ class Router:
         model_id: Optional[str] = None,
         deadline_ms: Optional[float] = None,
         timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> Dict[str, Any]:
         """:meth:`route`, but returning the full reply envelope (the
         front door forwards ``server_ms`` so the bench can separate
         router-added overhead from replica forward time)."""
-        self._admit()
+        base_id, pin = split_versioned(model_id)
+        tm = self._tenant_instruments(tenant)
+        self._admit(tm)
         start = time.monotonic()
         budget = (
             timeout_s if timeout_s is not None else self._request_timeout_s
@@ -217,26 +378,35 @@ class Router:
         try:
             inject.fire("router.route")
             self._m_requests.add(1)
+            if tm is not None:
+                tm.requests.add(1)
             tried: set = set()
             last_exc: Optional[BaseException] = None
             while True:
-                backend = self._pick(tried)
+                backend = self._pick(tried, pin=pin)
                 if backend is None:
                     self._m_errors.add(1)
+                    if tm is not None:
+                        tm.errors.add(1)
                     if last_exc is not None:
                         raise last_exc
                     raise NoLiveReplicas(
                         "no live replica to place the request on "
-                        f"(tried {sorted(tried) or 'none'})"
+                        f"(version {pin or 'any'}; "
+                        f"tried {sorted(tried) or 'none'})"
                     )
+                vm = self._version_instruments(backend.version)
+                vm.requests.add(1)
+                attempt_start = time.monotonic()
                 try:
                     reply = self._send_one(
-                        backend, value, model_id, deadline_ms,
+                        backend, value, base_id, deadline_ms, tenant,
                         max(0.05, deadline - time.monotonic()),
                     )
                 except (ConnectionError, OSError, socket.timeout) as exc:
                     # the stranded-request case: the replica died (or
                     # wedged) under this request — re-place it
+                    vm.errors.add(1)
                     tried.add(backend.name)
                     last_exc = exc
                     self._m_retries.add(1)
@@ -244,6 +414,7 @@ class Router:
                 except Exception as exc:
                     from sparkdl_tpu.resilience.errors import is_transient
 
+                    vm.errors.add(1)
                     if is_transient(exc):
                         # draining / replica-side shed: try elsewhere
                         tried.add(backend.name)
@@ -251,23 +422,31 @@ class Router:
                         self._m_retries.add(1)
                         continue
                     self._m_errors.add(1)
+                    if tm is not None:
+                        tm.errors.add(1)
                     raise
                 finally:
                     self._unpick(backend)
-                self._m_latency.observe(
-                    (time.monotonic() - start) * 1000.0
-                )
+                now = time.monotonic()
+                # per-version latency is per-*attempt* so a retried
+                # request doesn't charge the surviving version for time
+                # the dying one burned
+                vm.latency.observe((now - attempt_start) * 1000.0)
+                self._m_latency.observe((now - start) * 1000.0)
+                if tm is not None:
+                    tm.latency.observe((now - start) * 1000.0)
                 return reply
         finally:
             self._release()
 
     def _send_one(self, backend: _Backend, value, model_id, deadline_ms,
-                  timeout_s: float) -> Dict[str, Any]:
+                  tenant: Optional[str], timeout_s: float) -> Dict[str, Any]:
         reply = backend.transport.request({
             "op": "infer",
             "model_id": model_id,
             "value": value,
             "deadline_ms": deadline_ms,
+            "tenant": tenant,
         }, timeout_s)
         if not isinstance(reply, dict):
             raise ConnectionError(
@@ -308,6 +487,7 @@ class Router:
                                 msg["value"],
                                 model_id=msg.get("model_id"),
                                 deadline_ms=msg.get("deadline_ms"),
+                                tenant=msg.get("tenant"),
                             )
                             reply = {
                                 "ok": True,
@@ -366,5 +546,6 @@ class Router:
     def __repr__(self):
         return (
             f"Router(replicas={sorted(self.names())}, "
+            f"weights={self.weights()}, "
             f"max_inflight={self.max_inflight})"
         )

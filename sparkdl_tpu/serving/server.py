@@ -191,10 +191,13 @@ class ModelServer:
         value,
         model_id: Optional[str] = None,
         deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> Future:
         """Admit one item for ``model_id`` (optional when the server
         hosts exactly one endpoint); returns the request's Future."""
-        return self._endpoint(model_id).submit(value, deadline_ms=deadline_ms)
+        return self._endpoint(model_id).submit(
+            value, deadline_ms=deadline_ms, tenant=tenant
+        )
 
     def predict(
         self,
@@ -202,9 +205,10 @@ class ModelServer:
         model_id: Optional[str] = None,
         timeout: Optional[float] = None,
         deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
     ):
         return self._endpoint(model_id).predict(
-            value, timeout=timeout, deadline_ms=deadline_ms
+            value, timeout=timeout, deadline_ms=deadline_ms, tenant=tenant
         )
 
     # ------------------------------------------------------------------

@@ -53,7 +53,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.resilience.policy import CircuitBreaker, RetryPolicy
 from sparkdl_tpu.serving.replica import ENV_SPEC, ReplicaSpec
-from sparkdl_tpu.serving.router import Router
+from sparkdl_tpu.serving.router import DEFAULT_VERSION, Router
 from sparkdl_tpu.utils.metrics import metrics
 
 logger = logging.getLogger(__name__)
@@ -71,10 +71,14 @@ class ReplicaHandle:
                          \\-> stopped          (graceful scale-down)
     """
 
-    def __init__(self, slot: int, spec: ReplicaSpec):
+    def __init__(
+        self, slot: int, spec: ReplicaSpec,
+        version: str = DEFAULT_VERSION,
+    ):
         self.slot = int(slot)
         self.name = f"replica-{slot}"
         self.spec = spec
+        self.version = str(version)
         self.proc: Optional[subprocess.Popen] = None
         self.state = "new"
         self.generation = 0          # completed spawns
@@ -93,6 +97,7 @@ class ReplicaHandle:
         return {
             "slot": self.slot,
             "name": self.name,
+            "version": self.version,
             "state": self.state,
             "pid": self.proc.pid if self.proc is not None else None,
             "port": self.port,
@@ -129,6 +134,10 @@ class ReplicaSupervisor:
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self._spec = spec
+        #: one ReplicaSpec per registered version; the initial spec is
+        #: the primary ("v1") fleet, :meth:`deploy` adds more
+        self._specs: Dict[str, ReplicaSpec] = {DEFAULT_VERSION: spec}
+        self._primary_version = DEFAULT_VERSION
         self._initial_replicas = int(replicas)
         self._owns_router = router is None
         self.router = router if router is not None else Router()
@@ -212,11 +221,14 @@ class ReplicaSupervisor:
     # ------------------------------------------------------------------
     # spawning
     # ------------------------------------------------------------------
-    def _add_slot(self) -> ReplicaHandle:
+    def _add_slot(self, version: Optional[str] = None) -> ReplicaHandle:
         with self._lock:
+            if version is None:
+                version = self._primary_version
+            spec = self._specs[version]
             slot = self._next_slot
             self._next_slot += 1
-            handle = ReplicaHandle(slot, self._spec)
+            handle = ReplicaHandle(slot, spec, version=version)
             self._handles[slot] = handle
             self._breakers[slot] = CircuitBreaker(
                 name=f"supervisor.slot{slot}",
@@ -289,7 +301,7 @@ class ReplicaSupervisor:
         self._breakers[handle.slot].record_success()
         self.router.add(
             handle.name, handle.spec.host, handle.port,
-            lanes=handle.lanes,
+            lanes=handle.lanes, version=handle.version,
         )
         self._m_spawn_time.add_seconds(time.monotonic() - started)
         logger.info(
@@ -461,24 +473,116 @@ class ReplicaSupervisor:
     # ------------------------------------------------------------------
     # operator surface
     # ------------------------------------------------------------------
-    def scale_to(self, n: int) -> int:
-        """Grow or (gracefully) shrink toward ``n`` replicas; returns the
-        resulting slot count.  Shrink stops the highest slots — drain
+    def scale_to(self, n: int, version: Optional[str] = None) -> int:
+        """Grow or (gracefully) shrink toward ``n`` replicas of one
+        version (default: the primary fleet); returns the resulting slot
+        count for that version.  Shrink stops the highest slots — drain
         first, never a kill."""
         n = max(1, int(n))
+        with self._lock:
+            if version is None:
+                version = self._primary_version
         while True:
             with self._lock:
                 active = sorted(
                     h.slot for h in self._handles.values()
-                    if h.state not in ("stopped", "evicted")
+                    if h.version == version
+                    and h.state not in ("stopped", "evicted")
                 )
             if len(active) < n:
-                self._add_slot()
+                self._add_slot(version)
                 continue
             if len(active) > n:
                 self.stop_replica(active[-1])
                 continue
             return len(active)
+
+    # ------------------------------------------------------------------
+    # versioned deploys (the blue/green substrate RolloutController
+    # drives — the supervisor only knows *mechanism*: spawn a second
+    # fleet, retire a fleet, flip which one scaling targets)
+    # ------------------------------------------------------------------
+    def deploy(
+        self,
+        version: str,
+        spec: ReplicaSpec,
+        replicas: int = 1,
+    ) -> List[ReplicaHandle]:
+        """Spawn ``replicas`` slots of a new ``version`` next to the
+        existing fleet(s).  The new replicas register with the router
+        under their version, so they receive no unpinned traffic until
+        :meth:`Router.set_weights` gives the version weight.  Spawning
+        is synchronous (ready-line waited); restarts of these slots
+        reuse the deployed spec."""
+        version = str(version)
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        with self._lock:
+            existing = self._specs.get(version)
+            if existing is not None and existing is not spec:
+                raise ValueError(
+                    f"version {version!r} already deployed; retire it "
+                    "before redeploying"
+                )
+            self._specs[version] = spec
+        metrics.counter("supervisor.deploys").add(1)
+        handles = [self._add_slot(version) for _ in range(replicas)]
+        logger.info(
+            "deployed version %s: %d replica(s)", version, len(handles)
+        )
+        return handles
+
+    def retire_version(self, version: str) -> Dict[int, Optional[int]]:
+        """Gracefully drain and stop every slot of ``version`` (router
+        removal first, then SIGTERM — the zero-downtime half of a
+        promotion or rollback).  Returns ``{slot: exit_code}``; exit 0
+        everywhere means every in-flight request finished.  The version's
+        spec is dropped, so the monitor cannot resurrect its slots."""
+        version = str(version)
+        with self._lock:
+            if version == self._primary_version:
+                raise ValueError(
+                    f"refusing to retire the primary version {version!r}; "
+                    "set_primary() to the survivor first"
+                )
+            slots = [
+                h.slot for h in self._handles.values()
+                if h.version == version
+                and h.state not in ("stopped", "evicted")
+            ]
+            self._specs.pop(version, None)
+        exits: Dict[int, Optional[int]] = {}
+        for slot in slots:
+            self.stop_replica(slot, graceful=True)
+            with self._lock:
+                exits[slot] = self._handles[slot].last_exit
+        metrics.counter("supervisor.retired").add(len(slots))
+        logger.info("retired version %s: exits=%s", version, exits)
+        return exits
+
+    def set_primary(self, version: str) -> None:
+        """Flip which version unqualified :meth:`scale_to` (and the
+        autoscaler through it) targets — the promotion bookkeeping step
+        after a rollout reaches 100%."""
+        version = str(version)
+        with self._lock:
+            if version not in self._specs:
+                raise KeyError(f"version {version!r} was never deployed")
+            self._primary_version = version
+
+    @property
+    def primary_version(self) -> str:
+        with self._lock:
+            return self._primary_version
+
+    def versions(self) -> Dict[str, int]:
+        """Live replica count per version."""
+        with self._lock:
+            out: Dict[str, int] = {v: 0 for v in self._specs}
+            for h in self._handles.values():
+                if h.state == "live":
+                    out[h.version] = out.get(h.version, 0) + 1
+            return out
 
     def stop_replica(self, slot: int, graceful: bool = True) -> None:
         """Take one replica out of service. Graceful = drain contract:
@@ -549,32 +653,40 @@ class ReplicaSupervisor:
         with self._lock:
             return list(self._handles.values())
 
-    def live_count(self) -> int:
+    def live_count(self, version: Optional[str] = None) -> int:
         with self._lock:
             return sum(
-                1 for h in self._handles.values() if h.state == "live"
+                1 for h in self._handles.values()
+                if h.state == "live"
+                and (version is None or h.version == version)
             )
 
-    def wait_live(self, n: int, timeout_s: float = 60.0) -> bool:
+    def wait_live(
+        self, n: int, timeout_s: float = 60.0,
+        version: Optional[str] = None,
+    ) -> bool:
         """Block (event-paced, not sleep-retry) until ``n`` replicas are
         live or ``timeout_s`` passes."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            if self.live_count() >= n:
+            if self.live_count(version) >= n:
                 return True
             if self._stop.wait(0.05):
                 return False
-        return self.live_count() >= n
+        return self.live_count(version) >= n
 
     def status(self) -> Dict[str, Any]:
         """The supervisor's ``/healthz`` payload: healthy while at least
         one replica is live."""
         with self._lock:
             rows = [h.describe() for h in self._handles.values()]
+            primary = self._primary_version
         live = sum(1 for r in rows if r["state"] == "live")
         return {
             "healthy": live > 0,
             "live": live,
+            "primary_version": primary,
+            "versions": self.versions(),
             "replicas": rows,
             "breakers": {
                 slot: b.snapshot() for slot, b in self._breakers.items()

@@ -59,6 +59,12 @@ ENV_SHM_DISABLE = "SPARKDL_WIRE_SHM_DISABLE"  # replica-side refusal
 ENV_RING_BYTES = "SPARKDL_WIRE_SHM_RING"      # per-direction ring capacity
 ENV_COALESCE = "SPARKDL_WIRE_COALESCE"        # "0" disables TCP coalescing
 ENV_COALESCE_MS = "SPARKDL_WIRE_COALESCE_MS"  # extra flush window (default 0)
+ENV_POOL_IDLE_S = "SPARKDL_WIRE_POOL_IDLE_S"  # pooled-socket age-out window
+
+#: discard pooled sockets idle longer than this — a replica that was
+#: replaced behind the same name while traffic was quiet should cost a
+#: dial, not a retry
+DEFAULT_POOL_IDLE_S = 30.0
 
 DEFAULT_RING_BYTES = 1 << 20
 _POLL_SPIN = 32           # busy polls before blocking on the doorbell
@@ -179,6 +185,20 @@ def make_transport(
 # TCP lane
 
 
+def _sock_is_stale(sock) -> bool:
+    """True when a pooled *idle* socket must not carry the next request.
+    The wire protocol is strictly request/reply, so an idle socket with
+    readable data is either EOF (the replica died while the socket sat
+    pooled) or a torn stream — both mean dial fresh.  Without this
+    probe a whole pool of sockets to a dead replica fails one request
+    each before the pool empties (the ISSUE-12 staleness burst)."""
+    try:
+        readable, _, _ = select.select([sock], [], [], 0)
+    except (OSError, ValueError):
+        return True
+    return bool(readable)
+
+
 class _Slot:
     __slots__ = ("msg", "done", "reply", "exc")
 
@@ -265,6 +285,13 @@ class _Coalescer:
     def _roundtrip(self, batch: List[_Slot]) -> None:
         try:
             sock = self._sock
+            if sock is not None and _sock_is_stale(sock):
+                # the replica died while the lane was idle between
+                # round trips: pay a fresh dial here, not a failed
+                # batch surfacing as ConnectionError retries
+                metrics.counter("wire.pool.stale").add(1)
+                self._drop_sock()
+                sock = None
             if sock is None:
                 sock = wire.connect(
                     self._host, self._port, self._connect_timeout_s
@@ -335,8 +362,11 @@ class TcpTransport(Transport):
         self._connect_timeout_s = connect_timeout_s
         self._io_timeout_s = io_timeout_s
         self._max_idle = max_idle
+        self._max_idle_s = float(
+            os.environ.get(ENV_POOL_IDLE_S, str(DEFAULT_POOL_IDLE_S))
+        )
         self._lock = threading.Lock()
-        self._idle: List[socket.socket] = []
+        self._idle: List[Tuple[socket.socket, float]] = []
         self._closed = False
         if coalesce is None:
             coalesce = os.environ.get(ENV_COALESCE, "1") != "0"
@@ -374,17 +404,34 @@ class TcpTransport(Transport):
         return reply
 
     def _checkout(self) -> socket.socket:
-        with self._lock:
-            if self._closed:
-                raise ConnectionError("transport closed")
-            if self._idle:
-                return self._idle.pop()
+        """A pooled socket proven idle-healthy, or a fresh dial.  Aged
+        and stale entries are discarded here (probe outside the lock —
+        select is a syscall) so replica death during a quiet spell costs
+        a dial, never a user-visible error burst."""
+        now = time.monotonic()
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise ConnectionError("transport closed")
+                if not self._idle:
+                    break
+                sock, idle_since = self._idle.pop()
+            if now - idle_since > self._max_idle_s:
+                metrics.counter("wire.pool.aged").add(1)
+            elif not _sock_is_stale(sock):
+                return sock
+            else:
+                metrics.counter("wire.pool.stale").add(1)
+            try:
+                sock.close()
+            except OSError:
+                pass
         return wire.connect(self._host, self._port, self._connect_timeout_s)
 
     def _checkin(self, sock: socket.socket) -> None:
         with self._lock:
             if not self._closed and len(self._idle) < self._max_idle:
-                self._idle.append(sock)
+                self._idle.append((sock, time.monotonic()))
                 return
         try:
             sock.close()
@@ -395,7 +442,7 @@ class TcpTransport(Transport):
         with self._lock:
             self._closed = True
             idle, self._idle = self._idle, []
-        for sock in idle:
+        for sock, _ in idle:
             try:
                 sock.close()
             except OSError:
@@ -757,11 +804,24 @@ class ShmTransport(Transport):
         return fallback
 
     def _checkout(self) -> _ShmClientChannel:
-        with self._lock:
-            if self._closed:
-                raise ConnectionError("transport closed")
-            if self._idle:
-                return self._idle.pop()
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise ConnectionError("transport closed")
+                if not self._idle:
+                    break
+                chan = self._idle.pop()
+            # the side-channel is the liveness signal: EOF (or a frame
+            # that has no business arriving on an idle channel) means
+            # the replica died while this channel sat pooled
+            try:
+                stale = _drain_side_channel(chan._sock) is not None
+            except ConnectionError:
+                stale = True
+            if not stale:
+                return chan
+            metrics.counter("wire.pool.stale").add(1)
+            chan.close()
         return _ShmClientChannel(
             self._host, self._port, self._connect_timeout_s,
             self._io_timeout_s, self._ring_bytes,

@@ -22,6 +22,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -363,9 +364,10 @@ class TestTransports:
             srv.server_close()
 
     def test_peer_death_is_connection_error(self):
-        # SIGKILL equivalent at channel level: the peer's socket dies
-        # and the client must turn that into a typed ConnectionError
-        # instead of spinning on a ring no one will ever answer
+        # SIGKILL equivalent at channel level: the peer process is gone
+        # (listener included) and the client must turn that into a
+        # typed ConnectionError instead of spinning on a ring no one
+        # will ever answer
         srv, port = start_echo()
         t = transport.ShmTransport("127.0.0.1", port)
         try:
@@ -376,8 +378,36 @@ class TestTransports:
                     conn.shutdown(socket.SHUT_RDWR)
                 except OSError:
                     pass
+            srv.shutdown()
+            srv.server_close()
             with pytest.raises((ConnectionError, OSError)):
                 t.request({"op": "infer", "value": x}, 2.0)
+        finally:
+            t.close()
+        assert transport.active_segments() == []
+        assert my_shm_entries() == []
+
+    def test_replica_restart_while_pooled_recovers_transparently(self):
+        # the softer death: the replica behind the name was restarted
+        # while this channel sat pooled, but SOMETHING is listening
+        # again — the staleness probe discards the dead channel and the
+        # request rides a fresh one instead of surfacing ConnectionError
+        srv, port = start_echo()
+        t = transport.ShmTransport("127.0.0.1", port)
+        try:
+            x = np.ones(4, np.float32)
+            t.request({"op": "infer", "value": x}, 5.0)
+            before = metrics.counter("wire.pool.stale").value
+            for conn in list(srv.conns):
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                    conn.close()
+                except OSError:
+                    pass
+            time.sleep(0.05)
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+            assert metrics.counter("wire.pool.stale").value == before + 1
         finally:
             t.close()
             srv.shutdown()
@@ -427,6 +457,71 @@ class TestTransports:
                     )
             t.close()
             assert my_shm_entries() == []
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestPoolStaleness:
+    """ISSUE-12 satellite: the idle pool must never hand a request a
+    socket the peer already abandoned — checkout probes (readable while
+    idle == EOF/garbage) and age-gates every pooled entry first."""
+
+    def test_healthy_idle_socket_is_reused(self):
+        srv, port = start_echo()
+        try:
+            t = transport.TcpTransport("127.0.0.1", port, coalesce=False)
+            x = np.ones(4, np.float32)
+            for _ in range(3):
+                reply = t.request({"op": "infer", "value": x}, 5.0)
+                np.testing.assert_array_equal(reply["result"], x * 2)
+            # all three rode the same connection: probe passed, no churn
+            assert len(srv.conns) == 1
+            t.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_peer_closed_idle_socket_is_discarded_not_served(self):
+        srv, port = start_echo()
+        try:
+            t = transport.TcpTransport("127.0.0.1", port, coalesce=False)
+            x = np.ones(4, np.float32)
+            t.request({"op": "infer", "value": x}, 5.0)
+            # replica restarts during a quiet spell: the pooled socket
+            # is now a dead letter the old code would try to write to
+            before = metrics.counter("wire.pool.stale").value
+            for conn in list(srv.conns):
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                    conn.close()
+                except OSError:
+                    pass
+            time.sleep(0.05)  # let the FIN land client-side
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+            assert metrics.counter("wire.pool.stale").value == before + 1
+            assert len(srv.conns) == 2  # fresh dial, not the corpse
+            t.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_idle_socket_ages_out(self, monkeypatch):
+        # the knob is read at construction: set it BEFORE the transport
+        monkeypatch.setenv("SPARKDL_WIRE_POOL_IDLE_S", "0.02")
+        srv, port = start_echo()
+        try:
+            t = transport.TcpTransport("127.0.0.1", port, coalesce=False)
+            x = np.ones(4, np.float32)
+            t.request({"op": "infer", "value": x}, 5.0)
+            before = metrics.counter("wire.pool.aged").value
+            time.sleep(0.05)  # older than the 20ms budget
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+            assert metrics.counter("wire.pool.aged").value == before + 1
+            assert len(srv.conns) == 2
+            t.close()
         finally:
             srv.shutdown()
             srv.server_close()
