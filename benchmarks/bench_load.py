@@ -51,11 +51,28 @@ the goodput timeline through the transition.  ``--rollout-regress-ms
 exit 0.  ``--tenants a,b`` makes workers send tenant labels
 (per-tenant admission + ``router.tenant.*`` series).
 
+Since ISSUE-13 the report decomposes each request's latency into the
+wire-stamped **phases** that ride every reply envelope (``admission``,
+``router_queue``, ``transport``, ``wire``, ``replica_queue``,
+``forward``, ``fetch``, plus the front door's ``frontdoor`` residual):
+a per-phase p50/p95/p99 table plus the coverage ratio (phase sum over
+end-to-end p50 — the proof the decomposition accounts for the latency
+it claims to explain).  ``--obs on`` additionally turns on the
+fleet-wide observability plane for the run: cross-process tracing
+(router + replicas; the stitched traces land in ``--trace-out``) and
+supervisor-side metrics federation (``fleet.*`` series scraped from
+every replica's ObsServer).  The report then carries a ``trace``
+section (spans, traces, how many stitched end-to-end) and a ``fleet``
+section (scrape health).  ``--obs off`` is the baseline twin — the
+on/off latency delta is the documented cost of the plane.
+
 ``--smoke`` is the CI mode (<60 s): 2 replicas, sustained load, one
 planned kill; exits non-zero unless zero accepted requests were lost
 and the dead replica came back.  ``--smoke --scenario rollout`` is the
 rollout twin: breach -> auto-rollback -> zero accepted loss, v1 still
-serving.
+serving.  Smoke runs default ``--obs on`` and additionally assert that
+at least one stitched end-to-end trace was captured and that the phase
+table's p50 sum lands within 10% of the end-to-end p50.
 
     JAX_PLATFORMS=cpu python benchmarks/bench_load.py --smoke
     JAX_PLATFORMS=cpu python benchmarks/bench_load.py \
@@ -70,6 +87,7 @@ import multiprocessing as mp
 import os
 import random
 import sys
+import tempfile
 import threading
 import time
 
@@ -127,7 +145,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
     mean_burst = 1.0 / (1.0 - burst_p)
     base_event_rate = max(args_dict["rate_per_worker"] / mean_burst, 0.1)
 
-    records = []  # (t_rel, latency_ms, outcome, server_ms)
+    records = []  # (t_rel, latency_ms, outcome, server_ms, phases)
     sock = None
     start = time.monotonic()
     while True:
@@ -147,6 +165,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
             endpoint = rng.choices(endpoints, weights=weights)[0]
             t0 = time.monotonic()
             server_ms = None
+            phases = None
             try:
                 if sock is None:
                     sock = wire.connect(host, port, 5.0)
@@ -163,6 +182,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                 if reply.get("ok"):
                     outcome = "ok"
                     server_ms = reply.get("server_ms")
+                    phases = reply.get("phases")
                 else:
                     outcome = reply.get("error_class", "UnknownError")
             except Exception as exc:
@@ -173,10 +193,25 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                 except OSError:
                     pass
                 sock = None
-            latency_ms = (time.monotonic() - t0) * 1000.0
+            t1 = time.monotonic()
+            latency_ms = (t1 - t0) * 1000.0
+            if isinstance(phases, dict):
+                # the front door stamps absolute CLOCK_MONOTONIC times
+                # (system-wide on Linux, so comparable same-host): turn
+                # them into the client's own ingress/egress hops — the
+                # send/wakeup/decode time no server-side phase can see
+                phases = dict(phases)
+                t_route = phases.pop("t_route", None)
+                t_send = phases.pop("t_send", None)
+                if (isinstance(t_route, float)
+                        and 0.0 < t_route - t0 < 10.0):
+                    phases["ingress"] = (t_route - t0) * 1000.0
+                if (isinstance(t_send, float)
+                        and 0.0 < t1 - t_send < 10.0):
+                    phases["egress"] = (t1 - t_send) * 1000.0
             records.append((
                 round(t0 - start, 4), round(latency_ms, 3), outcome,
-                server_ms,
+                server_ms, phases,
             ))
     if sock is not None:
         try:
@@ -209,6 +244,72 @@ def _latency_stats(latencies):
         "p95": round(_quantile(vals, 0.95), 3),
         "p99": round(_quantile(vals, 0.99), 3),
         "max": round(vals[-1], 3),
+    }
+
+
+def _phase_table(ok_records):
+    """Per-phase latency decomposition over every reply that carried a
+    wire-stamped ``phases`` dict: p50/p95/p99 per phase, the
+    distribution of per-request phase *sums*, and ``coverage_p50`` —
+    the sum's p50 over the end-to-end p50, i.e. how much of the latency
+    the decomposition actually accounts for (the acceptance bar is
+    within 10%)."""
+    by_phase = {}
+    sums, lats = [], []
+    for rec in ok_records:
+        phases = rec[4]
+        if not isinstance(phases, dict) or not phases:
+            continue
+        total = 0.0
+        for name, val in phases.items():
+            # "t_"-prefixed keys are absolute stamps, not durations
+            if str(name).startswith("t_"):
+                continue
+            if isinstance(val, (int, float)):
+                by_phase.setdefault(str(name), []).append(float(val))
+                total += float(val)
+        sums.append(total)
+        lats.append(rec[1])
+    if not sums:
+        return {"requests_with_phases": 0}
+    sum_p50 = _quantile(sorted(sums), 0.50)
+    e2e_p50 = _quantile(sorted(lats), 0.50)
+    return {
+        "requests_with_phases": len(sums),
+        "per_phase_ms": {
+            name: _latency_stats(vals)
+            for name, vals in sorted(by_phase.items())
+        },
+        "sum_ms": _latency_stats(sums),
+        "coverage_p50": (
+            round(sum_p50 / e2e_p50, 4) if e2e_p50 else None
+        ),
+    }
+
+
+def _trace_summary(spans):
+    """Stitch check over the router-side sink: group spans by trace_id
+    and count the traces that contain BOTH the router's root span and a
+    replica-process serve span — end-to-end traces stitched across the
+    process boundary (the replica spans arrived piggybacked on reply
+    envelopes and were re-ingested router-side)."""
+    by_trace = {}
+    for span in spans:
+        by_trace.setdefault(span.get("trace_id"), set()).add(
+            span.get("name")
+        )
+    stitched = sum(
+        1 for names in by_trace.values()
+        if "router.request" in names and "replica.serve" in names
+    )
+    replica_spans = sum(
+        1 for s in spans if s.get("name") == "replica.serve"
+    )
+    return {
+        "spans": len(spans),
+        "replica_spans": replica_spans,
+        "traces": len(by_trace),
+        "stitched": stitched,
     }
 
 
@@ -281,6 +382,26 @@ def run(args):
     from sparkdl_tpu.serving.replica import ReplicaSpec
     from sparkdl_tpu.serving.supervisor import ReplicaSupervisor
 
+    obs_on = args.obs == "on"
+    router_sink = None
+    trace_path = args.trace_out
+    if obs_on:
+        from sparkdl_tpu.obs.export import JsonlTraceSink
+        from sparkdl_tpu.obs.trace import tracer
+
+        if trace_path is None:
+            fd, trace_path = tempfile.mkstemp(
+                prefix="bench_trace_", suffix=".jsonl"
+            )
+            os.close(fd)
+        router_sink = JsonlTraceSink(path=trace_path, capacity=50_000)
+        tracer.enable(router_sink)
+        # replicas arm through the zero-code env hook (inherited at
+        # spawn); their local JSONL is a side artifact — the spans the
+        # report asserts on are the ones shipped back inside reply
+        # envelopes and ingested into the ROUTER-side sink above
+        os.environ["SPARKDL_TRACE_OUT"] = trace_path + ".replica"
+
     factory = (
         "sparkdl_tpu.serving.replica:demo_server"
         if args.compile else
@@ -322,6 +443,7 @@ def run(args):
         "autoscale": None,
         "fault_plan": fault_plans[0] if fault_plans else None,
         "seed": args.seed,
+        "obs": obs_on,
     }
     try:
         if not supervisor.wait_live(args.replicas, args.spawn_timeout_s):
@@ -332,18 +454,29 @@ def run(args):
             h.slot: h.warmup for h in supervisor.handles()
         }
         front_port = supervisor.router.serve()
-        if args.autoscale or args.scenario == "rollout":
+        if args.autoscale or args.scenario == "rollout" or obs_on:
             extra_slos = None
             if args.scenario == "rollout":
                 # the canary pair: tight windows so a bad v2 pages
                 # within seconds of its first weighted traffic
                 from sparkdl_tpu.obs.slo import rollout_slos
 
-                extra_slos = rollout_slos(
+                extra_slos = list(rollout_slos(
                     "v2",
                     latency_threshold_ms=args.rollout_slo_ms,
                     fast_window_s=3.0, slow_window_s=10.0,
-                )
+                ))
+                if obs_on:
+                    # the federated pair: the canary pages on its OWN
+                    # fleet.version.v2.* series, scraped at the replica
+                    # — the view router-side retries cannot mask
+                    from sparkdl_tpu.obs.slo import fleet_rollout_slos
+
+                    extra_slos += list(fleet_rollout_slos(
+                        "v2",
+                        latency_threshold_ms=args.rollout_slo_ms,
+                        fast_window_s=3.0, slow_window_s=10.0,
+                    ))
             supervisor.start_telemetry(
                 sample_interval_s=0.25 if args.scenario == "rollout"
                 else 0.5,
@@ -351,6 +484,8 @@ def run(args):
                 latency_threshold_ms=args.slo_p99_ms,
                 fast_window_s=5.0, slow_window_s=30.0,
                 extra_slos=extra_slos,
+                federate=obs_on,
+                fleet_interval_s=0.5,
             )
         if args.autoscale:
             from sparkdl_tpu.serving.autoscale import Autoscaler
@@ -528,6 +663,7 @@ def run(args):
             "latency_ms": _latency_stats([r[1] for r in ok]),
             "server_ms": _latency_stats(server_vals),
             "router_overhead_ms": _latency_stats(overhead_vals),
+            "phases_ms": _phase_table(ok),
             "wire": {
                 "breakdown": breakdown,
                 "total_s": round(wire_total_s, 4),
@@ -564,6 +700,31 @@ def run(args):
                 },
             },
         })
+        if obs_on:
+            fleet = supervisor.fleet_collector
+            fleet_snap = None
+            if fleet is not None:
+                snap = fleet.snapshot()
+                fleet_snap = {
+                    "healthy": snap["healthy"],
+                    "total": snap["total"],
+                    "targets": {
+                        name: {
+                            "version": row.get("version"),
+                            "ok": row.get("ok"),
+                            "error": row.get("error"),
+                            "federated_metrics":
+                                len(row.get("metrics") or {}),
+                        }
+                        for name, row in snap["targets"].items()
+                    },
+                }
+            report["trace"] = dict(
+                _trace_summary(router_sink.spans()),
+                out=trace_path,
+            )
+            report["fleet"] = fleet_snap
+            router_sink.flush()
         if rollout_report is not None:
             report["rollout"] = rollout_report
         if autoscaler is not None:
@@ -578,6 +739,43 @@ def run(args):
             autoscaler.close()
         supervisor.close()
     return report
+
+
+def _print_fleet_on_fail(report):
+    """On smoke failure, dump the federated fleet view (the
+    ``/debug/fleet`` snapshot captured at run end) so CI logs show
+    per-replica scrape state next to the failure — ``ci/fault-suite.sh``
+    greps this marker."""
+    fleet = report.get("fleet")
+    if fleet is not None:
+        print("FLEET SNAPSHOT: " + json.dumps(fleet, default=str),
+              file=sys.stderr)
+
+
+def _obs_problems(report):
+    """Smoke assertions for the observability plane (``--obs on``):
+    at least one stitched end-to-end trace, a phase table whose p50 sum
+    lands within 10% of the end-to-end p50, and a healthy federation
+    target set."""
+    problems = []
+    trace = report.get("trace") or {}
+    if trace.get("stitched", 0) < 1:
+        problems.append(
+            f"no stitched end-to-end trace captured (trace={trace})"
+        )
+    phases = report.get("phases_ms") or {}
+    cov = phases.get("coverage_p50")
+    if cov is None:
+        problems.append("no reply carried a phases breakdown")
+    elif not 0.9 <= cov <= 1.1:
+        problems.append(
+            f"phase-sum p50 covers {cov:.0%} of e2e p50 "
+            "(want within 10%)"
+        )
+    fleet = report.get("fleet") or {}
+    if not fleet.get("healthy"):
+        problems.append(f"no healthy federation target (fleet={fleet})")
+    return problems
 
 
 def main():
@@ -632,6 +830,16 @@ def main():
     ap.add_argument("--rollout-slo-ms", type=float, default=50.0,
                     help="rollout scenario: canary p99 threshold "
                     "(rollout.v2.latency SLO)")
+    ap.add_argument("--obs", default="auto",
+                    choices=["auto", "on", "off"],
+                    help="fleet observability plane for the run: "
+                    "cross-process tracing (router + replicas, stitched "
+                    "traces in --trace-out) and supervisor metrics "
+                    "federation; auto = on for --smoke, off otherwise "
+                    "(off is the overhead baseline)")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="router-side stitched-trace JSONL (default: a "
+                    "temp file; replicas append to PATH.replica)")
     ap.add_argument("--slo-p99-ms", type=float, default=250.0)
     ap.add_argument("--spawn-timeout-s", type=float, default=120.0)
     ap.add_argument("--seed", type=int, default=0)
@@ -642,6 +850,9 @@ def main():
                     "accepted-request loss + recovery, exit non-zero "
                     "on violation")
     args = ap.parse_args()
+
+    if args.obs == "auto":
+        args.obs = "on" if args.smoke else "off"
 
     if args.smoke and args.scenario == "rollout":
         # CI rollout smoke (<60 s): 1+1 replicas, injected v2
@@ -724,9 +935,12 @@ def main():
             )
         if report["ok"] == 0:
             problems.append("no successful requests at all")
+        if args.obs == "on":
+            problems.extend(_obs_problems(report))
         if problems:
             print("ROLLOUT SMOKE FAIL: " + "; ".join(problems),
                   file=sys.stderr)
+            _print_fleet_on_fail(report)
             return 1
         print(
             "ROLLOUT SMOKE PASS: "
@@ -749,8 +963,11 @@ def main():
             problems.append("killed replica never came back")
         if report["ok"] == 0:
             problems.append("no successful requests at all")
+        if args.obs == "on":
+            problems.extend(_obs_problems(report))
         if problems:
             print("SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
+            _print_fleet_on_fail(report)
             return 1
         print(
             "SMOKE PASS: "

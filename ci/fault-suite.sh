@@ -41,6 +41,23 @@ if ! python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
   exit 1
 fi
 
+# On smoke failure bench_load prints one "FLEET SNAPSHOT: {...}" line —
+# the supervisor's federated per-replica/per-version view at the moment
+# of failure (ISSUE-13).  Capture each smoke's output so the snapshot
+# can be pretty-printed next to the failure banner instead of scrolling
+# away in the load-loop noise.
+SMOKE_LOG="$(mktemp -t fault-suite-smoke.XXXXXX.log)"
+trap 'rm -rf "$TRACE_OUT" "$BLACKBOX_DIR" "$SMOKE_LOG"' EXIT
+print_fleet_snapshot() {
+  local line
+  line="$(grep -a 'FLEET SNAPSHOT: ' "$SMOKE_LOG" | tail -n 1 | sed 's/.*FLEET SNAPSHOT: //')" || true
+  if [ -n "$line" ]; then
+    echo "--- federated fleet snapshot at failure (/debug/fleet view) ---" >&2
+    printf '%s\n' "$line" | python -m json.tool >&2 2>/dev/null \
+      || printf '%s\n' "$line" >&2
+  fi
+}
+
 # replica-kill smoke (<60 s total, ISSUE-10/11): 2 replica processes
 # under sustained load, a FaultPlan SIGKILL-equivalent takes one out
 # mid-request, and the harness itself asserts zero accepted-request
@@ -53,17 +70,19 @@ fi
 # every backend on tcp even though shm was requested.
 for lane in tcp shm; do
   if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
-      --transport "$lane" --assert-lane "$lane"; then
+      --transport "$lane" --assert-lane "$lane" 2>&1 | tee "$SMOKE_LOG"; then
     echo "replica-kill smoke FAILED on the $lane lane (accepted-request" >&2
     echo "loss, no recovery, wrong lane, or >60s wall — see above)" >&2
+    print_fleet_snapshot
     exit 1
   fi
 done
 if ! timeout -k 10 60 env SPARKDL_WIRE_SHM_DISABLE=1 \
     python benchmarks/bench_load.py --smoke \
-    --transport shm --assert-lane tcp; then
+    --transport shm --assert-lane tcp 2>&1 | tee "$SMOKE_LOG"; then
   echo "shm->tcp fallback smoke FAILED: with shm disabled on the" >&2
   echo "replicas, a shm-mode router must still serve on tcp" >&2
+  print_fleet_snapshot
   exit 1
 fi
 
@@ -74,10 +93,11 @@ fi
 # with the v1 fleet still serving at the end (plus bounded
 # breach-detection latency).  --smoke exits non-zero on any violation.
 if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
-    --scenario rollout; then
+    --scenario rollout 2>&1 | tee "$SMOKE_LOG"; then
   echo "rollout smoke FAILED: canary breach did not auto-roll-back" >&2
   echo "cleanly (accepted-request loss, no rollback, v1 gone, or" >&2
   echo ">60s wall — see above)" >&2
+  print_fleet_snapshot
   exit 1
 fi
 
@@ -85,7 +105,7 @@ fi
 # parse per file, all nine rules); on failure print the JSON report so
 # CI logs carry the machine-readable findings, not just the exit code
 CHECK_REPORT="$(mktemp -t fault-suite-check.XXXXXX.json)"
-trap 'rm -rf "$TRACE_OUT" "$BLACKBOX_DIR" "$CHECK_REPORT"' EXIT
+trap 'rm -rf "$TRACE_OUT" "$BLACKBOX_DIR" "$SMOKE_LOG" "$CHECK_REPORT"' EXIT
 if ! ci/check.sh "$CHECK_REPORT"; then
   echo "--- sparkdl_check JSON report ---" >&2
   cat "$CHECK_REPORT" >&2 || true

@@ -13,4 +13,5 @@ from ci.sparkdl_check.rules import (  # noqa: F401
     recompile_hazard,
     resource_lifecycle,
     sleep_retry,
+    wire_envelope,
 )

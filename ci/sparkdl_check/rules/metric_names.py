@@ -24,11 +24,15 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: replica supervisor; "wire" (frame codec + transport lanes) with the
 #: ISSUE-11 zero-copy data plane; "rollout" (blue/green shift state)
 #: and "tenant" (per-tenant fair-share admission) with the ISSUE-12
-#: zero-downtime fleet.
+#: zero-downtime fleet; "fleet" (supervisor-side metrics federation —
+#: scrape health plus the ``fleet.replica.*`` / ``fleet.version.*``
+#: federated series) with the ISSUE-13 fleet observability plane
+#: (``router.phase.*`` latency-decomposition histograms ride the
+#: existing "router" prefix).
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
     "streaming", "slo", "ts", "supervisor", "router", "wire",
-    "rollout", "tenant",
+    "rollout", "tenant", "fleet",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

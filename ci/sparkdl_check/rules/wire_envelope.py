@@ -1,0 +1,162 @@
+"""``wire-envelope`` — every wire-envelope field is schema-declared and
+fixture-tested.
+
+The SDW2 envelope is a *cross-process* contract: the router, the
+transport lanes (TCP, shm ring, spill), and the replica all pickle and
+unpickle the same dict, and a field one side starts emitting that the
+other side's fixtures never exercised is exactly how a rolling deploy
+breaks mid-flight (old replica, new router).  The schema lives in ONE
+place — ``serving/wire.py``'s ``ENVELOPE_FIELDS`` frozenset — and the
+roundtrip fixtures in ``tests/test_wire.py`` are the executable form of
+that contract.
+
+This rule closes the loop statically, at every envelope *construction*
+site in the serving data plane (``serving/wire.py`` / ``transport.py``
+/ ``router.py`` / ``replica.py``):
+
+- a dict literal carrying an ``"op"`` or ``"ok"`` key IS an envelope —
+  every constant string key in it must appear in ``ENVELOPE_FIELDS``;
+- a subscript assignment onto the conventional envelope variables
+  (``msg[...] = ...`` / ``reply[...] = ...``) adds a field after
+  construction — same requirement;
+- either way, the field must appear *quoted* somewhere in
+  ``tests/test_wire.py`` — no fixture, no field.
+
+When the scanned tree carries no ``serving/wire.py`` schema or no
+``tests/test_wire.py``, the corresponding half of the check is skipped
+(single-file scans stay usable); the real tree always has both.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+
+#: the files that construct wire envelopes (package-relative)
+ENVELOPE_FILES = frozenset({
+    "serving/wire.py", "serving/transport.py",
+    "serving/router.py", "serving/replica.py",
+})
+
+#: a dict literal with one of these keys is treated as an envelope
+SENTINEL_KEYS = frozenset({"op", "ok"})
+
+#: subscript-assignment targets that hold an envelope by convention
+ENVELOPE_VARS = frozenset({"msg", "reply"})
+
+SCHEMA_FILE = "serving/wire.py"
+SCHEMA_NAME = "ENVELOPE_FIELDS"
+FIXTURE_FILE = "test_wire.py"
+
+
+def _extract_schema(tree: ast.Module) -> Optional[Set[str]]:
+    """The string members of ``ENVELOPE_FIELDS = frozenset({...})``."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == SCHEMA_NAME
+                   for t in node.targets):
+            continue
+        value = node.value
+        if (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "frozenset" and value.args):
+            value = value.args[0]
+        if isinstance(value, (ast.Set, ast.List, ast.Tuple)):
+            return {
+                el.value for el in value.elts
+                if isinstance(el, ast.Constant)
+                and isinstance(el.value, str)
+            }
+    return None
+
+
+def _envelope_keys(tree: ast.Module) -> List[Tuple[str, ast.AST]]:
+    """(field, node) for every envelope field this file introduces."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            keys = [
+                k.value for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            ]
+            if not SENTINEL_KEYS & set(keys):
+                continue
+            out.extend((k, node) for k in keys)
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if not (isinstance(target, ast.Subscript)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id in ENVELOPE_VARS):
+                    continue
+                idx = target.slice
+                if (isinstance(idx, ast.Constant)
+                        and isinstance(idx.value, str)):
+                    out.append((idx.value, target))
+    return out
+
+
+@rule
+class WireEnvelopeRule(Rule):
+    id = "wire-envelope"
+    severity = "error"
+    doc = ("wire-envelope fields are declared in wire.ENVELOPE_FIELDS "
+           "and exercised by tests/test_wire.py roundtrip fixtures")
+    #: reads the schema from another file and the tests tree — per-file
+    #: results depend on state the cache digest does not fully cover
+    cacheable = False
+
+    def applies(self, relpath: str) -> bool:
+        return relpath in ENVELOPE_FILES
+
+    def _schema(self) -> Optional[Set[str]]:
+        if self.project is None:
+            return None
+        ctx = self.project.files.get(SCHEMA_FILE)
+        if ctx is None:
+            return None
+        return _extract_schema(ctx.tree)
+
+    def _fixture_source(self) -> Optional[str]:
+        if self.project is None:
+            return None
+        blobs = [
+            src for name, src in self.project.test_sources()
+            if name == FIXTURE_FILE
+        ]
+        return "\n".join(blobs) if blobs else None
+
+    def check(self, ctx: FileContext) -> Iterable:
+        schema = self._schema()
+        fixtures = self._fixture_source()
+        if schema is None and fixtures is None:
+            return []
+        findings = []
+        seen: Set[Tuple[str, int]] = set()
+        for key, node in _envelope_keys(ctx.tree):
+            mark = (key, getattr(node, "lineno", 0))
+            if mark in seen:
+                continue
+            seen.add(mark)
+            if schema is not None and key not in schema:
+                findings.append(self.finding(
+                    ctx, node,
+                    f"envelope field {key!r} is not declared in "
+                    f"wire.{SCHEMA_NAME} — the wire schema is a "
+                    "cross-process contract; declare the field (and add "
+                    f"a roundtrip fixture in tests/{FIXTURE_FILE})",
+                ))
+                continue
+            if fixtures is not None and (
+                    f'"{key}"' not in fixtures
+                    and f"'{key}'" not in fixtures):
+                findings.append(self.finding(
+                    ctx, node,
+                    f"envelope field {key!r} has no roundtrip fixture in "
+                    f"tests/{FIXTURE_FILE} — a field no fixture "
+                    "round-trips is one rolling deploy away from a "
+                    "mid-flight decode break",
+                ))
+        return findings

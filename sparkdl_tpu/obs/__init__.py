@@ -33,6 +33,14 @@ PR 8 grows the passive layer into a **telemetry plane**:
   (``SPARKDL_BLACKBOX_DIR``) that turns silent wedges into post-mortem
   dumps.
 
+PR 13 makes the plane **fleet-wide**: spans carry ``(trace_id,
+span_id)`` across the wire envelope (one stitched trace per request,
+router through replica), and :mod:`fleet` —
+:class:`FleetCollector` — federates every replica's registry into the
+supervisor's recorder as labeled ``fleet.*`` series, so SLOs, the
+autoscaler and rollout bake decisions read replica-attributed data
+(:func:`~sparkdl_tpu.obs.slo.fleet_rollout_slos`).
+
 Disabled by default: every instrumentation site costs one branch until
 ``tracer.enable(...)`` (or the ``SPARKDL_TRACE_OUT`` env var — the
 zero-code hook ``ci/fault-suite.sh`` and subprocess workers use).
@@ -47,12 +55,14 @@ only through lazy cold-path imports in ``policy``/``watchdog``
 
 from sparkdl_tpu.obs.blackbox import FlightRecorder
 from sparkdl_tpu.obs.export import JsonlTraceSink, prometheus_text
+from sparkdl_tpu.obs.fleet import FleetCollector
 from sparkdl_tpu.obs.hooks import FitProfiler, fit_profiler
 from sparkdl_tpu.obs.server import ObsServer
 from sparkdl_tpu.obs.slo import (
     SLO,
     SLOEngine,
     availability_slo,
+    fleet_rollout_slos,
     serving_slos,
     streaming_slos,
 )
@@ -109,6 +119,7 @@ __all__ = [
     "ENV_SLOW_MS",
     "ENV_VAR",
     "FitProfiler",
+    "FleetCollector",
     "FlightRecorder",
     "JsonlTraceSink",
     "ObsServer",
@@ -121,6 +132,7 @@ __all__ = [
     "current_span",
     "enable_from_env",
     "fit_profiler",
+    "fleet_rollout_slos",
     "prometheus_text",
     "record_event",
     "serving_slos",

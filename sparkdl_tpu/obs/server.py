@@ -5,7 +5,13 @@ serves the process's existing telemetry over HTTP:
 
 ====================  ====================================================
 ``/metrics``          Prometheus text exposition
-                      (:func:`~sparkdl_tpu.obs.export.prometheus_text`)
+                      (:func:`~sparkdl_tpu.obs.export.prometheus_text`);
+                      with a fleet collector attached, replica series
+                      follow with ``replica``/``version`` labels — the
+                      federated view
+``/metrics.json``     the registry's flat snapshot as JSON — what the
+                      :class:`~sparkdl_tpu.obs.fleet.FleetCollector`
+                      scrapes (machine-mergeable, no exposition parsing)
 ``/healthz``          JSON health: the wired health callable (e.g.
                       ``ModelServer.status()``) + the worst SLO state;
                       **200** while healthy, **503** when not — the
@@ -16,6 +22,9 @@ serves the process's existing telemetry over HTTP:
                       :class:`~sparkdl_tpu.obs.export.JsonlTraceSink`
 ``/debug/threads``    all-thread stack dump (``sys._current_frames``)
 ``/debug/timeseries`` :meth:`TimeSeriesRecorder.snapshot`
+``/debug/fleet``      :meth:`FleetCollector.snapshot` — per-replica
+                      scrape state (who answered, who is failing, with
+                      what) on the supervisor
 ====================  ====================================================
 
 Design rules:
@@ -91,6 +100,7 @@ class ObsServer:
         slo_engine=None,
         span_sink=None,
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        fleet=None,
     ):
         self.host = host
         self._requested_port = int(port)
@@ -100,6 +110,7 @@ class ObsServer:
         self._slo_engine = slo_engine
         self._span_sink = span_sink
         self._health_fn = health_fn
+        self._fleet = fleet
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -112,6 +123,7 @@ class ObsServer:
         slo_engine=None,
         span_sink=None,
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        fleet=None,
     ) -> "ObsServer":
         """Wire components after construction (each is optional; a
         later attach replaces an earlier one for that slot)."""
@@ -124,6 +136,8 @@ class ObsServer:
                 self._span_sink = span_sink
             if health_fn is not None:
                 self._health_fn = health_fn
+            if fleet is not None:
+                self._fleet = fleet
         return self
 
     # ------------------------------------------------------------------
@@ -158,6 +172,7 @@ class ObsServer:
             recorder = self._recorder
             engine = self._slo_engine
             sink = self._span_sink
+            fleet = self._fleet
 
         def jdump(status: int, obj: Any):
             body = json.dumps(obj, indent=2, default=str).encode()
@@ -166,15 +181,22 @@ class ObsServer:
         if path in ("/", "/index"):
             return jdump(200, {
                 "endpoints": [
-                    "/metrics", "/healthz", "/slo", "/debug/spans",
-                    "/debug/threads", "/debug/timeseries",
+                    "/metrics", "/metrics.json", "/healthz", "/slo",
+                    "/debug/spans", "/debug/threads", "/debug/timeseries",
+                    "/debug/fleet",
                 ],
             })
         if path == "/metrics":
             from sparkdl_tpu.obs.export import prometheus_text
 
             text = prometheus_text(self._registry)
+            if fleet is not None:
+                # federation: every replica's latest scrape, labeled —
+                # one scrape of the supervisor sees the whole fleet
+                text += fleet.prometheus_block()
             return 200, "text/plain; version=0.0.4", text.encode()
+        if path == "/metrics.json":
+            return jdump(200, self._registry.snapshot())
         if path == "/healthz":
             payload = self._health_payload()
             return jdump(200 if payload["healthy"] else 503, payload)
@@ -198,6 +220,10 @@ class ObsServer:
                 return jdump(404, {"error": "no time-series recorder "
                                             "attached"})
             return jdump(200, {"series": recorder.snapshot()})
+        if path == "/debug/fleet":
+            if fleet is None:
+                return jdump(404, {"error": "no fleet collector attached"})
+            return jdump(200, fleet.snapshot())
         return jdump(404, {"error": f"unknown path {path!r}"})
 
     # ------------------------------------------------------------------

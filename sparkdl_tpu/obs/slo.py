@@ -463,6 +463,59 @@ def rollout_slos(
     ]
 
 
+def fleet_rollout_slos(
+    version: str,
+    latency_quantile: str = "p99",
+    latency_threshold_ms: float = 250.0,
+    latency_objective: float = 0.95,
+    error_objective: float = 0.99,
+    **overrides,
+) -> List[SLO]:
+    """The *replica-attributed* canary pair: objectives over the
+    federated ``fleet.version.<version>.serving.*`` series a
+    :class:`~sparkdl_tpu.obs.fleet.FleetCollector` scrapes from the
+    canary replicas themselves.  The router-side :func:`rollout_slos`
+    measures attempts *the router saw complete* — its retry loop
+    re-places a failing canary's requests on healthy replicas, so the
+    router-side error series can stay clean while the canary burns.
+    These objectives read the canary's own registry (queue depth spikes,
+    replica-side errors, forward-path latency), so the rollout
+    controller pages on what the canary *experienced*, not on what the
+    router salvaged.  Names carry the ``fleet.rollout.<version>.``
+    prefix, which the controller's default watch list also matches."""
+    ver = _router_label(version)
+    return [
+        SLO(
+            name=f"fleet.rollout.{ver}.latency",
+            kind="threshold",
+            series=(
+                f"fleet.version.{ver}.serving.latency_ms"
+                f".{latency_quantile}"
+            ),
+            threshold=latency_threshold_ms,
+            objective=latency_objective,
+            description=(
+                f"replica-side {latency_quantile} latency of version "
+                f"{version!r} under {latency_threshold_ms:g} ms "
+                "(federated)"
+            ),
+            **overrides,
+        ),
+        SLO(
+            name=f"fleet.rollout.{ver}.errors",
+            kind="error_rate",
+            numerator=f"fleet.version.{ver}.serving.errors",
+            denominator=f"fleet.version.{ver}.serving.requests",
+            objective=error_objective,
+            description=(
+                f"replica-side success rate of version {version!r} "
+                "(federated)"
+            ),
+            **overrides,
+        ),
+    ]
+
+
 def tenant_slos(
     tenant: str,
     latency_quantile: str = "p99",

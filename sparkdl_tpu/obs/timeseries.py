@@ -124,6 +124,27 @@ class TimeSeriesRecorder:
         self._m_samples.add(1)
         return updated
 
+    def record(self, name: str, value: float,
+               now: Optional[float] = None) -> bool:
+        """Inject one point into series ``name`` directly — the seam the
+        fleet collector uses to merge *scraped* replica metrics into the
+        same store the SLO engine queries (they never appear in this
+        process's registry snapshot).  Subject to the same series cap
+        and per-series ring as sampled points; returns False when the
+        series cap drops the point."""
+        t = self._clock() if now is None else float(now)
+        with self._lock:
+            ring = self._series.get(name)
+            if ring is None:
+                if len(self._series) >= self.max_series:
+                    self._m_dropped.add(1)
+                    return False
+                ring = deque(maxlen=self.max_points)
+                self._series[name] = ring
+                self._m_active.set(len(self._series))
+            ring.append((t, float(value)))
+        return True
+
     def start(self) -> "TimeSeriesRecorder":
         """Launch the background sampling thread (idempotent)."""
         with self._lock:

@@ -37,16 +37,58 @@ Design rules:
 
 from __future__ import annotations
 
-import itertools
+import os
+import random
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-#: process-wide id streams (span ids are unique per process; trace ids
-#: group one root span with all its descendants)
-_span_ids = itertools.count(1)
-_trace_ids = itertools.count(1)
+ENV_SEED = "SPARKDL_TRACE_SEED"
+
+#: a remote span reference carried over the wire: ``(trace_id, span_id)``
+RemoteParent = Tuple[int, int]
+
+
+class _IdSource:
+    """Process-seeded random 64-bit span/trace ids.
+
+    Sequential per-process counters collide the moment traces are
+    stitched across processes (every replica starts at 1), so ids come
+    from a per-process ``random.Random``: seeded from ``os.urandom``
+    normally, or — under ``SPARKDL_TRACE_SEED`` — deterministically from
+    the seed mixed with ``os.getpid()``, so tests get reproducible ids
+    per process while two replicas under the same seed still cannot
+    collide.  The pid is re-checked on every draw: a fork gets a fresh
+    stream instead of replaying the parent's.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rng: Optional[random.Random] = None
+        self._pid: Optional[int] = None
+
+    def _reseed(self, pid: int) -> random.Random:
+        seed_spec = os.environ.get(ENV_SEED, "").strip()
+        if seed_spec:
+            rng = random.Random(f"{seed_spec}:{pid}")
+        else:
+            rng = random.Random(int.from_bytes(os.urandom(8), "big") ^ pid)
+        self._rng = rng
+        self._pid = pid
+        return rng
+
+    def next_id(self) -> int:
+        """A nonzero random 63-bit id (always positive, JSON-safe)."""
+        pid = os.getpid()
+        with self._lock:
+            rng = self._rng
+            if rng is None or pid != self._pid:
+                rng = self._reseed(pid)
+            return rng.getrandbits(63) | 1
+
+
+_ids = _IdSource()
 
 
 class Span:
@@ -64,15 +106,21 @@ class Span:
     )
 
     def __init__(self, tracer: "Tracer", name: str,
-                 parent: Optional["Span"], attributes: Dict[str, Any]):
+                 parent: Optional["Span"], attributes: Dict[str, Any],
+                 remote: Optional[RemoteParent] = None):
         self._tracer = tracer
         self.name = name
-        self.span_id = next(_span_ids)
+        self.span_id = _ids.next_id()
         if parent is not None:
             self.trace_id = parent.trace_id
             self.parent_id = parent.span_id
+        elif remote is not None:
+            # a parent in another process: its (trace_id, span_id) rode
+            # the wire envelope — this span joins that trace
+            self.trace_id = int(remote[0])
+            self.parent_id = int(remote[1])
         else:
-            self.trace_id = next(_trace_ids)
+            self.trace_id = _ids.next_id()
             self.parent_id = None
         self.attributes = dict(attributes)
         self.events: List[Dict[str, Any]] = []
@@ -82,6 +130,12 @@ class Span:
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------------
+    def context(self) -> RemoteParent:
+        """The wire form of this span: ``(trace_id, span_id)`` — what a
+        client injects into the envelope so the remote side can open a
+        child with ``start_span(..., remote=...)``."""
+        return (self.trace_id, self.span_id)
+
     def set_attribute(self, key: str, value: Any) -> None:
         with self._lock:
             self.attributes[key] = value
@@ -275,17 +329,35 @@ class Tracer:
         finally:
             self._current.reset(token)
 
+    # -- cross-process stitching ---------------------------------------
+    def ingest(self, span_dict: Dict[str, Any]) -> None:
+        """Deliver an already-finished FOREIGN span dict straight to the
+        sinks — the router calls this with replica spans piggybacked on
+        a reply envelope.  No re-sampling: the emitting process already
+        applied its tail-aware policy, and re-flipping the coin here
+        could orphan a trace the replica chose to keep."""
+        if not self.enabled:
+            return
+        for sink in self._sinks:
+            try:
+                sink(dict(span_dict))
+            except Exception:  # pragma: no cover - a sink must not
+                pass           # break the ingest path
+
     # -- span creation -------------------------------------------------
     def start_span(self, name: str, parent: Optional[Span] = None,
+                   remote: Optional[RemoteParent] = None,
                    **attributes: Any) -> Optional[Span]:
         """A manually-ended span (serving request spans end from a
         future callback, not a ``with`` block).  Child of ``parent``
-        (explicit) or of the current span; None when disabled."""
+        (explicit), else of ``remote`` (a ``(trace_id, span_id)`` pair
+        from another process's envelope), else of the current span;
+        None when disabled."""
         if not self.enabled:
             return None
-        if parent is None:
+        if parent is None and remote is None:
             parent = self._current.get()
-        return Span(self, name, parent, attributes)
+        return Span(self, name, parent, attributes, remote=remote)
 
     @contextmanager
     def span(self, name: str, parent: Optional[Span] = None,

@@ -345,10 +345,10 @@ class MicroBatcher:
                 return np.asarray(self._forward(x))
 
             if not tracer.enabled:
-                self._forward_batch(live, bucket, forward_once)
+                self._forward_batch(live, bucket, forward_once, now)
                 return
             with self._batch_span(live, bucket) as bspan:  # noqa: F841
-                self._forward_batch(live, bucket, forward_once)
+                self._forward_batch(live, bucket, forward_once, now)
             return
 
         # compiled path: dispatch through the engine program now; the
@@ -403,8 +403,9 @@ class MicroBatcher:
             self._m_errors.add(len(live))
             self._fail_batch(live, bspan, e, record=True)
             return
+        t_dispatched = time.monotonic()
         for host, meta in self._window.submit(
-            out_dev, meta=(live, bucket, bspan)
+            out_dev, meta=(live, bucket, bspan, now, t_dispatched)
         ):
             self._complete(host, meta)
 
@@ -450,7 +451,7 @@ class MicroBatcher:
 
     def _complete(self, host, meta) -> None:
         """Resolve one batch that fell out of the dispatch window."""
-        live, bucket, bspan = meta
+        live, bucket, bspan, t_batch, t_dispatched = meta
         if isinstance(host, FetchFailure):
             metrics.counter("serving.errors").add(1)
             self._m_errors.add(len(live))
@@ -460,6 +461,15 @@ class MicroBatcher:
         done = time.monotonic()
         latency = metrics.histogram("serving.latency_ms")
         for i, r in enumerate(live):
+            # the phase decomposition rides the future (set BEFORE the
+            # result so a reader woken by set_result always sees it):
+            # queue wait, device dispatch, device->host fetch — what the
+            # replica stamps into the reply envelope's "phases"
+            r.future.sparkdl_phases = {
+                "replica_queue": (t_batch - r.enqueued_at) * 1000.0,
+                "forward": (t_dispatched - t_batch) * 1000.0,
+                "fetch": (done - t_dispatched) * 1000.0,
+            }
             r.future.set_result(host[i])
             ms = (done - r.enqueued_at) * 1000.0
             latency.observe(ms)
@@ -471,7 +481,7 @@ class MicroBatcher:
         if bspan is not None:
             bspan.end()
 
-    def _forward_batch(self, live, bucket, forward_once) -> None:
+    def _forward_batch(self, live, bucket, forward_once, t_batch) -> None:
         try:
             # breaker first: while open, fail the batch fast with the
             # typed (transient) CircuitOpen instead of hammering a dead
@@ -508,6 +518,12 @@ class MicroBatcher:
         done = time.monotonic()
         latency = metrics.histogram("serving.latency_ms")
         for i, r in enumerate(live):
+            # synchronous path: forward and fetch are one region
+            r.future.sparkdl_phases = {
+                "replica_queue": (t_batch - r.enqueued_at) * 1000.0,
+                "forward": (done - t_batch) * 1000.0,
+                "fetch": 0.0,
+            }
             r.future.set_result(out[i])
             ms = (done - r.enqueued_at) * 1000.0
             latency.observe(ms)

@@ -50,6 +50,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.serving import transport as transport_mod
 from sparkdl_tpu.serving import wire
@@ -190,6 +191,45 @@ def demo_server_slow(endpoints: int = 3):
     return server
 
 
+class _SpanHarvest:
+    """Tracer sink buffering this process's finished spans by trace_id
+    so a reply envelope can carry its own trace's spans back to the
+    router (where they are stitched into the router-side sink).
+
+    Bounded both ways — at most ``MAX_TRACES`` trace buckets (oldest
+    evicted first: a trace whose reply never ships, e.g. a connection
+    that died mid-request, must not leak) and ``MAX_SPANS_PER_TRACE``
+    spans per bucket.  Only spans that survived the tracer's tail-aware
+    sampling reach any sink, so the piggyback inherits the same policy:
+    a dropped trace ships no spans, a kept trace ships whole."""
+
+    MAX_TRACES = 256
+    MAX_SPANS_PER_TRACE = 16
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_trace: "Dict[int, list]" = {}
+
+    def __call__(self, span_dict: Dict[str, Any]) -> None:
+        tid = span_dict.get("trace_id")
+        if tid is None:
+            return
+        with self._lock:
+            bucket = self._by_trace.get(tid)
+            if bucket is None:
+                if len(self._by_trace) >= self.MAX_TRACES:
+                    # dicts iterate in insertion order: drop the oldest
+                    self._by_trace.pop(next(iter(self._by_trace)))
+                bucket = self._by_trace[tid] = []
+            if len(bucket) < self.MAX_SPANS_PER_TRACE:
+                bucket.append(span_dict)
+
+    def take(self, trace_id: int) -> list:
+        """Pop (and return) every buffered span of one trace."""
+        with self._lock:
+            return self._by_trace.pop(trace_id, [])
+
+
 class ReplicaService:
     """Serve a :class:`ModelServer` over the wire protocol.
 
@@ -226,6 +266,10 @@ class ReplicaService:
         self._draining = False
         self._m_requests = metrics.counter("supervisor.replica_requests")
         self._m_inflight = metrics.gauge("supervisor.replica_inflight")
+        # harvest this process's finished spans per trace so replies can
+        # piggyback them back to the router for cross-process stitching
+        self._harvest = _SpanHarvest()
+        tracer.add_sink(self._harvest)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -283,7 +327,7 @@ class ReplicaService:
         staged = self._submit(msg)
         if staged[0] == "reply":
             return staged[1]
-        return self._finish(staged[1], staged[2])
+        return self._finish(staged[1], staged[2], staged[3])
 
     def _handle_batch(
         self, msgs: list
@@ -304,22 +348,24 @@ class ReplicaService:
                 replies.append(item[1])
                 continue
             try:
-                replies.append(self._finish(item[1], item[2]))
+                replies.append(self._finish(item[1], item[2], item[3]))
             except Exception as exc:
                 replies.append(wire.encode_error(exc))
         return replies
 
     def _submit(self, msg: Dict[str, Any]):
         """Admit + submit one request; returns ``("reply", dict)`` for
-        control ops or ``("future", fut, t0)`` for inference."""
+        control ops or ``("future", fut, t0, span)`` for inference."""
         op = msg.get("op")
         if op == "ping":
             return ("reply", {"ok": True, "pid": os.getpid(),
                               "draining": self.draining})
         if op != "infer":
             raise ValueError(f"unknown wire op {op!r}")
+        span = self._serve_span(msg)
         with self._lock:
             if self._draining:
+                self._end_span(span, ReplicaDraining)
                 raise ReplicaDraining(
                     f"replica pid={os.getpid()} is draining"
                 )
@@ -329,28 +375,73 @@ class ReplicaService:
         try:
             inject.fire("supervisor.replica_serve")
             self._m_requests.add(1)
-            fut = self._server.submit(
-                msg["value"],
-                model_id=msg.get("model_id"),
-                deadline_ms=msg.get("deadline_ms"),
-                tenant=msg.get("tenant"),
-            )
+            # the serve span is current for the submit, so the micro-
+            # batcher's "serving.request" span becomes its child — one
+            # stitched lineage from the router's root down to the batch
+            with tracer.use_span(span):
+                fut = self._server.submit(
+                    msg["value"],
+                    model_id=msg.get("model_id"),
+                    deadline_ms=msg.get("deadline_ms"),
+                    tenant=msg.get("tenant"),
+                )
             ok = True
-            return ("future", fut, time.monotonic())
+            return ("future", fut, time.monotonic(), span)
+        except Exception as exc:
+            self._end_span(span, type(exc))
+            raise
         finally:
             if not ok:
                 self._done_one()
 
-    def _finish(self, fut, t0: float) -> Dict[str, Any]:
+    def _serve_span(self, msg: Dict[str, Any]):
+        """Open this replica's serve span as a child of the REMOTE
+        parent whose ``(trace_id, span_id)`` rode the request envelope;
+        None when tracing is off or no context was sent."""
+        remote = msg.get("trace")
+        if not tracer.enabled or remote is None:
+            return None
+        try:
+            remote = (int(remote[0]), int(remote[1]))
+        except (TypeError, ValueError, IndexError):
+            return None
+        return tracer.start_span(
+            "replica.serve", remote=remote,
+            model_id=msg.get("model_id"), pid=os.getpid(),
+        )
+
+    @staticmethod
+    def _end_span(span, exc_type=None) -> None:
+        if span is None:
+            return
+        if exc_type is not None:
+            span.set_attribute("error", exc_type.__name__)
+        span.end()
+
+    def _finish(self, fut, t0: float, span=None) -> Dict[str, Any]:
         try:
             result = fut.result(timeout=self._request_timeout_s)
-            return {
+            reply = {
                 "ok": True,
                 "result": np.asarray(result),
-                # submit->result time: what the bench subtracts from
-                # client latency to get router-added overhead
+                # submit->result time: the replica-attributed share of
+                # the client-observed latency
                 "server_ms": round((time.monotonic() - t0) * 1000.0, 3),
             }
+            # the micro-batcher stamps its phase decomposition onto the
+            # future before resolving it; forward it on the reply
+            phases = getattr(fut, "sparkdl_phases", None)
+            if phases:
+                reply["phases"] = dict(phases)
+            if span is not None:
+                span.end()
+                # piggyback this trace's finished replica-side spans
+                # (bounded + sampled by the harvest sink) on the reply
+                reply["spans"] = self._harvest.take(span.trace_id)
+            return reply
+        except Exception as exc:
+            self._end_span(span, type(exc))
+            raise
         finally:
             self._done_one()
 
@@ -384,6 +475,7 @@ class ReplicaService:
         self._tcp.server_close()
         if self._thread.is_alive():
             self._thread.join(timeout=5.0)
+        tracer.remove_sink(self._harvest)
         self._server.close()
 
     def __enter__(self) -> "ReplicaService":

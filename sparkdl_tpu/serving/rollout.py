@@ -329,14 +329,23 @@ class RolloutController:
             self._transition("promoting")
 
     def _breached(self) -> List[str]:
-        """Watched SLO names currently in a rollback state."""
+        """Watched SLO names currently in a rollback state.  The default
+        watch covers both canary views: the router-side
+        ``rollout.<version>.*`` attempt objectives AND the federated
+        ``fleet.rollout.<version>.*`` replica-attributed objectives
+        (:func:`~sparkdl_tpu.obs.slo.fleet_rollout_slos`) — a canary
+        whose failures the router's retries mask still pages on its own
+        scraped series."""
         states = self._engine.states() if self._engine is not None else {}
-        prefix = f"rollout.{self.new_version}."
+        prefixes = (
+            f"rollout.{self.new_version}.",
+            f"fleet.rollout.{self.new_version}.",
+        )
         return sorted(
             name for name, state in states.items()
             if state in self._rollback_on
             and (name in self._watch if self._watch is not None
-                 else name.startswith(prefix))
+                 else name.startswith(prefixes))
         )
 
     def _promote(self, now: float) -> None:

@@ -51,6 +51,7 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.serving import transport as transport_mod
 from sparkdl_tpu.serving import wire
@@ -166,6 +167,7 @@ class Router:
         self._m_weight_fallback = metrics.counter("router.weight_fallback")
         self._vm: Dict[str, _VersionInstruments] = {}
         self._tm: Dict[str, _TenantInstruments] = {}
+        self._m_phase: Dict[str, Any] = {}
 
     # ------------------------------------------------------------------
     # membership (the supervisor's side of the interface)
@@ -364,90 +366,166 @@ class Router:
         timeout_s: Optional[float] = None,
         tenant: Optional[str] = None,
     ) -> Dict[str, Any]:
-        """:meth:`route`, but returning the full reply envelope (the
-        front door forwards ``server_ms`` so the bench can separate
-        router-added overhead from replica forward time)."""
+        """:meth:`route`, but returning the full reply envelope — with a
+        per-phase latency breakdown in ``reply["phases"]`` (admission /
+        router_queue / wire / transport / replica_queue / forward /
+        fetch, observed as ``router.phase.<name>``) and, when tracing
+        is on, the replica's piggybacked spans ingested into this
+        process's sinks so the trace sink holds one stitched
+        end-to-end trace per request."""
         base_id, pin = split_versioned(model_id)
         tm = self._tenant_instruments(tenant)
-        self._admit(tm)
-        start = time.monotonic()
-        budget = (
-            timeout_s if timeout_s is not None else self._request_timeout_s
+        span = (
+            tracer.start_span(
+                "router.request", model_id=model_id, tenant=tenant,
+            )
+            if tracer.enabled else None
         )
-        deadline = start + budget
         try:
-            inject.fire("router.route")
-            self._m_requests.add(1)
-            if tm is not None:
-                tm.requests.add(1)
-            tried: set = set()
-            last_exc: Optional[BaseException] = None
-            while True:
-                backend = self._pick(tried, pin=pin)
-                if backend is None:
-                    self._m_errors.add(1)
-                    if tm is not None:
-                        tm.errors.add(1)
-                    if last_exc is not None:
-                        raise last_exc
-                    raise NoLiveReplicas(
-                        "no live replica to place the request on "
-                        f"(version {pin or 'any'}; "
-                        f"tried {sorted(tried) or 'none'})"
-                    )
-                vm = self._version_instruments(backend.version)
-                vm.requests.add(1)
-                attempt_start = time.monotonic()
-                try:
-                    reply = self._send_one(
-                        backend, value, base_id, deadline_ms, tenant,
-                        max(0.05, deadline - time.monotonic()),
-                    )
-                except (ConnectionError, OSError, socket.timeout) as exc:
-                    # the stranded-request case: the replica died (or
-                    # wedged) under this request — re-place it
-                    vm.errors.add(1)
-                    tried.add(backend.name)
-                    last_exc = exc
-                    self._m_retries.add(1)
-                    continue
-                except Exception as exc:
-                    from sparkdl_tpu.resilience.errors import is_transient
-
-                    vm.errors.add(1)
-                    if is_transient(exc):
-                        # draining / replica-side shed: try elsewhere
+            t_in = time.monotonic()
+            self._admit(tm)
+            start = time.monotonic()
+            admission_ms = (start - t_in) * 1000.0
+            budget = (
+                timeout_s if timeout_s is not None
+                else self._request_timeout_s
+            )
+            deadline = start + budget
+            try:
+                inject.fire("router.route")
+                self._m_requests.add(1)
+                if tm is not None:
+                    tm.requests.add(1)
+                tried: set = set()
+                last_exc: Optional[BaseException] = None
+                while True:
+                    backend = self._pick(tried, pin=pin)
+                    if backend is None:
+                        self._m_errors.add(1)
+                        if tm is not None:
+                            tm.errors.add(1)
+                        if last_exc is not None:
+                            raise last_exc
+                        raise NoLiveReplicas(
+                            "no live replica to place the request on "
+                            f"(version {pin or 'any'}; "
+                            f"tried {sorted(tried) or 'none'})"
+                        )
+                    vm = self._version_instruments(backend.version)
+                    vm.requests.add(1)
+                    attempt_start = time.monotonic()
+                    try:
+                        reply = self._send_one(
+                            backend, value, base_id, deadline_ms, tenant,
+                            max(0.05, deadline - time.monotonic()),
+                            trace=(
+                                span.context() if span is not None else None
+                            ),
+                        )
+                    except (ConnectionError, OSError, socket.timeout) as exc:
+                        # the stranded-request case: the replica died
+                        # (or wedged) under this request — re-place it
+                        vm.errors.add(1)
                         tried.add(backend.name)
                         last_exc = exc
                         self._m_retries.add(1)
                         continue
-                    self._m_errors.add(1)
+                    except Exception as exc:
+                        from sparkdl_tpu.resilience.errors import is_transient
+
+                        vm.errors.add(1)
+                        if is_transient(exc):
+                            # draining / replica-side shed: try elsewhere
+                            tried.add(backend.name)
+                            last_exc = exc
+                            self._m_retries.add(1)
+                            continue
+                        self._m_errors.add(1)
+                        if tm is not None:
+                            tm.errors.add(1)
+                        raise
+                    finally:
+                        self._unpick(backend)
+                    now = time.monotonic()
+                    # per-version latency is per-*attempt* so a retried
+                    # request doesn't charge the surviving version for
+                    # time the dying one burned
+                    vm.latency.observe((now - attempt_start) * 1000.0)
+                    self._m_latency.observe((now - start) * 1000.0)
                     if tm is not None:
-                        tm.errors.add(1)
-                    raise
-                finally:
-                    self._unpick(backend)
-                now = time.monotonic()
-                # per-version latency is per-*attempt* so a retried
-                # request doesn't charge the surviving version for time
-                # the dying one burned
-                vm.latency.observe((now - attempt_start) * 1000.0)
-                self._m_latency.observe((now - start) * 1000.0)
-                if tm is not None:
-                    tm.latency.observe((now - start) * 1000.0)
-                return reply
+                        tm.latency.observe((now - start) * 1000.0)
+                    shipped = reply.pop("spans", None)
+                    if span is not None:
+                        span.set_attribute("replica", backend.name)
+                        span.set_attribute("version", backend.version)
+                        for remote_span in shipped or ():
+                            tracer.ingest(remote_span)
+                    self._decompose(
+                        reply,
+                        admission_ms=admission_ms,
+                        queue_ms=(attempt_start - start) * 1000.0,
+                        attempt_ms=(now - attempt_start) * 1000.0,
+                    )
+                    return reply
+            finally:
+                self._release()
+        except BaseException as exc:
+            # a replica dying mid-request (SIGKILL, wedge) with no
+            # survivor still leaves a *terminated* root span carrying
+            # the error class — never a dangling parent
+            if span is not None:
+                span.set_attribute("error", type(exc).__name__)
+            raise
         finally:
-            self._release()
+            if span is not None:
+                span.end()
+
+    def _decompose(self, reply: Dict[str, Any], admission_ms: float,
+                   queue_ms: float, attempt_ms: float) -> None:
+        """Merge the router-side phases into the reply's breakdown and
+        observe each as ``router.phase.<name>``.  The transport phase
+        is the winning attempt's wall time minus what finer phases
+        already account for (client-side wire work stamped by the
+        transport, replica-side ``server_ms``), clamped at zero."""
+        phases = reply.get("phases")
+        if not isinstance(phases, dict):
+            phases = reply["phases"] = {}
+        phases["admission"] = admission_ms
+        phases["router_queue"] = queue_ms
+        try:
+            accounted = (
+                float(phases.get("wire") or 0.0)
+                + float(reply.get("server_ms") or 0.0)
+            )
+        except (TypeError, ValueError):
+            accounted = 0.0
+        phases["transport"] = max(0.0, attempt_ms - accounted)
+        for name, ms in phases.items():
+            if not isinstance(ms, (int, float)):
+                continue
+            h = self._m_phase.get(name)
+            if h is None:
+                h = self._m_phase.setdefault(
+                    name,
+                    metrics.histogram(
+                        f"router.phase.{_sanitize_label(str(name))}"
+                    ),
+                )
+            h.observe(float(ms))
 
     def _send_one(self, backend: _Backend, value, model_id, deadline_ms,
-                  tenant: Optional[str], timeout_s: float) -> Dict[str, Any]:
-        reply = backend.transport.request({
+                  tenant: Optional[str], timeout_s: float,
+                  trace=None) -> Dict[str, Any]:
+        msg: Dict[str, Any] = {
             "op": "infer",
             "model_id": model_id,
             "value": value,
             "deadline_ms": deadline_ms,
             "tenant": tenant,
-        }, timeout_s)
+        }
+        if trace is not None:
+            msg["trace"] = trace
+        reply = backend.transport.request(msg, timeout_s)
         if not isinstance(reply, dict):
             raise ConnectionError(
                 f"malformed reply from replica {backend.name!r}"
@@ -483,17 +561,45 @@ class Router:
                         }
                     else:
                         try:
+                            t_route = time.monotonic()
                             inner = outer.route_reply(
                                 msg["value"],
                                 model_id=msg.get("model_id"),
                                 deadline_ms=msg.get("deadline_ms"),
                                 tenant=msg.get("tenant"),
                             )
+                            route_ms = (
+                                time.monotonic() - t_route
+                            ) * 1000.0
                             reply = {
                                 "ok": True,
                                 "result": inner["result"],
                                 "server_ms": inner.get("server_ms"),
                             }
+                            phases = inner.get("phases")
+                            if isinstance(phases, dict):
+                                phases = dict(phases)
+                                accounted = sum(
+                                    v for v in phases.values()
+                                    if isinstance(v, (int, float))
+                                )
+                                # routing time no finer phase accounts
+                                # for (retry gaps, GIL waits)
+                                phases["frontdoor"] = max(
+                                    0.0, route_ms - accounted
+                                )
+                                # absolute CLOCK_MONOTONIC stamps (s,
+                                # not ms — the "t_" prefix marks them):
+                                # monotonic is system-wide on Linux, so
+                                # a SAME-HOST client can decompose its
+                                # own ingress (t0 -> t_route) and
+                                # egress (t_send -> reply-read) hops —
+                                # the scheduler/codec time no server-
+                                # side phase can see.  Phase consumers
+                                # skip "t_"-prefixed keys.
+                                phases["t_route"] = t_route
+                                phases["t_send"] = time.monotonic()
+                                reply["phases"] = phases
                         except Exception as exc:
                             reply = wire.encode_error(exc)
                     try:

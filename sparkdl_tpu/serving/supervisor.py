@@ -93,6 +93,12 @@ class ReplicaHandle:
         self.last_exit: Optional[int] = None
         self._drain_thread: Optional[threading.Thread] = None
 
+    def obs_url(self) -> Optional[str]:
+        """Base URL of this replica's ObsServer (None before ready)."""
+        if self.obs_port is None:
+            return None
+        return f"http://{self.spec.host}:{self.obs_port}"
+
     def describe(self) -> Dict[str, Any]:
         return {
             "slot": self.slot,
@@ -102,6 +108,7 @@ class ReplicaHandle:
             "pid": self.proc.pid if self.proc is not None else None,
             "port": self.port,
             "obs_port": self.obs_port,
+            "obs_url": self.obs_url(),
             "lanes": list(self.lanes),
             "generation": self.generation,
             "attempt": self.attempt,
@@ -206,6 +213,8 @@ class ReplicaSupervisor:
             self._stop_handle(handle, graceful=True)
         telemetry, self._telemetry = self._telemetry, None
         if telemetry is not None:
+            if telemetry.get("fleet") is not None:
+                telemetry["fleet"].stop()
             telemetry["engine"].stop()
             telemetry["recorder"].stop()
             telemetry["server"].close()
@@ -701,6 +710,22 @@ class ReplicaSupervisor:
     # ------------------------------------------------------------------
     # telemetry
     # ------------------------------------------------------------------
+    def obs_targets(self) -> List[Dict[str, Any]]:
+        """Scrape targets for the fleet collector: every live replica's
+        name / version / ObsServer base URL.  Polled at each scrape, so
+        restarts (new obs port) and deploys are picked up on the next
+        pass without re-wiring."""
+        with self._lock:
+            return [
+                {
+                    "name": h.name,
+                    "version": h.version,
+                    "url": h.obs_url(),
+                }
+                for h in self._handles.values()
+                if h.state == "live" and h.obs_port is not None
+            ]
+
     def start_telemetry(
         self,
         port: int = 0,
@@ -711,6 +736,8 @@ class ReplicaSupervisor:
         latency_objective: float = 0.99,
         error_objective: float = 0.999,
         extra_slos: Optional[Sequence] = None,
+        federate: bool = True,
+        fleet_interval_s: float = 2.0,
         **slo_overrides,
     ):
         """The router-level telemetry plane (mirrors
@@ -718,7 +745,13 @@ class ReplicaSupervisor:
         a recorder sampling the registry, an SLO engine with router p99
         latency + error-rate objectives (what the autoscaler reads), and
         an ObsServer whose ``/healthz`` reflects :meth:`status`.
-        Idempotent; torn down in :meth:`close`."""
+        With ``federate`` (the default) a
+        :class:`~sparkdl_tpu.obs.fleet.FleetCollector` also scrapes
+        every live replica's own metrics into the recorder as
+        ``fleet.*`` series — replica-attributed signal for the SLO
+        engine, the autoscaler, and the rollout controller — and the
+        ObsServer gains the federated ``/metrics`` + ``/debug/fleet``
+        views.  Idempotent; torn down in :meth:`close`."""
         if self._telemetry is not None:
             return self._telemetry["server"]
         from sparkdl_tpu.obs import ObsServer, SLOEngine, TimeSeriesRecorder
@@ -751,15 +784,24 @@ class ReplicaSupervisor:
         if extra_slos:
             engine.add(*extra_slos)
         engine.start(interval_s=slo_interval_s)
+        fleet = None
+        if federate:
+            from sparkdl_tpu.obs.fleet import FleetCollector
+
+            fleet = FleetCollector(
+                recorder, self.obs_targets, interval_s=fleet_interval_s,
+            ).start()
         server = ObsServer(
             port=port,
             host=host,
             recorder=recorder,
             slo_engine=engine,
             health_fn=self.status,
+            fleet=fleet,
         ).start()
         self._telemetry = {
             "server": server, "recorder": recorder, "engine": engine,
+            "fleet": fleet,
         }
         return server
 
@@ -769,6 +811,15 @@ class ReplicaSupervisor:
         :meth:`start_telemetry`) — the autoscaler's signal source."""
         return (
             self._telemetry["engine"] if self._telemetry else None
+        )
+
+    @property
+    def fleet_collector(self):
+        """The running fleet collector (None before
+        :meth:`start_telemetry`, or when it ran with
+        ``federate=False``)."""
+        return (
+            self._telemetry.get("fleet") if self._telemetry else None
         )
 
     def __repr__(self):

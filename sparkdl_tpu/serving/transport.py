@@ -185,6 +185,20 @@ def make_transport(
 # TCP lane
 
 
+def _stamp_wire(reply: Any, wire_ms: float) -> Any:
+    """Record the client-side serialize+send cost of THIS request into
+    the reply's ``phases`` dict (created if the replica sent none) — the
+    ``wire`` slice of the per-request latency decomposition.  Non-dict
+    replies pass through untouched (the router rejects them anyway)."""
+    if isinstance(reply, dict):
+        phases = reply.get("phases")
+        if isinstance(phases, dict):
+            phases["wire"] = wire_ms
+        else:
+            reply["phases"] = {"wire": wire_ms}
+    return reply
+
+
 def _sock_is_stale(sock) -> bool:
     """True when a pooled *idle* socket must not carry the next request.
     The wire protocol is strictly request/reply, so an idle socket with
@@ -298,14 +312,24 @@ class _Coalescer:
                 )
                 sock.settimeout(self._io_timeout_s)
                 self._sock = sock
+            t0 = time.perf_counter()
             if len(batch) == 1:
-                wire.send_msg(sock, batch[0].msg)
+                wire.sendall_parts(
+                    sock, wire.encode_parts(batch[0].msg, wire.KIND_MSG)
+                )
+                wire_ms = (time.perf_counter() - t0) * 1000.0
                 reply = wire.recv_msg(sock)
                 if reply is None:
                     raise ConnectionError("replica closed connection mid-request")
                 replies = [reply]
             else:
-                wire.send_batch(sock, [s.msg for s in batch])
+                wire.sendall_parts(
+                    sock,
+                    wire.encode_parts([s.msg for s in batch], wire.KIND_BATCH),
+                )
+                # the frame cost is shared — attribute an equal share of
+                # serialize+send to each coalesced rider
+                wire_ms = (time.perf_counter() - t0) * 1000.0 / len(batch)
                 got = wire.recv_any(sock)
                 if got is None:
                     raise ConnectionError("replica closed connection mid-batch")
@@ -320,7 +344,7 @@ class _Coalescer:
             self._fail(batch, exc)
             return
         for slot, reply in zip(batch, replies):
-            slot.reply = reply
+            slot.reply = _stamp_wire(reply, wire_ms)
             slot.done.set()
 
     @staticmethod
@@ -386,7 +410,9 @@ class TcpTransport(Transport):
         sock = self._checkout()
         try:
             sock.settimeout(timeout_s)
-            wire.send_msg(sock, msg)
+            t0 = time.perf_counter()
+            wire.sendall_parts(sock, wire.encode_parts(msg, wire.KIND_MSG))
+            wire_ms = (time.perf_counter() - t0) * 1000.0
             reply = wire.recv_msg(sock)
         except BaseException:
             try:
@@ -401,7 +427,7 @@ class TcpTransport(Transport):
                 pass
             raise ConnectionError("replica closed connection mid-request")
         self._checkin(sock)
-        return reply
+        return _stamp_wire(reply, wire_ms)
 
     def _checkout(self) -> socket.socket:
         """A pooled socket proven idle-healthy, or a fresh dial.  Aged
@@ -661,6 +687,7 @@ class _ShmClientChannel:
     def request(self, msg: Dict[str, Any], timeout_s: float) -> Dict[str, Any]:
         inject.fire("wire.shm")
         deadline = time.monotonic() + timeout_s
+        t0 = time.perf_counter()
         parts = wire.encode_parts(msg, wire.KIND_MSG)
         total = wire.parts_len(parts)
         assert self._tx is not None and self._rx is not None
@@ -683,6 +710,7 @@ class _ShmClientChannel:
             # frame itself wakes the replica — no doorbell needed)
             wire.sendall_parts(self._sock, parts)
             metrics.counter("wire.shm.spill").add(1)
+        wire_ms = (time.perf_counter() - t0) * 1000.0
         spins = 0
         while True:
             record = self._rx.try_read()
@@ -690,7 +718,7 @@ class _ShmClientChannel:
                 kind, obj = wire.decode_frame(record)
                 if kind != wire.KIND_MSG:
                     raise ConnectionError("unexpected batch frame on shm ring")
-                return obj
+                return _stamp_wire(obj, wire_ms)
             if spins < _POLL_SPIN:
                 # pure ring polls — no syscalls until we decide to block
                 spins += 1
@@ -714,7 +742,7 @@ class _ShmClientChannel:
                             raise ConnectionError(
                                 "unexpected batch frame on shm side-channel"
                             )
-                        return obj
+                        return _stamp_wire(obj, wire_ms)
             finally:
                 self._rx.set_waiter(False)
 

@@ -70,6 +70,23 @@ MAX_META_BYTES = 64 * 1024 * 1024
 #: ndarrays in the envelope are swapped for (_TENSOR_MARK, index) tuples
 _TENSOR_MARK = "\x00sdw-tensor\x00"
 
+#: every key any request/reply envelope may carry — THE schema of the
+#: router<->replica boundary.  The ``wire-envelope`` check rule holds
+#: code to this set AND requires each field to appear in the
+#: ``tests/test_wire.py`` roundtrip fixtures, so a field cannot ship
+#: without a codec roundtrip proving it survives both lanes.
+ENVELOPE_FIELDS = frozenset({
+    # requests
+    "op", "model_id", "value", "deadline_ms", "tenant", "trace",
+    # shm lane upgrade handshake
+    "shm", "ring_bytes",
+    # replies
+    "ok", "result", "server_ms", "phases", "spans",
+    "pid", "draining", "replicas",
+    # typed errors
+    "error", "error_class",
+})
+
 
 def _timer(name: str):
     """``wire.*`` timer when the package's metrics registry is already

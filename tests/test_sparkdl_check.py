@@ -71,12 +71,12 @@ def rule_lines(report, rule_id):
 # framework plumbing
 # ---------------------------------------------------------------------------
 
-def test_registry_has_all_twelve_rules():
+def test_registry_has_all_thirteen_rules():
     assert set(all_rule_ids()) == {
         "lock-order", "lock-blocking", "host-sync", "recompile-hazard",
         "donation-safety", "contextvar-leak", "sleep-retry", "metric-name",
         "raw-jit", "exception-safety", "resource-lifecycle",
-        "fault-site-coverage",
+        "fault-site-coverage", "wire-envelope",
     }
 
 
@@ -1715,3 +1715,106 @@ def test_metric_name_rule_sanctions_wire_prefix(tmp_path):
     )
     assert len(report.findings) == 1, [f.message for f in report.findings]
     assert "wires.frames_out" in report.findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# wire-envelope
+# ---------------------------------------------------------------------------
+
+_WIRE_SCHEMA = """
+    ENVELOPE_FIELDS = frozenset({
+        "op", "ok", "value", "result", "error",
+    })
+    """
+
+_WIRE_FIXTURES = """
+    def test_roundtrip():
+        msg = {"op": "infer", "value": 1}
+        reply = {"ok": True, "result": 2, "error": None}
+        assert msg and reply
+    """
+
+
+def test_wire_envelope_flags_undeclared_field(tmp_path):
+    """A dict-literal envelope key absent from ``ENVELOPE_FIELDS`` is a
+    schema finding — both lanes of the cross-process contract."""
+    report = check_files(
+        tmp_path,
+        {
+            "serving/wire.py": _WIRE_SCHEMA,
+            "tests/test_wire.py": _WIRE_FIXTURES,
+            "serving/router.py": """
+                reply = {"ok": True, "result": 1, "surprise": 2}
+                """,
+        },
+        rules=["wire-envelope"],
+    )
+    assert len(report.findings) == 1, [f.message for f in report.findings]
+    f = report.findings[0]
+    assert "'surprise'" in f.message and "ENVELOPE_FIELDS" in f.message
+    assert f.path == "serving/router.py"
+
+
+def test_wire_envelope_flags_unfixtured_subscript(tmp_path):
+    """``reply[...] = ...`` adds a field post-construction; declared but
+    never quoted in tests/test_wire.py means no roundtrip fixture."""
+    report = check_files(
+        tmp_path,
+        {
+            "serving/wire.py": _WIRE_SCHEMA,
+            "tests/test_wire.py": """
+                def test_roundtrip():
+                    msg = {"op": "infer", "value": 1}
+                    reply = {"ok": True, "result": 2}
+                    assert msg and reply
+                """,
+            "serving/transport.py": """
+                reply = {"ok": False}
+                reply["error"] = "boom"
+                """,
+        },
+        rules=["wire-envelope"],
+    )
+    assert len(report.findings) == 1, [f.message for f in report.findings]
+    f = report.findings[0]
+    assert "'error'" in f.message and "roundtrip fixture" in f.message
+    assert f.path == "serving/transport.py"
+
+
+def test_wire_envelope_clean_tree_is_quiet(tmp_path):
+    """Declared + fixtured fields, and non-envelope dicts (no sentinel
+    key), produce no findings."""
+    report = check_files(
+        tmp_path,
+        {
+            "serving/wire.py": _WIRE_SCHEMA,
+            "tests/test_wire.py": _WIRE_FIXTURES,
+            "serving/replica.py": """
+                msg = {"op": "infer", "value": 3}
+                reply = {"ok": True, "result": 4}
+                reply["error"] = None
+                options = {"retries": 2, "verbose": True}  # no sentinel key
+                """,
+            "serving/batcher.py": """
+                # outside ENVELOPE_FILES: never scanned by this rule
+                stray = {"op": "x", "not_a_field": 1}
+                """,
+        },
+        rules=["wire-envelope"],
+    )
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+def test_wire_envelope_skips_without_schema_or_fixtures(tmp_path):
+    """A bare fixture tree with neither ``serving/wire.py`` schema nor a
+    tests/ dir stays silent — single-file scans must remain usable."""
+    report = check_files(
+        tmp_path,
+        {
+            "serving/router.py": """
+                reply = {"ok": True, "whatever": 1}
+                """,
+        },
+        rules=["wire-envelope"],
+    )
+    assert report.findings == [], [f.message for f in report.findings]

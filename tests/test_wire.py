@@ -121,6 +121,69 @@ class TestCodec:
 
 
 # ----------------------------------------------------------------------
+# envelope schema: the cross-process contract, fixture-tested
+# ----------------------------------------------------------------------
+class TestEnvelopeSchema:
+    """Every ``wire.ENVELOPE_FIELDS`` member round-trips here, as a
+    *literal*.  The ``wire-envelope`` checker rule requires any field a
+    serving module puts on the wire to appear quoted in this file; the
+    completeness assertion below closes the other direction — a field
+    added to the schema without a fixture fails this test.  Together
+    they pin the envelope from both sides."""
+
+    #: one fixture envelope per message family, every field literal
+    FIXTURES = {
+        "request": {
+            "op": "infer", "model_id": "ep0", "value": None,
+            "deadline_ms": 12.5, "tenant": "team-a",
+            "trace": (12345, 67890),
+        },
+        "shm_handshake": {
+            "op": "shm_attach", "shm": "psm_fixture",
+            "ring_bytes": 1 << 20,
+        },
+        "reply": {
+            "ok": True, "result": None, "server_ms": 3.25,
+            "phases": {"wire": 0.1, "transport": 0.4},
+            "spans": [{"name": "replica.serve", "trace_id": 12345}],
+            "pid": 4242, "draining": False,
+            "replicas": ("replica-0",),
+        },
+        "error": {
+            "ok": False, "error": "boom",
+            "error_class": "ValueError",
+        },
+    }
+
+    @pytest.mark.parametrize("family", sorted(FIXTURES))
+    def test_envelope_roundtrip(self, family):
+        env = dict(self.FIXTURES[family])
+        if "value" in env:
+            env["value"] = np.arange(8, dtype=np.float32)
+        if "result" in env:
+            env["result"] = np.arange(4, dtype=np.float32)
+        _, got = wire.decode_frame(frame_bytes(env))
+        assert set(got) == set(env)
+        for key, want in env.items():
+            if isinstance(want, np.ndarray):
+                np.testing.assert_array_equal(got[key], want)
+            elif isinstance(want, tuple):
+                assert tuple(got[key]) == want
+            else:
+                assert got[key] == want
+
+    def test_fixtures_cover_the_declared_schema(self):
+        covered = set()
+        for env in self.FIXTURES.values():
+            covered |= set(env)
+        assert covered == set(wire.ENVELOPE_FIELDS), (
+            "ENVELOPE_FIELDS and the roundtrip fixtures disagree: "
+            f"unfixtured={sorted(set(wire.ENVELOPE_FIELDS) - covered)}, "
+            f"undeclared={sorted(covered - set(wire.ENVELOPE_FIELDS))}"
+        )
+
+
+# ----------------------------------------------------------------------
 # torn-frame fuzz: damaged input must never become a garbage array
 # ----------------------------------------------------------------------
 class TestTornFrames:
