@@ -66,9 +66,28 @@ section (spans, traces, how many stitched end-to-end) and a ``fleet``
 section (scrape health).  ``--obs off`` is the baseline twin — the
 on/off latency delta is the documented cost of the plane.
 
+Since ISSUE-14 ``--scenario faultnet`` runs a Byzantine-wire brownout:
+slot 0 stalls a fraction of its serves (``supervisor.replica_serve``
+``stall_s``) and slot 1's replica corrupts a fraction of the reply
+frames it encodes (``faultnet.tx`` ``corrupt_body`` via the env-armed
+tx tap — damage the CRC trailer must catch), while workers attach an
+end-to-end ``deadline_ms``.  The report grows a ``faultnet`` section
+with router-side counter deltas (``wire.crc_fail``,
+``router.hedge.*``, ``router.retry_budget.*``) and the
+**retry-amplification factor** (attempts per admitted request — the
+token bucket's promise is <= 2.0 under full brownout).  Replies that
+die by their own deadline land in a separate ``expired`` outcome
+bucket: a typed ``DeadlineExceeded`` is the contract working, not an
+accepted-then-lost request.  Without ``--smoke`` the scenario runs
+TWICE with the same seed — hedging on, then ``SPARKDL_HEDGE=0`` — and
+the combined report carries the measured hedging p99 delta.
+
 ``--smoke`` is the CI mode (<60 s): 2 replicas, sustained load, one
 planned kill; exits non-zero unless zero accepted requests were lost
-and the dead replica came back.  ``--smoke --scenario rollout`` is the
+and the dead replica came back.  ``--smoke --scenario faultnet`` is
+the brownout twin: one hedge-on pass asserting zero accepted loss and
+a nonzero ``wire.crc_fail`` (every corrupt frame detected, none
+decoded).  ``--smoke --scenario rollout`` is the
 rollout twin: breach -> auto-rollback -> zero accepted loss, v1 still
 serving.  Smoke runs default ``--obs on`` and additionally assert that
 at least one stitched end-to-end trace was captured and that the phase
@@ -100,6 +119,19 @@ _WIRE_PATH = os.path.join(REPO, "sparkdl_tpu", "serving", "wire.py")
 #: other failure class is an accepted request that was lost
 #: (TenantThrottled is the per-tenant fair-share refusal — ISSUE-12)
 _SHED_CLASSES = {"ServerOverloaded", "NoLiveReplicas", "TenantThrottled"}
+
+#: typed deadline deaths — the end-to-end deadline doing its job
+#: (ISSUE-14): neither goodput nor loss, its own outcome bucket
+_EXPIRED_CLASSES = {"DeadlineExceeded"}
+
+#: router-process counters the faultnet report tracks as deltas
+_FAULTNET_COUNTERS = (
+    "router.requests", "router.attempts", "router.retries",
+    "router.errors", "router.deadline_expired",
+    "router.hedge.fired", "router.hedge.wins",
+    "router.retry_budget.spent", "router.retry_budget.denied",
+    "wire.crc_fail", "faultnet.injected",
+)
 
 
 def _load_wire():
@@ -175,6 +207,8 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                 }
                 if tenant is not None:
                     msg["tenant"] = tenant
+                if args_dict.get("deadline_ms"):
+                    msg["deadline_ms"] = args_dict["deadline_ms"]
                 wire.send_msg(sock, msg)
                 reply = wire.recv_msg(sock)
                 if reply is None:
@@ -323,7 +357,10 @@ def _timeline(records, duration_s):
         ok_lat = sorted(r[1] for r in rows if r[2] == "ok")
         shed = sum(1 for r in rows if r[2] in _SHED_CLASSES)
         lost = sum(
-            1 for r in rows if r[2] != "ok" and r[2] not in _SHED_CLASSES
+            1 for r in rows
+            if r[2] != "ok"
+            and r[2] not in _SHED_CLASSES
+            and r[2] not in _EXPIRED_CLASSES
         )
         buckets.append({
             "t": sec,
@@ -378,9 +415,19 @@ def run(args):
         # before the supervisor starts: the router builds one transport
         # per backend at replica-ready time
         os.environ["SPARKDL_WIRE_TRANSPORT"] = args.transport
+    if args.scenario == "faultnet":
+        # before the supervisor constructs its Router (env read once)
+        os.environ["SPARKDL_HEDGE"] = "1" if args.hedge == "on" else "0"
 
     from sparkdl_tpu.serving.replica import ReplicaSpec
     from sparkdl_tpu.serving.supervisor import ReplicaSupervisor
+    from sparkdl_tpu.utils.metrics import metrics
+
+    # run() can execute twice in one process (the faultnet A/B passes):
+    # every counter the report quotes is a delta from here
+    counters_base = {
+        name: metrics.counter(name).value for name in _FAULTNET_COUNTERS
+    }
 
     obs_on = args.obs == "on"
     router_sink = None
@@ -414,6 +461,25 @@ def run(args):
             "kill": True,
             "at": args.kill_at_requests,
         }]}
+    elif args.scenario == "faultnet":
+        # the brownout: slot 0 is the slow replica (a fraction of its
+        # serves stall — the tail hedging must rescue), slot 1's child
+        # process corrupts a fraction of the reply frames it encodes
+        # (post-CRC, so detection MUST come from the trailer); any
+        # further slots are clean survivors
+        fault_plans = {
+            0: [{
+                "site": "supervisor.replica_serve",
+                "stall_s": args.faultnet_stall_s,
+                "p": args.faultnet_stall_p,
+            }],
+        }
+        if args.replicas >= 2:
+            fault_plans[1] = [{
+                "site": "faultnet.tx",
+                "act": "corrupt_body",
+                "p": args.faultnet_corrupt_p,
+            }]
     spec = ReplicaSpec(factory=factory)
     supervisor = ReplicaSupervisor(
         spec,
@@ -442,6 +508,8 @@ def run(args):
         ),
         "autoscale": None,
         "fault_plan": fault_plans[0] if fault_plans else None,
+        "fault_plans": fault_plans,
+        "hedge": args.hedge if args.scenario == "faultnet" else None,
         "seed": args.seed,
         "obs": obs_on,
     }
@@ -535,6 +603,10 @@ def run(args):
             "tenants": (
                 args.tenants.split(",") if args.tenants else None
             ),
+            "deadline_ms": (
+                args.faultnet_deadline_ms
+                if args.scenario == "faultnet" else None
+            ),
         }
         procs = [
             ctx.Process(
@@ -605,9 +677,12 @@ def run(args):
         records.sort(key=lambda r: r[0])
         ok = [r for r in records if r[2] == "ok"]
         shed = [r for r in records if r[2] in _SHED_CLASSES]
+        expired = [r for r in records if r[2] in _EXPIRED_CLASSES]
         lost = [
             r for r in records
-            if r[2] != "ok" and r[2] not in _SHED_CLASSES
+            if r[2] != "ok"
+            and r[2] not in _SHED_CLASSES
+            and r[2] not in _EXPIRED_CLASSES
         ]
         kill_t = None
         if args.scenario == "kill":
@@ -631,7 +706,6 @@ def run(args):
         # wire.* codec accounting from the router process (the replica
         # side keeps its own registry; the router's is what the front
         # door adds per hop)
-        from sparkdl_tpu.utils.metrics import metrics
         breakdown = {}
         for stage in ("serialize", "copy", "deserialize"):
             t = metrics.timer(f"wire.{stage}_seconds")
@@ -654,6 +728,7 @@ def run(args):
             "sent": len(records),
             "ok": len(ok),
             "shed": len(shed),
+            "expired": len(expired),
             "lost_accepted": len(lost),
             "lost_detail": sorted({r[2] for r in lost}),
             "shed_rate": round(len(shed) / len(records), 4) if records
@@ -725,6 +800,22 @@ def run(args):
             )
             report["fleet"] = fleet_snap
             router_sink.flush()
+        if args.scenario == "faultnet":
+            deltas = {
+                name: metrics.counter(name).value - counters_base[name]
+                for name in _FAULTNET_COUNTERS
+            }
+            requests = deltas["router.requests"]
+            report["faultnet"] = {
+                "counters": deltas,
+                # attempts per admitted request — hedges and retries
+                # included; the retry budget's promise is <= 2.0 even
+                # under full brownout
+                "retry_amplification": (
+                    round(deltas["router.attempts"] / requests, 4)
+                    if requests else None
+                ),
+            }
         if rollout_report is not None:
             report["rollout"] = rollout_report
         if autoscaler is not None:
@@ -782,7 +873,7 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--scenario", default="kill",
                     choices=["steady", "ramp", "spike", "kill",
-                             "rollout"])
+                             "rollout", "faultnet"])
     ap.add_argument("--replicas", type=int, default=2)
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--rate", type=float, default=100.0,
@@ -840,6 +931,22 @@ def main():
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="router-side stitched-trace JSONL (default: a "
                     "temp file; replicas append to PATH.replica)")
+    ap.add_argument("--faultnet-stall-s", type=float, default=0.25,
+                    help="faultnet scenario: slot-0 per-serve stall "
+                    "duration (the slow replica hedging rescues)")
+    ap.add_argument("--faultnet-stall-p", type=float, default=0.3,
+                    help="faultnet scenario: probability a slot-0 serve "
+                    "stalls")
+    ap.add_argument("--faultnet-corrupt-p", type=float, default=0.05,
+                    help="faultnet scenario: probability slot 1 corrupts "
+                    "a reply frame it encodes (CRC must catch every one)")
+    ap.add_argument("--faultnet-deadline-ms", type=float, default=5000.0,
+                    help="faultnet scenario: end-to-end deadline workers "
+                    "attach to each request (typed expiry lands in the "
+                    "'expired' bucket, not loss)")
+    ap.add_argument("--hedge", default="on", choices=["on", "off"],
+                    help="faultnet scenario: hedged requests on/off for "
+                    "THIS pass (full runs do both automatically)")
     ap.add_argument("--slo-p99-ms", type=float, default=250.0)
     ap.add_argument("--spawn-timeout-s", type=float, default=120.0)
     ap.add_argument("--seed", type=int, default=0)
@@ -864,6 +971,16 @@ def main():
         args.compile = False
         args.rollout_stages = "0.05,0.5,1.0"
         args.rollout_bake_s = 4.0
+    elif args.smoke and args.scenario == "faultnet":
+        # CI brownout smoke (<60 s): one hedge-on pass, slot 0 slow,
+        # slot 1 corrupting — zero accepted loss, every corrupt frame
+        # caught by CRC
+        args.replicas = 2
+        args.duration = 12.0
+        args.rate = 60.0
+        args.workers = 2
+        args.compile = False
+        args.hedge = "on"
     elif args.smoke:
         args.scenario = "kill"
         args.replicas = 2
@@ -873,7 +990,47 @@ def main():
         args.kill_at_requests = 100
         args.compile = False
 
-    report = run(args)
+    if args.scenario == "faultnet" and not args.smoke:
+        # the A/B proof: same seed and traffic shape, hedging on then
+        # off — the p99 delta is the measured value of the hedge
+        args.hedge = "on"
+        report_on = run(args)
+        args.hedge = "off"
+        report_off = run(args)
+        p99_on = (report_on.get("latency_ms") or {}).get("p99")
+        p99_off = (report_off.get("latency_ms") or {}).get("p99")
+        report = {
+            "benchmark": "bench_load",
+            "scenario": "faultnet",
+            "seed": args.seed,
+            "hedging": {
+                "p99_on_ms": p99_on,
+                "p99_off_ms": p99_off,
+                "p99_delta_ms": (
+                    round(p99_off - p99_on, 3)
+                    if p99_on is not None and p99_off is not None
+                    else None
+                ),
+                "hedges_fired": (report_on.get("faultnet") or {})
+                .get("counters", {}).get("router.hedge.fired"),
+                "hedge_wins": (report_on.get("faultnet") or {})
+                .get("counters", {}).get("router.hedge.wins"),
+            },
+            "retry_amplification": {
+                "hedge_on": (report_on.get("faultnet") or {})
+                .get("retry_amplification"),
+                "hedge_off": (report_off.get("faultnet") or {})
+                .get("retry_amplification"),
+            },
+            "zero_accepted_loss": (
+                report_on.get("lost_accepted") == 0
+                and report_off.get("lost_accepted") == 0
+            ),
+            "hedge_on": report_on,
+            "hedge_off": report_off,
+        }
+    else:
+        report = run(args)
     print(json.dumps(report, indent=2, default=str))
     if args.out:
         with open(args.out, "w") as f:
@@ -947,6 +1104,45 @@ def main():
             f"{report['ok']} ok / {report['sent']} sent, 0 lost, "
             f"verdict={rr.get('verdict')}, "
             f"detection={rr.get('detection_s')}s",
+            file=sys.stderr,
+        )
+    elif args.smoke and args.scenario == "faultnet":
+        problems = []
+        counters = (report.get("faultnet") or {}).get("counters") or {}
+        amp = (report.get("faultnet") or {}).get("retry_amplification")
+        if report["lost_accepted"] != 0:
+            problems.append(
+                f"lost {report['lost_accepted']} accepted requests "
+                f"({report['lost_detail']})"
+            )
+        # faultnet.injected counts in the CHILD processes' registries;
+        # the router-side proof the faults both happened and were
+        # caught is wire.crc_fail moving with zero accepted loss
+        if not counters.get("wire.crc_fail"):
+            problems.append(
+                "corrupt frames were injected but wire.crc_fail never "
+                "moved — a flipped tensor byte went undetected"
+            )
+        if amp is not None and amp > 2.0:
+            problems.append(
+                f"retry amplification {amp} exceeds the 2.0x budget cap"
+            )
+        if report["ok"] == 0:
+            problems.append("no successful requests at all")
+        if args.obs == "on":
+            problems.extend(_obs_problems(report))
+        if problems:
+            print("FAULTNET SMOKE FAIL: " + "; ".join(problems),
+                  file=sys.stderr)
+            _print_fleet_on_fail(report)
+            return 1
+        print(
+            "FAULTNET SMOKE PASS: "
+            f"{report['ok']} ok / {report['sent']} sent, 0 lost, "
+            f"{report['expired']} expired, "
+            f"crc_fail={counters.get('wire.crc_fail')}, "
+            f"hedges={counters.get('router.hedge.fired')}, "
+            f"amplification={amp}",
             file=sys.stderr,
         )
     elif args.smoke:

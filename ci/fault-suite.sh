@@ -101,6 +101,22 @@ if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
   exit 1
 fi
 
+# byzantine-wire brownout smoke (<60 s, ISSUE-14): one replica stalls
+# a fraction of its serves, another corrupts a fraction of its reply
+# frames AFTER the CRC trailer is stamped; under hedged requests and
+# deadline-carrying traffic the harness asserts zero accepted-request
+# loss, a NONZERO wire.crc_fail (every flipped tensor byte detected,
+# none silently decoded), and retry amplification within the 2.0x
+# token-bucket cap.  --smoke exits non-zero on any violation.
+if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
+    --scenario faultnet 2>&1 | tee "$SMOKE_LOG"; then
+  echo "faultnet smoke FAILED: the brownout lost accepted requests," >&2
+  echo "a corrupt frame went undetected (wire.crc_fail == 0), retry" >&2
+  echo "amplification blew the 2.0x cap, or >60s wall — see above" >&2
+  print_fleet_snapshot
+  exit 1
+fi
+
 # full static-analysis pass (replaces the per-script lints: one AST
 # parse per file, all nine rules); on failure print the JSON report so
 # CI logs carry the machine-readable findings, not just the exit code

@@ -4,6 +4,7 @@ registry (each module uses the ``@rule`` decorator at import time)."""
 from ci.sparkdl_check.rules import (  # noqa: F401
     contextvar_leak,
     donation_safety,
+    error_taxonomy,
     exception_safety,
     fault_sites,
     host_sync,

@@ -1,0 +1,109 @@
+"""``error-taxonomy`` — every ServingError subclass is classified.
+
+The serving error family doubles as the retry decision: the router and
+``RetryPolicy`` test ``isinstance(exc, TransientError)`` — nothing
+string-matches.  A ``ServingError`` subclass inheriting NEITHER
+classification silently lands on ``classify()``'s unknown-is-permanent
+default (a retryable shed becomes a fail-fast); one inheriting BOTH is
+an undecidable contradiction (``classify`` would answer by mro order —
+an accident of base listing, not a decision).  So the invariant is
+*exactly one* of ``TransientError`` / ``PermanentError`` on every class
+transitively reaching ``ServingError``.
+
+Cross-file by necessity: the taxonomy bases live in
+``resilience/errors.py``, the serving family in ``serving/errors.py``,
+and nothing stops a third module from subclassing either — ``check()``
+collects every class definition in the tree (base names resolved
+through that file's import aliases), ``finalize()`` walks the
+name-level inheritance graph.  Same-named classes in different files
+merge their base sets — a deliberate over-approximation that keeps the
+walk resolver-free (the ``# sparkdl: disable=error-taxonomy`` escape
+covers a genuine collision).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name
+
+
+@rule
+class ErrorTaxonomyRule(Rule):
+    id = "error-taxonomy"
+    severity = "error"
+    doc = ("every ServingError subclass inherits exactly one of "
+           "TransientError / PermanentError — isinstance IS the retry "
+           "decision")
+    cacheable = False  # inheritance graph spans files
+
+    def __init__(self):
+        # class name -> [(relpath, lineno, resolved base names)]
+        self.classes: Dict[str, List[Tuple[str, int, Tuple[str, ...]]]] = {}
+
+    def applies(self, relpath: str) -> bool:
+        return not relpath.startswith("tests/")
+
+    def check(self, ctx: FileContext):
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.asname:
+                        aliases[a.asname] = a.name
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = []
+            for b in node.bases:
+                name = dotted_name(b)
+                if name is None:
+                    continue
+                leaf = name.split(".")[-1]
+                bases.append(aliases.get(leaf, leaf).split(".")[-1])
+            self.classes.setdefault(node.name, []).append(
+                (ctx.relpath, node.lineno, tuple(bases))
+            )
+        return ()
+
+    def _ancestors(self, name: str) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [name]
+        while stack:
+            for _, _, bases in self.classes.get(stack.pop(), ()):
+                for base in bases:
+                    if base not in seen:
+                        seen.add(base)
+                        stack.append(base)
+        return seen
+
+    def finalize(self):
+        for name, defs in sorted(self.classes.items()):
+            if name == "ServingError":
+                continue  # the family root carries no classification
+            ancestors = self._ancestors(name)
+            if "ServingError" not in ancestors:
+                continue
+            n = (
+                ("TransientError" in ancestors)
+                + ("PermanentError" in ancestors)
+            )
+            if n == 1:
+                continue
+            relpath, line, _ = defs[0]
+            if n == 0:
+                msg = (
+                    f"'{name}' subclasses ServingError but inherits "
+                    "neither TransientError nor PermanentError — "
+                    "classify() will silently default it to permanent; "
+                    "state the retry decision in the type"
+                )
+            else:
+                msg = (
+                    f"'{name}' inherits BOTH TransientError and "
+                    "PermanentError — the retry decision is "
+                    "contradictory; keep exactly one"
+                )
+            yield self.finding(relpath, line, msg)

@@ -28,11 +28,14 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: scrape health plus the ``fleet.replica.*`` / ``fleet.version.*``
 #: federated series) with the ISSUE-13 fleet observability plane
 #: (``router.phase.*`` latency-decomposition histograms ride the
-#: existing "router" prefix).
+#: existing "router" prefix).  "replica" (replica-process request-path
+#: counters like ``replica.expired_shed``) and "faultnet" (injected
+#: network-fault accounting) joined with the ISSUE-14 Byzantine-wire
+#: hardening.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
     "streaming", "slo", "ts", "supervisor", "router", "wire",
-    "rollout", "tenant", "fleet",
+    "rollout", "tenant", "fleet", "replica", "faultnet",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

@@ -75,6 +75,14 @@ KNOWN_SITES = {
     # rules here exercise the lane's failure handling without killing
     # the router process
     "wire": ("wire.shm",),
+    # network-fault layer (serving.faultnet): *decision* sites — the
+    # plan picks which rules trigger via :meth:`FaultPlan.decide` and
+    # faultnet interprets the ``act=`` verb (corrupt_body,
+    # corrupt_header, truncate, dup, disconnect, drop_reply) instead of
+    # this module executing it.  ``faultnet.tx`` guards every encoded
+    # frame leaving a process (both lanes), ``faultnet.request`` /
+    # ``faultnet.reply`` bracket a FaultyTransport round trip.
+    "faultnet": ("faultnet.request", "faultnet.reply", "faultnet.tx"),
 }
 
 
@@ -115,17 +123,18 @@ class Rule:
         stall_s: Optional[float] = None,
         preempt: bool = False,
         kill: bool = False,
+        act: Optional[str] = None,
         at: Optional[int] = None,
         times: int = 1,
         p: Optional[float] = None,
     ):
         actions = sum(
-            1 for a in (error, stall_s) if a is not None
+            1 for a in (error, stall_s, act) if a is not None
         ) + int(preempt) + int(kill)
         if actions != 1:
             raise ValueError(
                 "a rule needs exactly one action "
-                "(error= / stall_s= / preempt= / kill=)"
+                "(error= / stall_s= / preempt= / kill= / act=)"
             )
         if (at is None) == (p is None):
             raise ValueError("a rule needs exactly one trigger (at= or p=)")
@@ -134,6 +143,11 @@ class Rule:
         self.stall_s = stall_s
         self.preempt = bool(preempt)
         self.kill = bool(kill)
+        #: interpreted action verb: this module only *selects* act=
+        #: rules (via :meth:`FaultPlan.decide`); the consumer — today
+        #: ``serving.faultnet`` — gives the verb meaning.  Plain
+        #: :func:`fire` ignores act rules entirely.
+        self.act = act
         self.at = int(at) if at is not None else None
         self.times = int(times)
         self.p = float(p) if p is not None else None
@@ -169,6 +183,7 @@ class Rule:
             "kill" if self.kill
             else "preempt" if self.preempt
             else f"stall {self.stall_s}s" if self.stall_s is not None
+            else f"act {self.act}" if self.act is not None
             else f"error {self.error!r}"
         )
         return {"site": self.site, "action": action, **trigger}
@@ -195,6 +210,11 @@ class FaultPlan:
     def count(self, site: str) -> int:
         with self._lock:
             return self._counts.get(site, 0)
+
+    def sites(self) -> tuple:
+        """Sorted site names this plan carries rules for (consumers —
+        faultnet's ``arm`` — use it to decide whether to hook in)."""
+        return tuple(sorted({r.site for r in self._rules}))
 
     def reset(self) -> None:
         with self._lock:
@@ -224,14 +244,30 @@ class FaultPlan:
         return plan
 
     # -- firing --------------------------------------------------------
-    def _fire(self, site: str) -> None:
+    def _hits(self, site: str) -> List[Rule]:
+        """Count one call to ``site`` and return the triggered rules."""
         with self._lock:
             count = self._counts.get(site, 0) + 1
             self._counts[site] = count
-            hits = [
+            return [
                 r for r in self._rules
                 if r.site == site and r.triggered(count, self._rng)
             ]
+
+    def decide(self, site: str) -> List[Rule]:
+        """Triggered rules for ``site`` *without executing them* — the
+        selection half of :meth:`_fire` for consumers (faultnet) that
+        interpret the rule themselves.  Counts the call like ``fire``
+        does, and counts each triggered rule as an injected fault."""
+        hits = self._hits(site)
+        if hits:
+            from sparkdl_tpu.utils.metrics import metrics
+
+            metrics.counter("resilience.injected_faults").add(len(hits))
+        return hits
+
+    def _fire(self, site: str) -> None:
+        hits = [r for r in self._hits(site) if r.act is None]
         for rule in hits:
             from sparkdl_tpu.utils.metrics import metrics
 
@@ -256,12 +292,29 @@ class FaultPlan:
 _ACTIVE: Optional[FaultPlan] = None
 
 
+def installed_plan() -> Optional[FaultPlan]:
+    """The currently active plan, if any (read-only introspection)."""
+    return _ACTIVE
+
+
 def fire(site: str) -> None:
     """Fault-injection hook: no-op unless a plan is active and has a
-    matching, triggered rule for ``site``."""
+    matching, triggered rule for ``site``.  ``act=`` rules are never
+    executed here — use :func:`decide` for interpreted sites."""
     plan = _ACTIVE
     if plan is not None:
         plan._fire(site)
+
+
+def decide(site: str) -> List[Rule]:
+    """Selection-only hook: the triggered rules for ``site`` under the
+    active plan, for the caller to interpret (``serving.faultnet``'s
+    corrupt/truncate/dup verbs can't be expressed as a raised
+    exception).  Empty list when no plan is active."""
+    plan = _ACTIVE
+    if plan is None:
+        return []
+    return plan.decide(site)
 
 
 @contextmanager

@@ -214,6 +214,16 @@ class MicroBatcher:
             if deadline_ms is not None
             else None
         )
+        if deadline is not None and deadline <= time.monotonic():
+            # expired on arrival (upstream ships *remaining* budget):
+            # fail fast without burning a queue slot or a batch seat
+            metrics.counter("serving.expired").add(1)
+            fut: Future = Future()
+            fut.set_exception(DeadlineExceeded(
+                f"request to {self.model_id!r} expired before submit "
+                f"({deadline_ms}ms budget)"
+            ))
+            return fut
         req = Request(value=arr, deadline=deadline, tenant=tenant)
         if tracer.enabled:
             # one span per request, child of the caller's current span;

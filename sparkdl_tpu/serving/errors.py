@@ -71,4 +71,13 @@ class RemoteReplicaError(ServingError, PermanentError):
     """A replica reported a failure class the wire protocol does not
     recognise.  Permanent on purpose: the router must not blind-retry a
     failure it cannot classify (it might be a real model error that
-    would fail identically everywhere)."""
+    would fail identically everywhere).
+
+    Connection-*shaped* classes (``ConnectionError`` / ``TimeoutError``
+    / ``FrameCorrupt``) are in the wire error registry and decode to
+    their own retryable types, so they never land here — reconciling
+    "the router retries connection errors" with "unknown classes are
+    permanent" without weakening either rule.  The ``error-taxonomy``
+    check enforces the invariant this module relies on: every
+    ``ServingError`` subclass inherits exactly one of ``TransientError``
+    / ``PermanentError``."""

@@ -54,7 +54,7 @@ from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.serving import transport as transport_mod
 from sparkdl_tpu.serving import wire
-from sparkdl_tpu.serving.errors import ReplicaDraining
+from sparkdl_tpu.serving.errors import DeadlineExceeded, ReplicaDraining
 from sparkdl_tpu.utils.metrics import metrics
 
 ENV_SPEC = "SPARKDL_REPLICA_SPEC"
@@ -266,6 +266,7 @@ class ReplicaService:
         self._draining = False
         self._m_requests = metrics.counter("supervisor.replica_requests")
         self._m_inflight = metrics.gauge("supervisor.replica_inflight")
+        self._m_expired_shed = metrics.counter("replica.expired_shed")
         # harvest this process's finished spans per trace so replies can
         # piggyback them back to the router for cross-process stitching
         self._harvest = _SpanHarvest()
@@ -363,6 +364,18 @@ class ReplicaService:
         if op != "infer":
             raise ValueError(f"unknown wire op {op!r}")
         span = self._serve_span(msg)
+        deadline_ms = msg.get("deadline_ms")
+        if deadline_ms is not None and float(deadline_ms) <= 0.0:
+            # the router propagates *remaining* milliseconds: non-
+            # positive means the end-to-end deadline is already blown —
+            # shed at the door instead of burning a batch slot on an
+            # answer nobody will read
+            self._m_expired_shed.add(1)
+            self._end_span(span, DeadlineExceeded)
+            raise DeadlineExceeded(
+                f"request arrived at replica pid={os.getpid()} already "
+                f"expired ({deadline_ms}ms remaining)"
+            )
         with self._lock:
             if self._draining:
                 self._end_span(span, ReplicaDraining)
@@ -487,6 +500,12 @@ class ReplicaService:
 
 def main() -> int:
     """Replica process entry: build, warm, serve, drain on SIGTERM."""
+    # a SPARKDL_FAULT_PLAN with faultnet.* rules installs the frame-
+    # level byte-corruption tap in THIS process too, so replica->router
+    # reply frames brown out alongside router->replica requests
+    from sparkdl_tpu.serving import faultnet
+
+    faultnet.arm()
     spec = ReplicaSpec.from_env()
     server = spec.build_server()
     warmup_report: Dict[str, Any] = {}

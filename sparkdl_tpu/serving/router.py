@@ -44,6 +44,9 @@ process load generators (``benchmarks/bench_load.py``) connect to.
 
 from __future__ import annotations
 
+import collections
+import os
+import queue
 import random
 import socket
 import socketserver
@@ -56,6 +59,7 @@ from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.serving import transport as transport_mod
 from sparkdl_tpu.serving import wire
 from sparkdl_tpu.serving.errors import (
+    DeadlineExceeded,
     NoLiveReplicas,
     ServerOverloaded,
 )
@@ -63,6 +67,52 @@ from sparkdl_tpu.utils.metrics import metrics
 
 #: version every backend belongs to unless told otherwise
 DEFAULT_VERSION = "v1"
+
+ENV_HEDGE = "SPARKDL_HEDGE"                       # "0" disables hedging
+ENV_HEDGE_QUANTILE = "SPARKDL_HEDGE_QUANTILE"     # trigger quantile
+ENV_HEDGE_MIN_MS = "SPARKDL_HEDGE_MIN_MS"         # floor on the trigger
+ENV_HEDGE_WARMUP = "SPARKDL_HEDGE_WARMUP"         # samples before hedging
+ENV_RETRY_RATIO = "SPARKDL_RETRY_BUDGET_RATIO"    # tokens earned/request
+ENV_RETRY_BURST = "SPARKDL_RETRY_BUDGET_BURST"    # bucket capacity
+
+#: recent attempt latencies kept for the hedge-trigger quantile
+_HEDGE_WINDOW = 256
+
+
+class _RetryBudget:
+    """Token bucket capping fleet-wide retry *amplification*: every
+    admitted request earns ``ratio`` tokens (capped at ``burst``), and
+    every extra attempt — retry or hedge — spends one.  Under a full
+    brownout the extra-attempt rate is thus bounded at ``ratio`` per
+    request plus a one-off ``burst``, so a bad minute degrades into
+    typed errors instead of a self-amplifying retry storm (the
+    Google-SRE retry-budget idiom).  Denials surface the *last typed
+    error*, never a blind reclassification."""
+
+    def __init__(self, ratio: float, burst: float):
+        self.ratio = float(ratio)
+        self.burst = float(burst)
+        self._tokens = self.burst
+        self._lock = threading.Lock()
+        self._m_spent = metrics.counter("router.retry_budget.spent")
+        self._m_denied = metrics.counter("router.retry_budget.denied")
+        self._m_tokens = metrics.gauge("router.retry_budget.tokens")
+        self._m_tokens.set(self._tokens)
+
+    def earn(self) -> None:
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + self.ratio)
+            self._m_tokens.set(self._tokens)
+
+    def spend(self) -> bool:
+        with self._lock:
+            if self._tokens < 1.0:
+                self._m_denied.add(1)
+                return False
+            self._tokens -= 1.0
+            self._m_tokens.set(self._tokens)
+            self._m_spent.add(1)
+            return True
 
 
 def split_versioned(model_id: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
@@ -145,6 +195,9 @@ class Router:
         request_timeout_s: float = 30.0,
         connect_timeout_s: float = 2.0,
         seed: int = 0,
+        hedge: Optional[bool] = None,
+        retry_budget_ratio: Optional[float] = None,
+        retry_budget_burst: Optional[float] = None,
     ):
         self._lock = threading.Lock()
         self._backends: Dict[str, _Backend] = {}
@@ -158,16 +211,46 @@ class Router:
         self._connect_timeout_s = float(connect_timeout_s)
         self._closed = False
         self._m_requests = metrics.counter("router.requests")
+        self._m_attempts = metrics.counter("router.attempts")
         self._m_retries = metrics.counter("router.retries")
         self._m_errors = metrics.counter("router.errors")
         self._m_shed = metrics.counter("router.shed")
+        self._m_expired = metrics.counter("router.deadline_expired")
         self._m_latency = metrics.histogram("router.latency_ms")
         self._m_inflight = metrics.gauge("router.inflight")
         self._m_replicas = metrics.gauge("router.replicas")
         self._m_weight_fallback = metrics.counter("router.weight_fallback")
+        self._m_hedge_fired = metrics.counter("router.hedge.fired")
+        self._m_hedge_wins = metrics.counter("router.hedge.wins")
         self._vm: Dict[str, _VersionInstruments] = {}
         self._tm: Dict[str, _TenantInstruments] = {}
         self._m_phase: Dict[str, Any] = {}
+        # hedging: a second attempt fires when the first has run past
+        # the recent attempt-latency quantile — a tail-latency rescue,
+        # not a throughput feature, so it needs a warm sample window
+        # and >= 2 live backends before it ever triggers
+        if hedge is None:
+            hedge = os.environ.get(ENV_HEDGE, "1") != "0"
+        self._hedge_enabled = bool(hedge)
+        self._hedge_quantile = float(
+            os.environ.get(ENV_HEDGE_QUANTILE, "0.95")
+        )
+        self._hedge_min_ms = float(os.environ.get(ENV_HEDGE_MIN_MS, "10"))
+        self._hedge_warmup = int(os.environ.get(ENV_HEDGE_WARMUP, "20"))
+        self._attempt_ms: collections.deque = collections.deque(
+            maxlen=_HEDGE_WINDOW
+        )
+        self._sample_lock = threading.Lock()
+        self._retry_budget = _RetryBudget(
+            ratio=(
+                retry_budget_ratio if retry_budget_ratio is not None
+                else float(os.environ.get(ENV_RETRY_RATIO, "0.5"))
+            ),
+            burst=(
+                retry_budget_burst if retry_budget_burst is not None
+                else float(os.environ.get(ENV_RETRY_BURST, "32"))
+            ),
+        )
 
     # ------------------------------------------------------------------
     # membership (the supervisor's side of the interface)
@@ -342,6 +425,169 @@ class Router:
         with self._lock:
             backend.inflight -= 1
 
+    def _observe_attempt_ms(self, ms: float) -> None:
+        with self._sample_lock:
+            self._attempt_ms.append(ms)
+
+    def _hedge_delay_s(self, deadline: float) -> Optional[float]:
+        """Seconds to wait on the primary before firing a hedge, or
+        ``None`` when hedging must stay off: disabled, cold (not enough
+        latency samples), fewer than two live backends, or the deadline
+        already blown.  The trigger is the recent attempt-latency
+        quantile floored at ``hedge_min_ms`` and clamped to half the
+        remaining deadline (a hedge that can't finish is pure load)."""
+        if not self._hedge_enabled:
+            return None
+        with self._lock:
+            live = sum(
+                1 for b in self._backends.values() if not b.removed
+            )
+        if live < 2:
+            return None
+        with self._sample_lock:
+            if len(self._attempt_ms) < self._hedge_warmup:
+                return None
+            samples = sorted(self._attempt_ms)
+        idx = min(
+            len(samples) - 1, int(self._hedge_quantile * len(samples))
+        )
+        delay_ms = max(self._hedge_min_ms, samples[idx])
+        remaining_s = deadline - time.monotonic()
+        if remaining_s <= 0:
+            return None
+        return min(delay_ms / 1000.0, remaining_s / 2.0)
+
+    def _classify(self, exc: BaseException) -> str:
+        """``"retry"`` for connection-shaped or transient-typed
+        failures (the re-place-elsewhere class), ``"raise"`` for
+        permanent ones."""
+        from sparkdl_tpu.resilience.errors import is_transient
+
+        if isinstance(
+            exc, (ConnectionError, OSError, socket.timeout)
+        ) or is_transient(exc):
+            return "retry"
+        return "raise"
+
+    def _one_attempt(self, backend: _Backend, value, base_id,
+                     propagate_deadline: bool, tenant: Optional[str],
+                     deadline: float, span) -> Dict[str, Any]:
+        """One wire round trip on an already-picked backend, charged to
+        its version series and the hedge sample window.  The replica
+        sees the *remaining* milliseconds (when the caller set a
+        deadline at all), so downstream shedding works off the same
+        end-to-end clock.  Always unpicks; per-version latency is
+        per-*attempt* so a retried request doesn't charge the surviving
+        version for time the dying one burned."""
+        vm = self._version_instruments(backend.version)
+        vm.requests.add(1)
+        self._m_attempts.add(1)
+        t0 = time.monotonic()
+        try:
+            reply = self._send_one(
+                backend, value, base_id,
+                (
+                    max(1.0, (deadline - t0) * 1000.0)
+                    if propagate_deadline else None
+                ),
+                tenant,
+                max(0.05, deadline - t0),
+                trace=(span.context() if span is not None else None),
+            )
+        except Exception:
+            vm.errors.add(1)
+            raise
+        finally:
+            self._unpick(backend)
+        ms = (time.monotonic() - t0) * 1000.0
+        vm.latency.observe(ms)
+        self._observe_attempt_ms(ms)
+        return reply
+
+    def _attempt_or_hedge(self, primary: _Backend, tried, pin,
+                          value, base_id, propagate_deadline: bool,
+                          tenant: Optional[str], deadline: float, span):
+        """Attempt on ``primary``; when hedging is warm, race a second
+        attempt on another backend if the primary runs past the trigger
+        latency — first success wins, the loser finishes (and unpicks
+        itself) in the background, since a synchronous socket read
+        can't be cancelled.  Returns ``(reply, winner, t_start)``;
+        failed backends land in ``tried``.  A permanent failure raises
+        immediately; transient ones drain the race then re-raise the
+        last for the outer retry loop.  When hedging can't trigger,
+        this degrades to a plain inline call — no extra threads."""
+        delay = self._hedge_delay_s(deadline)
+        t_start = time.monotonic()
+        if delay is None:
+            try:
+                reply = self._one_attempt(
+                    primary, value, base_id, propagate_deadline,
+                    tenant, deadline, span,
+                )
+            except Exception:
+                tried.add(primary.name)
+                raise
+            return reply, primary, t_start
+
+        q: "queue.SimpleQueue" = queue.SimpleQueue()
+
+        def run(backend: _Backend, is_hedge: bool) -> None:
+            try:
+                r = self._one_attempt(
+                    backend, value, base_id, propagate_deadline,
+                    tenant, deadline, span,
+                )
+                q.put((backend, is_hedge, r, None))
+            except BaseException as exc:
+                q.put((backend, is_hedge, None, exc))
+
+        threading.Thread(
+            target=run, args=(primary, False),
+            name="sparkdl-router-attempt", daemon=True,
+        ).start()
+        in_flight = 1
+        hedge_decided = False
+        last_exc: Optional[BaseException] = None
+        while in_flight:
+            try:
+                item = (
+                    q.get(timeout=delay) if not hedge_decided else q.get()
+                )
+            except queue.Empty:
+                # the primary is out past the trigger: fire the hedge —
+                # if another backend exists and the retry budget allows
+                # the extra attempt (a hedge IS retry amplification)
+                hedge_decided = True
+                hedge = self._pick(tried | {primary.name}, pin=pin)
+                if hedge is None:
+                    continue
+                if not self._retry_budget.spend():
+                    self._unpick(hedge)
+                    continue
+                self._m_hedge_fired.add(1)
+                if span is not None:
+                    span.set_attribute("hedged", True)
+                threading.Thread(
+                    target=run, args=(hedge, True),
+                    name="sparkdl-router-hedge", daemon=True,
+                ).start()
+                in_flight += 1
+                continue
+            in_flight -= 1
+            backend, is_hedge, reply, exc = item
+            if exc is None:
+                if is_hedge:
+                    self._m_hedge_wins.add(1)
+                    if span is not None:
+                        span.set_attribute("hedge_won", True)
+                return reply, backend, t_start
+            tried.add(backend.name)
+            last_exc = exc
+            if self._classify(exc) == "raise":
+                raise exc
+        assert last_exc is not None
+        raise last_exc
+
     def route(
         self,
         value: Any,
@@ -390,7 +636,15 @@ class Router:
                 timeout_s if timeout_s is not None
                 else self._request_timeout_s
             )
+            # the END-TO-END deadline: the caller's deadline_ms and the
+            # router's own timeout budget, whichever is tighter.  Every
+            # attempt below gets the *remaining* time — propagated to
+            # the replica so its batcher can shed work that can no
+            # longer make it, instead of restarting the clock per hop.
             deadline = start + budget
+            if deadline_ms is not None:
+                deadline = min(deadline, start + float(deadline_ms) / 1000.0)
+            self._retry_budget.earn()
             try:
                 inject.fire("router.route")
                 self._m_requests.add(1)
@@ -398,7 +652,25 @@ class Router:
                     tm.requests.add(1)
                 tried: set = set()
                 last_exc: Optional[BaseException] = None
+                retries = 0
                 while True:
+                    if time.monotonic() >= deadline:
+                        self._m_expired.add(1)
+                        self._m_errors.add(1)
+                        if tm is not None:
+                            tm.errors.add(1)
+                        raise DeadlineExceeded(
+                            f"deadline expired in router after {retries} "
+                            f"retr{'y' if retries == 1 else 'ies'}"
+                        ) from last_exc
+                    if retries > 0 and not self._retry_budget.spend():
+                        # budget exhausted: degrade into the last typed
+                        # error instead of amplifying the brownout
+                        self._m_errors.add(1)
+                        if tm is not None:
+                            tm.errors.add(1)
+                        assert last_exc is not None
+                        raise last_exc
                     backend = self._pick(tried, pin=pin)
                     if backend is None:
                         self._m_errors.add(1)
@@ -411,53 +683,37 @@ class Router:
                             f"(version {pin or 'any'}; "
                             f"tried {sorted(tried) or 'none'})"
                         )
-                    vm = self._version_instruments(backend.version)
-                    vm.requests.add(1)
-                    attempt_start = time.monotonic()
                     try:
-                        reply = self._send_one(
-                            backend, value, base_id, deadline_ms, tenant,
-                            max(0.05, deadline - time.monotonic()),
-                            trace=(
-                                span.context() if span is not None else None
-                            ),
+                        reply, winner, attempt_start = self._attempt_or_hedge(
+                            backend, tried, pin, value, base_id,
+                            deadline_ms is not None, tenant, deadline, span,
                         )
-                    except (ConnectionError, OSError, socket.timeout) as exc:
-                        # the stranded-request case: the replica died
-                        # (or wedged) under this request — re-place it
-                        vm.errors.add(1)
-                        tried.add(backend.name)
-                        last_exc = exc
-                        self._m_retries.add(1)
-                        continue
                     except Exception as exc:
                         from sparkdl_tpu.resilience.errors import is_transient
 
-                        vm.errors.add(1)
-                        if is_transient(exc):
-                            # draining / replica-side shed: try elsewhere
-                            tried.add(backend.name)
+                        if isinstance(
+                            exc, (ConnectionError, OSError, socket.timeout)
+                        ) or is_transient(exc):
+                            # stranded or transiently-refused: re-place
+                            # on a backend we haven't burned yet
                             last_exc = exc
+                            retries += 1
                             self._m_retries.add(1)
+                            if span is not None:
+                                span.set_attribute("retries", retries)
                             continue
                         self._m_errors.add(1)
                         if tm is not None:
                             tm.errors.add(1)
                         raise
-                    finally:
-                        self._unpick(backend)
                     now = time.monotonic()
-                    # per-version latency is per-*attempt* so a retried
-                    # request doesn't charge the surviving version for
-                    # time the dying one burned
-                    vm.latency.observe((now - attempt_start) * 1000.0)
                     self._m_latency.observe((now - start) * 1000.0)
                     if tm is not None:
                         tm.latency.observe((now - start) * 1000.0)
                     shipped = reply.pop("spans", None)
                     if span is not None:
-                        span.set_attribute("replica", backend.name)
-                        span.set_attribute("version", backend.version)
+                        span.set_attribute("replica", winner.name)
+                        span.set_attribute("version", winner.version)
                         for remote_span in shipped or ():
                             tracer.ingest(remote_span)
                     self._decompose(

@@ -34,6 +34,22 @@ targets).  Negotiation: a replica advertises its lanes in the ready
 line, the supervisor forwards them to ``router.add``, and
 ``SPARKDL_WIRE_TRANSPORT`` (``auto``/``tcp``/``shm``) picks the lane
 on the router side.
+
+Every request is stamped with a per-channel ``seq`` number the reply
+must echo; a reply carrying the wrong ``seq`` (a duplicated frame, a
+desynced stream) is refused as ``ConnectionError`` and the channel is
+dropped — a stale reply can never be returned for the wrong request.
+
+Env knobs (constructor args override)::
+
+    SPARKDL_WIRE_TRANSPORT      auto | tcp | shm        (default auto)
+    SPARKDL_WIRE_SHM_DISABLE    "1": replica refuses shm (default 0)
+    SPARKDL_WIRE_SHM_RING       per-direction ring bytes (default 1MiB)
+    SPARKDL_WIRE_COALESCE       "0" disables TCP group commit
+    SPARKDL_WIRE_COALESCE_MS    extra flush window, ms   (default 0)
+    SPARKDL_WIRE_POOL_IDLE_S    pooled-socket age-out    (default 30)
+    SPARKDL_SEND_TIMEOUT_S      server->client shm send bound (default 30)
+    SPARKDL_FAULTNET            "1": wrap transports in FaultyTransport
 """
 
 from __future__ import annotations
@@ -60,6 +76,8 @@ ENV_RING_BYTES = "SPARKDL_WIRE_SHM_RING"      # per-direction ring capacity
 ENV_COALESCE = "SPARKDL_WIRE_COALESCE"        # "0" disables TCP coalescing
 ENV_COALESCE_MS = "SPARKDL_WIRE_COALESCE_MS"  # extra flush window (default 0)
 ENV_POOL_IDLE_S = "SPARKDL_WIRE_POOL_IDLE_S"  # pooled-socket age-out window
+ENV_SEND_TIMEOUT_S = "SPARKDL_SEND_TIMEOUT_S"  # server->client send bound
+ENV_FAULTNET = "SPARKDL_FAULTNET"             # wrap lanes in FaultyTransport
 
 #: discard pooled sockets idle longer than this — a replica that was
 #: replaced behind the same name while traffic was quiet should cost a
@@ -69,7 +87,9 @@ DEFAULT_POOL_IDLE_S = 30.0
 DEFAULT_RING_BYTES = 1 << 20
 _POLL_SPIN = 32           # busy polls before blocking on the doorbell
 _POLL_SLEEP_S = 0.0001
-_SERVER_SEND_TIMEOUT_S = 30.0
+_SERVER_SEND_TIMEOUT_S = float(
+    os.environ.get(ENV_SEND_TIMEOUT_S, "30.0")
+)
 
 #: one byte rung on the TCP side-channel to wake a peer that advertised
 #: (via the ring's waiter flag) that it is blocked in select().  0x00
@@ -171,14 +191,22 @@ def make_transport(
     mode = mode or os.environ.get(ENV_TRANSPORT, "auto")
     if mode not in ("auto", "tcp", "shm"):
         raise ValueError(f"unknown wire transport mode {mode!r}")
-    if mode != "tcp":
-        if "shm" in lanes and shm_supported():
-            return ShmTransport(host, port, connect_timeout_s, io_timeout_s)
+    picked: Transport
+    if mode != "tcp" and "shm" in lanes and shm_supported():
+        picked = ShmTransport(host, port, connect_timeout_s, io_timeout_s)
+    else:
         if mode == "shm":
             # explicitly requested but the replica does not offer it —
             # the transparent-fallback contract still applies
             metrics.counter("wire.shm.fallback").add(1)
-    return TcpTransport(host, port, connect_timeout_s, io_timeout_s)
+        picked = TcpTransport(host, port, connect_timeout_s, io_timeout_s)
+    if os.environ.get(ENV_FAULTNET, "0") == "1":
+        # lazy import: faultnet imports this module for the Transport
+        # protocol, and the wrap only exists under an active chaos run
+        from sparkdl_tpu.serving.faultnet import FaultyTransport
+
+        picked = FaultyTransport(picked)
+    return picked
 
 
 # ---------------------------------------------------------------------------
@@ -199,6 +227,34 @@ def _stamp_wire(reply: Any, wire_ms: float) -> Any:
     return reply
 
 
+#: process-wide request sequence — uniqueness across every channel in
+#: the process is what makes a cross-channel mixup detectable too
+_req_seq = itertools.count(1)
+
+
+def _stamp_seq(msg: Dict[str, Any]) -> Tuple[Dict[str, Any], int]:
+    """Shallow-copy ``msg`` with the next request sequence number — the
+    caller's dict is never mutated, so a hedge/retry re-stamps its own
+    copy and replies can be matched to the exact attempt."""
+    seq = next(_req_seq)
+    stamped = dict(msg)
+    stamped["seq"] = seq
+    return stamped, seq
+
+
+def _check_seq(reply: Any, seq: int) -> Any:
+    """Refuse a reply that does not echo our ``seq``: a duplicated
+    frame or a desynced reply stream must surface as a retryable
+    ``ConnectionError`` (the channel is dropped by the caller), never
+    as the wrong request's tensor."""
+    if isinstance(reply, dict) and reply.get("seq", seq) != seq:
+        raise ConnectionError(
+            f"reply desync: sent seq {seq}, reply echoes "
+            f"{reply.get('seq')!r} — duplicated or reordered frame"
+        )
+    return reply
+
+
 def _sock_is_stale(sock) -> bool:
     """True when a pooled *idle* socket must not carry the next request.
     The wire protocol is strictly request/reply, so an idle socket with
@@ -214,10 +270,12 @@ def _sock_is_stale(sock) -> bool:
 
 
 class _Slot:
-    __slots__ = ("msg", "done", "reply", "exc")
+    __slots__ = ("msg", "seq", "deadline", "done", "reply", "exc")
 
-    def __init__(self, msg: Dict[str, Any]):
+    def __init__(self, msg: Dict[str, Any], seq: int, deadline: float):
         self.msg = msg
+        self.seq = seq
+        self.deadline = deadline
         self.done = threading.Event()
         self.reply: Optional[Dict[str, Any]] = None
         self.exc: Optional[BaseException] = None
@@ -247,8 +305,8 @@ class _Coalescer:
         self._sock: Optional[socket.socket] = None
 
     def request(self, msg: Dict[str, Any], timeout_s: float) -> Dict[str, Any]:
-        slot = _Slot(msg)
         deadline = time.monotonic() + timeout_s
+        slot = _Slot(*_stamp_seq(msg), deadline)
         with self._lock:
             if self._closed:
                 raise ConnectionError("transport closed")
@@ -310,8 +368,15 @@ class _Coalescer:
                 sock = wire.connect(
                     self._host, self._port, self._connect_timeout_s
                 )
-                sock.settimeout(self._io_timeout_s)
                 self._sock = sock
+            # the leader blocks in recv on behalf of every rider: bound
+            # the wait by the tightest deadline in the batch so a
+            # stalled socket surfaces as a typed timeout while the
+            # riders' end-to-end budgets can still buy a retry
+            remaining = min(s.deadline for s in batch) - time.monotonic()
+            sock.settimeout(
+                min(self._io_timeout_s, max(0.05, remaining))
+            )
             t0 = time.perf_counter()
             if len(batch) == 1:
                 wire.sendall_parts(
@@ -340,6 +405,15 @@ class _Coalescer:
                 metrics.counter("wire.coalesced_msgs").add(len(batch))
                 metrics.counter("wire.batch_frames").add(1)
         except Exception as exc:
+            self._drop_sock()
+            self._fail(batch, exc)
+            return
+        try:
+            # verify every echo before releasing ANY waiter: a desynced
+            # stream invalidates the whole frame, not just one slot
+            for slot, reply in zip(batch, replies):
+                _check_seq(reply, slot.seq)
+        except ConnectionError as exc:
             self._drop_sock()
             self._fail(batch, exc)
             return
@@ -408,12 +482,15 @@ class TcpTransport(Transport):
         if self._coalescer is not None:
             return self._coalescer.request(msg, timeout_s)
         sock = self._checkout()
+        msg, seq = _stamp_seq(msg)
         try:
             sock.settimeout(timeout_s)
             t0 = time.perf_counter()
             wire.sendall_parts(sock, wire.encode_parts(msg, wire.KIND_MSG))
             wire_ms = (time.perf_counter() - t0) * 1000.0
             reply = wire.recv_msg(sock)
+            if reply is not None:
+                _check_seq(reply, seq)
         except BaseException:
             try:
                 sock.close()
@@ -688,6 +765,7 @@ class _ShmClientChannel:
         inject.fire("wire.shm")
         deadline = time.monotonic() + timeout_s
         t0 = time.perf_counter()
+        msg, seq = _stamp_seq(msg)
         parts = wire.encode_parts(msg, wire.KIND_MSG)
         total = wire.parts_len(parts)
         assert self._tx is not None and self._rx is not None
@@ -718,7 +796,7 @@ class _ShmClientChannel:
                 kind, obj = wire.decode_frame(record)
                 if kind != wire.KIND_MSG:
                     raise ConnectionError("unexpected batch frame on shm ring")
-                return _stamp_wire(obj, wire_ms)
+                return _stamp_wire(_check_seq(obj, seq), wire_ms)
             if spins < _POLL_SPIN:
                 # pure ring polls — no syscalls until we decide to block
                 spins += 1
@@ -742,7 +820,7 @@ class _ShmClientChannel:
                             raise ConnectionError(
                                 "unexpected batch frame on shm side-channel"
                             )
-                        return _stamp_wire(obj, wire_ms)
+                        return _stamp_wire(_check_seq(obj, seq), wire_ms)
             finally:
                 self._rx.set_waiter(False)
 
@@ -1037,13 +1115,24 @@ def serve_connection(
                         replies = handle_batch(msg)
                     else:
                         replies = [_safe(handle_one, m) for m in msg]
+                    for m, r in zip(msg, replies):
+                        _echo_seq(m, r)
                     chan.send(replies, kind=wire.KIND_BATCH)
                 else:
-                    chan.send(_safe(handle_one, msg))
+                    chan.send(_echo_seq(msg, _safe(handle_one, msg)))
             except (ConnectionError, OSError):
                 return
     finally:
         chan.close()
+
+
+def _echo_seq(msg: Any, reply: Any) -> Any:
+    """Echo the request's ``seq`` onto its reply — done centrally here
+    so every handler (real replica service or test stub) satisfies the
+    client-side desync check without knowing the field exists."""
+    if isinstance(msg, dict) and isinstance(reply, dict) and "seq" in msg:
+        reply["seq"] = msg["seq"]
+    return reply
 
 
 def _safe(

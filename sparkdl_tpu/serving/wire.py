@@ -31,6 +31,12 @@ A half-written frame from a killed replica surfaces as a clean
 ``ConnectionError`` the router can retry — bad magic, truncated
 header, truncated body, or a descriptor that disagrees with the
 payload length all refuse loudly, never a torn or garbage array.
+Structural checks can't catch a *bit flip inside a tensor body*, so
+every frame also carries a 4-byte checksum trailer (``flags`` bit
+:data:`FLAG_CRC`, covering prefix + meta + body); a mismatch raises
+the typed transient :class:`FrameCorrupt` and counts ``wire.crc_fail``.
+``SPARKDL_WIRE_CRC=0`` disables stamping (decode always honors the
+flag on the frame itself).
 
 Security note: meta is **pickle** and the sockets bind loopback by
 default — this is an intra-host data plane between processes the
@@ -47,12 +53,14 @@ permanent — survive the hop), falling back to
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
 import sys
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -61,6 +69,43 @@ KIND_MSG = 1
 KIND_BATCH = 2
 
 _PREFIX = struct.Struct(">4sBBIQ")  # magic, kind, flags, meta_len, body_len
+
+#: flags bit 0: a 4-byte checksum trailer follows the body, covering
+#: prefix + meta + body.  The checksum is ``zlib.crc32`` — C-speed in
+#: the stdlib; true CRC32C (Castagnoli) needs a native wheel this
+#: environment doesn't ship, and the polynomial is a one-line swap here
+#: if one ever lands.  Flag-driven so a CRC-less peer (older frame, or
+#: ``SPARKDL_WIRE_CRC=0``) still decodes.
+FLAG_CRC = 0x01
+
+_CRC = struct.Struct(">I")
+
+#: encode-side knob; decode always honors the flag on the frame itself
+_CRC_ENABLED = os.environ.get(
+    "SPARKDL_WIRE_CRC", "1"
+).lower() not in ("0", "false", "off")
+
+
+class FrameCorrupt(ConnectionError):
+    """A frame whose checksum trailer disagrees with its bytes — the
+    payload was damaged in flight (flipped bit, torn ring record, a
+    proxy that rewrote us).  Subclasses ``ConnectionError`` so every
+    existing retry/fallback path already treats it as transient, and so
+    this module stays importable standalone (no package imports)."""
+
+
+#: optional hook over every encoded frame's parts, installed by
+#: ``serving.faultnet`` to damage frames *after* the CRC trailer is
+#: stamped (corrupt / truncate / duplicate / disconnect / stall) on
+#: whichever lane consumes the encode — TCP sendmsg, shm ring, spill.
+#: Must stay None-by-default: wire imports nothing from faultnet.
+_SEND_TAP: Optional[Callable[[List[Any]], List[Any]]] = None
+
+
+def set_send_tap(tap: Optional[Callable[[List[Any]], List[Any]]]) -> None:
+    """Install (or clear, with None) the frame send tap."""
+    global _SEND_TAP
+    _SEND_TAP = tap
 
 #: refuse frames beyond this (a torn prefix must not allocate GBs)
 MAX_FRAME_BYTES = 256 * 1024 * 1024
@@ -76,8 +121,9 @@ _TENSOR_MARK = "\x00sdw-tensor\x00"
 #: ``tests/test_wire.py`` roundtrip fixtures, so a field cannot ship
 #: without a codec roundtrip proving it survives both lanes.
 ENVELOPE_FIELDS = frozenset({
-    # requests
-    "op", "model_id", "value", "deadline_ms", "tenant", "trace",
+    # requests ("seq" is the per-channel request sequence number the
+    # reply must echo — the duplicate/desynced-reply detector)
+    "op", "model_id", "value", "deadline_ms", "tenant", "trace", "seq",
     # shm lane upgrade handshake
     "shm", "ring_bytes",
     # replies
@@ -147,13 +193,22 @@ def encode_parts(obj: Any, kind: int = KIND_MSG) -> List[Any]:
 
     envelope = walk(obj)
     meta = pickle.dumps((envelope, descs), protocol=pickle.HIGHEST_PROTOCOL)
-    head = _PREFIX.pack(MAGIC, kind, 0, len(meta), offset)
+    flags = FLAG_CRC if _CRC_ENABLED else 0
+    head = _PREFIX.pack(MAGIC, kind, flags, len(meta), offset)
+    parts: List[Any] = [head + meta, *buffers]
+    if flags & FLAG_CRC:
+        crc = zlib.crc32(meta, zlib.crc32(head))
+        for buf in buffers:
+            crc = zlib.crc32(buf, crc)
+        parts.append(_CRC.pack(crc))
     timer = _timer("wire.serialize_seconds")
     if timer is not None:
         timer.add_seconds(time.perf_counter() - t0)
         _count("wire.frames_out", 1)
-        _count("wire.bytes_out", len(head) + len(meta) + offset)
-    return [head + meta, *buffers]
+        _count("wire.bytes_out", parts_len(parts))
+    if _SEND_TAP is not None:
+        parts = _SEND_TAP(parts)
+    return parts
 
 
 def parts_len(parts: Sequence[Any]) -> int:
@@ -198,8 +253,8 @@ def _fill(sock: socket.socket, view: memoryview,
     return True
 
 
-def _parse_prefix(head: bytes) -> Tuple[int, int, int]:
-    magic, kind, _flags, meta_len, body_len = _PREFIX.unpack(head)
+def _parse_prefix(head: bytes) -> Tuple[int, int, int, int]:
+    magic, kind, flags, meta_len, body_len = _PREFIX.unpack(head)
     if magic != MAGIC:
         raise ConnectionError(
             f"bad frame magic {magic!r} — torn or foreign stream"
@@ -211,7 +266,24 @@ def _parse_prefix(head: bytes) -> Tuple[int, int, int]:
             f"frame of {meta_len + body_len} bytes exceeds MAX_FRAME_BYTES "
             f"({MAX_FRAME_BYTES}) — torn or hostile stream"
         )
-    return kind, meta_len, body_len
+    return kind, flags, meta_len, body_len
+
+
+def _verify_crc(head: bytes, meta: bytes, body: memoryview,
+                trailer: bytes) -> None:
+    """Checksum prefix+meta+body against the 4-byte trailer; a mismatch
+    is :class:`FrameCorrupt` — counted, typed, retried elsewhere.  The
+    prefix is covered too, so a flipped length byte that still parses
+    lands here instead of decoding garbage."""
+    crc = zlib.crc32(meta, zlib.crc32(head))
+    crc = zlib.crc32(body, crc)
+    (want,) = _CRC.unpack(trailer)
+    if crc != want:
+        _count("wire.crc_fail", 1)
+        raise FrameCorrupt(
+            f"frame checksum mismatch: computed {crc:#010x}, trailer "
+            f"says {want:#010x} — payload damaged in flight"
+        )
 
 
 def _decode(meta: bytes, body: memoryview) -> Any:
@@ -292,12 +364,17 @@ def recv_any(sock: socket.socket,
         _fill(sock, memoryview(head)[len(first):])
     elif not _fill(sock, memoryview(head), eof_ok_at_start=True):
         return None
-    kind, meta_len, body_len = _parse_prefix(bytes(head))
+    kind, flags, meta_len, body_len = _parse_prefix(bytes(head))
     t0 = time.perf_counter()
     meta = bytearray(meta_len)
     body = bytearray(body_len)
     _fill(sock, memoryview(meta))
     _fill(sock, memoryview(body))
+    if flags & FLAG_CRC:
+        trailer = bytearray(_CRC.size)
+        _fill(sock, memoryview(trailer))
+        _verify_crc(bytes(head), bytes(meta), memoryview(body),
+                    bytes(trailer))
     timer = _timer("wire.copy_seconds")
     if timer is not None:
         timer.add_seconds(time.perf_counter() - t0)
@@ -314,15 +391,21 @@ def decode_frame(frame: bytearray) -> Tuple[int, Any]:
         raise ConnectionError(
             f"truncated frame: {len(frame)} bytes < prefix"
         )
-    kind, meta_len, body_len = _parse_prefix(bytes(frame[:_PREFIX.size]))
-    if len(frame) != _PREFIX.size + meta_len + body_len:
+    kind, flags, meta_len, body_len = _parse_prefix(
+        bytes(frame[:_PREFIX.size])
+    )
+    tail = _CRC.size if flags & FLAG_CRC else 0
+    if len(frame) != _PREFIX.size + meta_len + body_len + tail:
         raise ConnectionError(
             f"frame length mismatch: have {len(frame)}, prefix declares "
-            f"{_PREFIX.size + meta_len + body_len}"
+            f"{_PREFIX.size + meta_len + body_len + tail}"
         )
     view = memoryview(frame)
     meta = bytes(view[_PREFIX.size:_PREFIX.size + meta_len])
-    body = view[_PREFIX.size + meta_len:]
+    body = view[_PREFIX.size + meta_len:_PREFIX.size + meta_len + body_len]
+    if tail:
+        _verify_crc(bytes(view[:_PREFIX.size]), meta, body,
+                    bytes(view[len(frame) - tail:]))
     return kind, _decode(meta, body)
 
 
@@ -380,6 +463,13 @@ def _error_registry() -> Dict[str, type]:
         for cls in (CircuitOpen, DeadlineExceeded, PermanentError,
                     TransientError)
     }
+    # connection-shaped failures must stay *transient* across the hop:
+    # a replica that hit FrameCorrupt / ConnectionError / TimeoutError
+    # talking to its own dependencies would otherwise decode router-side
+    # as the permanent RemoteReplicaError and never be retried
+    registry["FrameCorrupt"] = FrameCorrupt
+    registry["ConnectionError"] = ConnectionError
+    registry["TimeoutError"] = TimeoutError
     for name in serving_errors.__dict__:
         obj = serving_errors.__dict__[name]
         if isinstance(obj, type) and issubclass(obj, Exception):

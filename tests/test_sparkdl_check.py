@@ -71,12 +71,12 @@ def rule_lines(report, rule_id):
 # framework plumbing
 # ---------------------------------------------------------------------------
 
-def test_registry_has_all_thirteen_rules():
+def test_registry_has_all_fourteen_rules():
     assert set(all_rule_ids()) == {
         "lock-order", "lock-blocking", "host-sync", "recompile-hazard",
         "donation-safety", "contextvar-leak", "sleep-retry", "metric-name",
         "raw-jit", "exception-safety", "resource-lifecycle",
-        "fault-site-coverage", "wire-envelope",
+        "fault-site-coverage", "wire-envelope", "error-taxonomy",
     }
 
 
@@ -1816,5 +1816,126 @@ def test_wire_envelope_skips_without_schema_or_fixtures(tmp_path):
                 """,
         },
         rules=["wire-envelope"],
+    )
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# error-taxonomy (cross-file: serving family vs resilience bases)
+# ---------------------------------------------------------------------------
+
+_TAXONOMY_BASES = """
+    class FaultError(RuntimeError):
+        pass
+
+    class TransientError(FaultError):
+        pass
+
+    class PermanentError(FaultError):
+        pass
+    """
+
+_SERVING_BASE = """
+    from resilience.errors import (
+        PermanentError,
+        TransientError,
+    )
+
+    class ServingError(RuntimeError):
+        pass
+
+    class ServerOverloaded(ServingError, TransientError):
+        pass
+
+    class ServerClosed(ServingError, PermanentError):
+        pass
+    """
+
+
+def test_error_taxonomy_flags_unclassified_subclass(tmp_path):
+    """A ServingError subclass inheriting neither TransientError nor
+    PermanentError silently classifies as permanent — flagged."""
+    report = check_files(
+        tmp_path,
+        {
+            "resilience/errors.py": _TAXONOMY_BASES,
+            "serving/errors.py": _SERVING_BASE,
+            "serving/extra.py": """
+                from serving.errors import ServingError
+
+                class MysteryError(ServingError):
+                    pass
+                """,
+        },
+        rules=["error-taxonomy"],
+    )
+    assert len(report.findings) == 1, [f.message for f in report.findings]
+    f = report.findings[0]
+    assert "'MysteryError'" in f.message and "neither" in f.message
+    assert f.path == "serving/extra.py"
+
+
+def test_error_taxonomy_flags_double_classification(tmp_path):
+    """Inheriting BOTH classifications is contradictory — flagged."""
+    report = check_files(
+        tmp_path,
+        {
+            "resilience/errors.py": _TAXONOMY_BASES,
+            "serving/errors.py": _SERVING_BASE,
+            "serving/extra.py": """
+                from resilience.errors import (
+                    PermanentError,
+                    TransientError,
+                )
+                from serving.errors import ServingError
+
+                class ConfusedError(
+                    ServingError, TransientError, PermanentError
+                ):
+                    pass
+                """,
+        },
+        rules=["error-taxonomy"],
+    )
+    assert len(report.findings) == 1, [f.message for f in report.findings]
+    assert "BOTH" in report.findings[0].message
+
+
+def test_error_taxonomy_clean_family_is_quiet(tmp_path):
+    """Classification through intermediate bases and import aliases
+    counts: the real tree's DeadlineExceeded-as-_DeadlineExpired shape
+    must pass, as must subclass-of-classified (TenantThrottled)."""
+    report = check_files(
+        tmp_path,
+        {
+            "resilience/errors.py": _TAXONOMY_BASES,
+            "serving/errors.py": _SERVING_BASE,
+            "resilience/extra.py": """
+                from resilience.errors import PermanentError
+
+                class DeadlineExpiredBase(PermanentError):
+                    pass
+                """,
+            "serving/extra.py": """
+                from resilience.extra import (
+                    DeadlineExpiredBase as _DeadlineExpired,
+                )
+                from serving.errors import ServerOverloaded, ServingError
+
+                class DeadlineExceeded(ServingError, _DeadlineExpired):
+                    pass
+
+                class TenantThrottled(ServerOverloaded):
+                    pass
+                """,
+            "serving/other.py": """
+                class NotAnError:
+                    pass
+
+                class FrameCorrupt(ConnectionError):
+                    pass  # outside the ServingError family: exempt
+                """,
+        },
+        rules=["error-taxonomy"],
     )
     assert report.findings == [], [f.message for f in report.findings]

@@ -136,7 +136,7 @@ class TestEnvelopeSchema:
         "request": {
             "op": "infer", "model_id": "ep0", "value": None,
             "deadline_ms": 12.5, "tenant": "team-a",
-            "trace": (12345, 67890),
+            "trace": (12345, 67890), "seq": 7,
         },
         "shm_handshake": {
             "op": "shm_attach", "shm": "psm_fixture",
@@ -147,7 +147,7 @@ class TestEnvelopeSchema:
             "phases": {"wire": 0.1, "transport": 0.4},
             "spans": [{"name": "replica.serve", "trace_id": 12345}],
             "pid": 4242, "draining": False,
-            "replicas": ("replica-0",),
+            "replicas": ("replica-0",), "seq": 7,
         },
         "error": {
             "ok": False, "error": "boom",
@@ -586,5 +586,250 @@ class TestPoolStaleness:
             assert len(srv.conns) == 2
             t.close()
         finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# ----------------------------------------------------------------------
+# CRC-verified frames (ISSUE-14): a flipped byte anywhere in the frame
+# is detected and typed — never decoded into a silently-wrong tensor
+# ----------------------------------------------------------------------
+class TestFrameCrc:
+    def test_frames_carry_the_crc_flag(self):
+        raw = frame_bytes({"v": np.ones(4, np.float32)})
+        _, flags, _, _ = wire._parse_prefix(bytes(raw[:PREFIX.size]))
+        assert flags & wire.FLAG_CRC
+
+    @pytest.mark.parametrize("where", ["meta", "body", "trailer"])
+    def test_single_flipped_byte_is_detected(self, where):
+        raw = frame_bytes({"v": np.arange(64, dtype=np.float32)})
+        index = {
+            "meta": PREFIX.size + 2,
+            "body": len(raw) - wire._CRC.size - 5,
+            "trailer": len(raw) - 1,
+        }[where]
+        raw[index] ^= 0x40
+        before = metrics.counter("wire.crc_fail").value
+        with pytest.raises(wire.FrameCorrupt):
+            wire.decode_frame(raw)
+        assert metrics.counter("wire.crc_fail").value == before + 1
+
+    def test_corrupt_frame_over_socket_is_typed(self):
+        raw = frame_bytes({"v": np.arange(16, dtype=np.float32)})
+        raw[len(raw) - wire._CRC.size - 3] ^= 0x01
+        a, b = socket.socketpair()
+        try:
+            a.sendall(bytes(raw))
+            a.close()
+            with pytest.raises(ConnectionError):
+                wire.recv_msg(b)
+        finally:
+            b.close()
+
+    def test_crc_off_roundtrip(self, monkeypatch):
+        monkeypatch.setattr(wire, "_CRC_ENABLED", False)
+        raw = frame_bytes({"v": np.ones(4, np.float32)})
+        _, flags, _, _ = wire._parse_prefix(bytes(raw[:PREFIX.size]))
+        assert not (flags & wire.FLAG_CRC)
+        _, got = wire.decode_frame(raw)
+        np.testing.assert_array_equal(got["v"], np.ones(4, np.float32))
+
+    def test_decode_honours_frame_flag_not_env(self, monkeypatch):
+        # a CRC-stamped frame from a peer with the knob ON must still
+        # verify locally even when THIS process has encoding turned off
+        raw = frame_bytes({"v": np.arange(8, dtype=np.float32)})
+        monkeypatch.setattr(wire, "_CRC_ENABLED", False)
+        raw[-2] ^= 0x10
+        with pytest.raises(wire.FrameCorrupt):
+            wire.decode_frame(raw)
+
+    def test_framecorrupt_is_transient_and_registry_typed(self):
+        from sparkdl_tpu.resilience.errors import is_transient
+
+        exc = wire.FrameCorrupt("x")
+        assert isinstance(exc, ConnectionError)
+        assert is_transient(exc)
+        # the registry round-trips it (and plain connection-shaped
+        # classes) typed, never as the permanent RemoteReplicaError
+        for cls in ("FrameCorrupt", "ConnectionError", "TimeoutError"):
+            decoded = wire.decode_error(
+                {"ok": False, "error_class": cls, "error": "x"}
+            )
+            assert not isinstance(decoded, RemoteReplicaError), cls
+        assert isinstance(
+            wire.decode_error(
+                {"ok": False, "error_class": "FrameCorrupt", "error": "x"}
+            ),
+            wire.FrameCorrupt,
+        )
+
+
+# ----------------------------------------------------------------------
+# seq stamping: the duplicated/reordered-reply defense
+# ----------------------------------------------------------------------
+class TestSeqEcho:
+    def test_check_seq_passes_on_echo_and_absence(self):
+        assert transport._check_seq({"ok": True, "seq": 9}, 9)["ok"]
+        # a peer that predates the field: absence is not a desync
+        assert transport._check_seq({"ok": True}, 9)["ok"]
+
+    def test_check_seq_raises_on_mismatch(self):
+        with pytest.raises(ConnectionError, match="desync"):
+            transport._check_seq({"ok": True, "seq": 8}, 9)
+
+    def test_replies_echo_seq_end_to_end(self):
+        srv, port = start_echo()
+        try:
+            t = transport.TcpTransport("127.0.0.1", port, coalesce=False)
+            reply = t.request(
+                {"op": "infer", "value": np.ones(4, np.float32)}, 5.0
+            )
+            assert isinstance(reply.get("seq"), int)
+            t.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_duplicated_reply_desyncs_then_recovers(self):
+        # a dup'd request frame makes the server answer twice, leaving
+        # a stale extra reply in the socket.  Two independent defenses
+        # race to catch it — which one wins depends on whether the
+        # stale bytes land before the next checkout:
+        #   * pool staleness probe: readable-while-idle => the poisoned
+        #     socket is discarded and the request rides a fresh dial
+        #   * seq echo: the stale reply is read => typed "desync" error
+        #     and the socket is dropped
+        # Either way the invariant is the same: NEVER a wrong result,
+        # one of the defenses provably fired, and the request after
+        # that succeeds on a clean socket.
+        srv, port = start_echo()
+        fired = []
+
+        def dup_once(parts):
+            if not fired:
+                fired.append(True)
+                return list(parts) + [bytes(p) for p in parts]
+            return parts
+
+        t = transport.TcpTransport("127.0.0.1", port, coalesce=False)
+        try:
+            x = np.ones(4, np.float32)
+            stale_before = metrics.counter("wire.pool.stale").value
+            wire.set_send_tap(dup_once)
+            try:
+                reply = t.request({"op": "infer", "value": x}, 5.0)
+                np.testing.assert_array_equal(reply["result"], x * 2)
+                desynced = False
+                try:
+                    reply = t.request({"op": "infer", "value": x}, 5.0)
+                except ConnectionError as exc:
+                    desynced = True
+                    assert "desync" in str(exc)
+                else:
+                    np.testing.assert_array_equal(reply["result"], x * 2)
+            finally:
+                wire.set_send_tap(None)
+            probed = metrics.counter("wire.pool.stale").value > stale_before
+            assert desynced or probed, (
+                "duplicated reply was neither desync-detected nor "
+                "discarded by the pool staleness probe"
+            )
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+        finally:
+            t.close()
+            srv.shutdown()
+            srv.server_close()
+
+
+# ----------------------------------------------------------------------
+# injected network faults on the shm lane (ISSUE-14 satellite): ring,
+# spill side-channel, and the shm->tcp fallback path all detect
+# corruption typed — zero silent wrong answers
+# ----------------------------------------------------------------------
+class TestShmLaneFaults:
+    def _plan(self, **rule_kw):
+        return inject.FaultPlan().add("faultnet.tx", **rule_kw)
+
+    def test_ring_corrupt_frame_is_detected_not_decoded(self):
+        from sparkdl_tpu.serving import faultnet
+
+        srv, port = start_echo()
+        t = transport.ShmTransport("127.0.0.1", port)
+        try:
+            x = np.arange(16, dtype=np.float32)
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+            before = metrics.counter("wire.crc_fail").value
+            with inject.active_plan(self._plan(act="corrupt_body", at=1)):
+                assert faultnet.arm()
+                try:
+                    with pytest.raises(
+                        (ConnectionError, OSError, socket.timeout)
+                    ):
+                        t.request({"op": "infer", "value": x}, 2.0)
+                finally:
+                    faultnet.disarm()
+            assert metrics.counter("wire.crc_fail").value > before
+            # the lane heals: a fresh channel serves clean traffic
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+        finally:
+            t.close()
+            srv.shutdown()
+            srv.server_close()
+        assert my_shm_entries() == []
+
+    def test_spill_lane_corrupt_frame_is_detected(self):
+        from sparkdl_tpu.serving import faultnet
+
+        srv, port = start_echo()
+        t = transport.ShmTransport("127.0.0.1", port)
+        try:
+            big = np.ones((700, 700), np.float32)  # > 1MB ring: spills
+            reply = t.request({"op": "infer", "value": big}, 15.0)
+            np.testing.assert_array_equal(reply["result"], big * 2)
+            before = metrics.counter("wire.crc_fail").value
+            with inject.active_plan(self._plan(act="corrupt_body", at=1)):
+                assert faultnet.arm()
+                try:
+                    with pytest.raises(
+                        (ConnectionError, OSError, socket.timeout)
+                    ):
+                        t.request({"op": "infer", "value": big}, 2.0)
+                finally:
+                    faultnet.disarm()
+            assert metrics.counter("wire.crc_fail").value > before
+        finally:
+            t.close()
+            srv.shutdown()
+            srv.server_close()
+        assert my_shm_entries() == []
+
+    def test_fallback_tcp_lane_detects_corruption_too(self):
+        from sparkdl_tpu.serving import faultnet
+
+        srv, port = start_echo(allow_shm=False)  # forces shm->tcp fall
+        t = transport.ShmTransport("127.0.0.1", port)
+        try:
+            x = np.ones(4, np.float32)
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+            assert t.lane == "tcp"
+            before = metrics.counter("wire.crc_fail").value
+            with inject.active_plan(self._plan(act="corrupt_body", at=1)):
+                assert faultnet.arm()
+                try:
+                    with pytest.raises(
+                        (ConnectionError, OSError, socket.timeout)
+                    ):
+                        t.request({"op": "infer", "value": x}, 2.0)
+                finally:
+                    faultnet.disarm()
+            assert metrics.counter("wire.crc_fail").value > before
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+        finally:
+            t.close()
             srv.shutdown()
             srv.server_close()
