@@ -794,11 +794,22 @@ def run(args):
                         for name, row in snap["targets"].items()
                     },
                 }
+            span_rows = router_sink.spans()
             report["trace"] = dict(
-                _trace_summary(router_sink.spans()),
+                _trace_summary(span_rows),
                 out=trace_path,
             )
             report["fleet"] = fleet_snap
+            if getattr(args, "diag", False):
+                # full attribution report over the same span set the
+                # trace summary counted — BEFORE flush() clears the
+                # in-memory buffer (the JSONL on disk survives for the
+                # offline CLI, but diag here must see this run's spans)
+                from sparkdl_tpu.obs.diag import diagnose
+
+                report["diag"] = diagnose(
+                    span_rows, top=3, registry=metrics,
+                )
             router_sink.flush()
         if args.scenario == "faultnet":
             deltas = {
@@ -866,6 +877,29 @@ def _obs_problems(report):
     fleet = report.get("fleet") or {}
     if not fleet.get("healthy"):
         problems.append(f"no healthy federation target (fleet={fleet})")
+    return problems
+
+
+def _diag_problems(report):
+    """Smoke assertions for ``--diag``: critical-path attribution
+    present and covering >= 90% of the measured e2e p50, and at least
+    one histogram exemplar resolving to a complete stitched trace."""
+    problems = []
+    diag = report.get("diag") or {}
+    attribution = diag.get("attribution") or {}
+    cov = attribution.get("coverage_p50")
+    if cov is None:
+        problems.append("diag report carried no phase attribution")
+    elif cov < 0.9:
+        problems.append(
+            f"critical-path attribution covers {cov:.0%} of e2e p50 "
+            "(want >= 90%)"
+        )
+    exemplars = diag.get("exemplars") or []
+    if not any(e.get("stitched") for e in exemplars):
+        problems.append(
+            "no histogram exemplar resolved to a stitched trace"
+        )
     return problems
 
 
@@ -956,9 +990,17 @@ def main():
                     help="CI mode: short kill run, assert zero "
                     "accepted-request loss + recovery, exit non-zero "
                     "on violation")
+    ap.add_argument("--diag", action="store_true",
+                    help="diagnosis mode: forces --obs on, appends the "
+                    "full trace-analytics attribution report to the run "
+                    "JSON, and runs the pass twice (same seed, sampling "
+                    "profiler armed then unarmed) to measure profiler "
+                    "overhead A/B")
     args = ap.parse_args()
 
-    if args.obs == "auto":
+    if args.diag:
+        args.obs = "on"
+    elif args.obs == "auto":
         args.obs = "on" if args.smoke else "off"
 
     if args.smoke and args.scenario == "rollout":
@@ -1029,6 +1071,43 @@ def main():
             "hedge_on": report_on,
             "hedge_off": report_off,
         }
+    elif args.diag:
+        # the profiler-overhead proof: same seed and traffic shape,
+        # sampler armed (router in-process, replicas via the inherited
+        # SPARKDL_PROFILE env hook) then unarmed — the goodput ratio is
+        # the measured cost of leaving the profiler on in production
+        from sparkdl_tpu.obs import profile as profile_mod
+
+        os.environ[profile_mod.ENV_PROFILE] = "1"
+        prof = profile_mod.enable_from_env()
+        report_on = run(args)
+        prof_snap = prof.snapshot(top=10) if prof is not None else None
+        if prof is not None:
+            prof.stop()
+        del os.environ[profile_mod.ENV_PROFILE]
+        report_off = run(args)
+        g_on = report_on.get("goodput_rps")
+        g_off = report_off.get("goodput_rps")
+        report = {
+            "benchmark": "bench_load",
+            "scenario": args.scenario,
+            "seed": args.seed,
+            "profiler_overhead": {
+                "goodput_on_rps": g_on,
+                "goodput_off_rps": g_off,
+                "overhead_frac": (
+                    round(1.0 - g_on / g_off, 4)
+                    if g_on is not None and g_off else None
+                ),
+                "p99_on_ms": (report_on.get("latency_ms") or {})
+                .get("p99"),
+                "p99_off_ms": (report_off.get("latency_ms") or {})
+                .get("p99"),
+                "profile": prof_snap,
+            },
+            "profile_on": report_on,
+            "profile_off": report_off,
+        }
     else:
         report = run(args)
     print(json.dumps(report, indent=2, default=str))
@@ -1036,6 +1115,11 @@ def main():
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2, default=str)
         print(f"wrote {args.out}", file=sys.stderr)
+
+    if args.diag and "profile_on" in report:
+        # smoke assertions (and --assert-lane) check the armed pass —
+        # the full A/B wrapper was already printed/written above
+        report = report["profile_on"]
 
     if args.assert_lane:
         lanes = set(report.get("router_lanes", {}).values())
@@ -1161,6 +1245,8 @@ def main():
             problems.append("no successful requests at all")
         if args.obs == "on":
             problems.extend(_obs_problems(report))
+        if args.diag:
+            problems.extend(_diag_problems(report))
         if problems:
             print("SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
             _print_fleet_on_fail(report)

@@ -5,6 +5,12 @@
 # suppressed inline (# sparkdl: disable=<rule-id>) nor grandfathered in
 # ci/sparkdl_check/baseline.json, and on stale baseline entries.
 #
+# Also runs the perf-regression gate in trajectory mode: every committed
+# BENCH_LOAD_*.json is compared against its newest same-shape
+# predecessor under ci/perf_gate.py's tolerance bands (waivers in
+# ci/perf_waivers.json), so a regression snuck into the committed bench
+# archive fails this gate even before a fresh run exists.
+#
 # Usage: ci/check.sh [--changed-only] [report-path]
 #   --changed-only  scan only files touched per git diff (HEAD + worktree)
 #                   plus their reverse call-graph dependents; stale-baseline
@@ -45,5 +51,7 @@ print(f"  timings: parse {t.get('parse_s', 0)}s, "
       f"call graph {t.get('graph_build_s', 0)}s; slowest rules: "
       + ", ".join(f"{rid} {s}s" for rid, s in slowest))
 EOF
+
+python -m ci.perf_gate --trajectory || rc=1
 
 exit "$rc"

@@ -117,6 +117,44 @@ if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
   exit 1
 fi
 
+# perf-regression gate smoke (ISSUE-15): the gate must (a) PASS a
+# fresh clean smoke run against the newest committed same-shape
+# BENCH_LOAD_*.json baseline, and (b) FAIL the same run under a
+# synthetic regression — a fleet-wide +12ms stall injected at the
+# faultnet.request site (the ISSUE-14 latency verb wrapped around
+# every router->replica round trip), which roughly doubles the smoke
+# p99 while goodput and the smoke's own invariants hold.  A gate that
+# never bites is worse than no gate; (b) proves this one does.
+GATE_OUT="$(mktemp -t fault-suite-gate.XXXXXX.json)"
+GATE_BAD="$(mktemp -t fault-suite-gate-bad.XXXXXX.json)"
+trap 'rm -rf "$TRACE_OUT" "$BLACKBOX_DIR" "$SMOKE_LOG" "$GATE_OUT" "$GATE_BAD"' EXIT
+if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
+    --out "$GATE_OUT" 2>&1 | tee "$SMOKE_LOG"; then
+  echo "perf-gate baseline smoke FAILED before the gate even ran" >&2
+  print_fleet_snapshot
+  exit 1
+fi
+if ! python -m ci.perf_gate --fresh "$GATE_OUT"; then
+  echo "perf gate FAILED on an unmodified tree: a clean smoke run" >&2
+  echo "breached the tolerance bands vs the committed baseline" >&2
+  exit 1
+fi
+if ! timeout -k 10 60 env SPARKDL_FAULTNET=1 \
+    SPARKDL_FAULT_PLAN='[{"site":"faultnet.request","stall_s":0.012,"p":1.0}]' \
+    python benchmarks/bench_load.py --smoke \
+    --out "$GATE_BAD" 2>&1 | tee "$SMOKE_LOG"; then
+  echo "injected-regression smoke FAILED outright (the +12ms stall" >&2
+  echo "should slow requests, not break smoke invariants)" >&2
+  print_fleet_snapshot
+  exit 1
+fi
+if python -m ci.perf_gate --fresh "$GATE_BAD"; then
+  echo "perf gate PASSED under an injected 2x p99 regression — the" >&2
+  echo "tolerance bands are too loose to catch a real one" >&2
+  exit 1
+fi
+echo "perf gate: clean run passed, injected regression caught" >&2
+
 # full static-analysis pass (replaces the per-script lints: one AST
 # parse per file, all nine rules); on failure print the JSON report so
 # CI logs carry the machine-readable findings, not just the exit code

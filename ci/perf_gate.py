@@ -1,0 +1,389 @@
+"""Perf-regression gate: the bench trajectory becomes a gate, not an
+archive.
+
+The repo commits one ``BENCH_LOAD_r<N>.json`` per PR, but until this
+gate nothing *read* them — a PR that quietly cost 20% goodput or
+doubled p99 sailed through CI.  This module compares a fresh
+``bench_load.py --smoke`` report against the last committed
+**same-shape** baseline with tolerance bands, and fails loudly when the
+fresh run regresses past them.
+
+Shape matching
+    Two reports are comparable only when they measured the same thing:
+    the shape key is ``(benchmark, scenario, replicas, workers,
+    target_rps, duration_s, compile, transport_mode, obs-armed)``.
+    Wrapper files (A/B runs like ``BENCH_LOAD_r13.json``'s
+    ``obs_on``/``obs_off``) are unpacked: every nested smoke-shaped
+    report participates, labeled ``file.json:key``.
+
+Tolerance bands (``TOLERANCES``)
+    Ratios with absolute noise floors: a latency metric must exceed
+    BOTH the relative band and the floor to fail — a 12 s smoke's p99
+    wobbles by fractions of a millisecond, and the gate must catch a
+    doubled tail without paging on scheduler noise.
+
+Waivers (``ci/perf_waivers.json``)
+    A checked-in JSON list; each entry names the ``metric`` (dotted
+    path), optionally the ``baseline`` file label it is waived against,
+    and a mandatory human ``reason``.  A waived breach is reported as
+    WAIVED and does not fail the gate — the contract is: regress on
+    purpose, say so in the diff, and the waiver is itself reviewable.
+
+Modes
+    ``--fresh out.json``  gate a fresh run against the newest committed
+    same-shape baseline (what ``ci/fault-suite.sh`` runs);
+    ``--trajectory``      walk the committed files oldest→newest and
+    gate every same-shape successor pair (cheap — no bench run; wired
+    into ``ci/check.sh`` so the archive itself stays monotone within
+    tolerance).
+
+Exit status: 0 when every comparison passes or is waived, 1 on any
+unwaived breach, 2 on usage errors.  ``--fresh`` with no same-shape
+baseline passes with a note: the first run of a new shape *creates*
+the trajectory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+#: report fields that define "the same experiment"
+SHAPE_FIELDS = (
+    "benchmark", "scenario", "replicas", "workers", "target_rps",
+    "duration_s", "compile", "transport_mode",
+)
+
+#: (metric dotted path, direction, max ratio vs baseline, abs floor)
+#: direction "min": fresh must stay >= baseline * (1 - band)
+#: direction "max": fresh must stay <= baseline * (1 + band), and the
+#:   absolute increase must also exceed ``floor`` to count as a breach
+TOLERANCES: Tuple[Tuple[str, str, float, float], ...] = (
+    ("goodput_rps", "min", 0.20, 0.0),
+    ("latency_ms.p50", "max", 0.60, 2.0),
+    ("latency_ms.p99", "max", 0.75, 4.0),
+    ("router_overhead_ms.p50", "max", 1.00, 2.0),
+    ("faultnet.retry_amplification", "max", 0.00, 0.5),
+)
+
+BENCH_GLOB = "BENCH_LOAD_*.json"
+DEFAULT_WAIVERS = os.path.join("ci", "perf_waivers.json")
+
+
+def _get_path(obj: Any, dotted: str) -> Optional[float]:
+    cur = obj
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return float(cur) if isinstance(cur, (int, float)) else None
+
+
+def _is_report(obj: Any) -> bool:
+    return (
+        isinstance(obj, dict)
+        and obj.get("benchmark") == "bench_load"
+        and isinstance(obj.get("latency_ms"), dict)
+    )
+
+
+def shape_key(report: Dict[str, Any]) -> Tuple:
+    """The comparability key; obs-armed runs never gate obs-off ones
+    (tracing is measured overhead, not regression)."""
+    return tuple(report.get(f) for f in SHAPE_FIELDS) + (
+        bool(report.get("obs") or report.get("trace")),
+    )
+
+
+def extract_reports(
+    path: str, payload: Dict[str, Any],
+) -> List[Tuple[str, Dict[str, Any]]]:
+    """``(label, report)`` rows from one committed file: the file
+    itself when smoke-shaped, else its nested smoke-shaped values
+    (A/B wrapper files)."""
+    base = os.path.basename(path)
+    if _is_report(payload):
+        return [(base, payload)]
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    for key in sorted(payload):
+        if _is_report(payload[key]):
+            out.append((f"{base}:{key}", payload[key]))
+    return out
+
+
+def _order(path: str) -> Tuple[int, str]:
+    """Committed files in trajectory order: by the rN suffix, then
+    name (``r11_tcp`` sorts after ``r11``)."""
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+
+def committed_reports(
+    repo_root: str,
+) -> List[Tuple[str, Dict[str, Any]]]:
+    rows: List[Tuple[str, Dict[str, Any]]] = []
+    for path in sorted(
+        glob.glob(os.path.join(repo_root, BENCH_GLOB)), key=_order,
+    ):
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+        except (OSError, ValueError):
+            continue  # an unreadable archive entry is not a perf fact
+        rows.extend(extract_reports(path, payload))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+
+def load_waivers(path: str) -> List[Dict[str, Any]]:
+    """The checked-in waiver list; absent file means no waivers.  Each
+    entry: ``{"metric": dotted-path, "reason": str,
+    "baseline"?: file-label}`` — schema errors raise (a malformed
+    waiver silently waiving nothing is the worst outcome)."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        payload = json.load(fh)
+    waivers = payload.get("waivers", payload) if isinstance(
+        payload, dict
+    ) else payload
+    if not isinstance(waivers, list):
+        raise ValueError(f"{path}: waivers must be a JSON list")
+    for w in waivers:
+        if not isinstance(w, dict) or "metric" not in w \
+                or "reason" not in w:
+            raise ValueError(
+                f"{path}: each waiver needs 'metric' and 'reason', "
+                f"got {w!r}"
+            )
+    return waivers
+
+
+def _waived(
+    waivers: List[Dict[str, Any]], metric: str, baseline_label: str,
+) -> Optional[str]:
+    for w in waivers:
+        if w["metric"] != metric:
+            continue
+        scope = w.get("baseline")
+        if scope is None or scope == baseline_label \
+                or baseline_label.startswith(f"{scope}:"):
+            return str(w["reason"])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+def compare(
+    fresh: Dict[str, Any],
+    baseline: Dict[str, Any],
+    baseline_label: str,
+    waivers: List[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Tolerance-band comparison of one same-shape pair; one row per
+    gated metric present in both reports."""
+    rows: List[Dict[str, Any]] = []
+    for metric, direction, band, floor in TOLERANCES:
+        base = _get_path(baseline, metric)
+        new = _get_path(fresh, metric)
+        if base is None or new is None:
+            continue
+        if direction == "min":
+            limit = base * (1.0 - band) - floor
+            ok = new >= limit
+        else:
+            limit = base * (1.0 + band) + floor
+            ok = new <= limit
+        row = {
+            "metric": metric,
+            "baseline": base,
+            "fresh": new,
+            "limit": round(limit, 4),
+            "direction": direction,
+            "ok": ok,
+            "waived": None,
+        }
+        if not ok:
+            reason = _waived(waivers, metric, baseline_label)
+            if reason is not None:
+                row["waived"] = reason
+        rows.append(row)
+    return rows
+
+
+def find_baseline(
+    fresh: Dict[str, Any], repo_root: str,
+    exclude_labels: Iterable[str] = (),
+) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """The newest committed same-shape report (the gate's reference)."""
+    key = shape_key(fresh)
+    excluded = set(exclude_labels)
+    for label, report in reversed(committed_reports(repo_root)):
+        if label in excluded:
+            continue
+        if shape_key(report) == key:
+            return label, report
+    return None
+
+
+def gate_fresh(
+    fresh_path: str, repo_root: str, waivers_path: str,
+) -> Dict[str, Any]:
+    with open(fresh_path) as fh:
+        payload = json.load(fh)
+    # a --diag/--smoke run writes a plain report; accept wrappers too
+    # (first nested report wins) so the gate composes with A/B outputs
+    candidates = extract_reports(fresh_path, payload)
+    if not candidates:
+        raise ValueError(
+            f"{fresh_path}: no bench_load report found in file"
+        )
+    label, fresh = candidates[0]
+    waivers = load_waivers(waivers_path)
+    # the fresh file may sit inside repo_root (a --out into the repo
+    # before committing): its own labels must never be its baseline
+    found = find_baseline(
+        fresh, repo_root,
+        exclude_labels=[lbl for lbl, _ in candidates],
+    )
+    if found is None:
+        return {
+            "mode": "fresh", "fresh": label, "baseline": None,
+            "rows": [], "ok": True,
+            "note": "no committed same-shape baseline — "
+                    "this run starts the trajectory",
+        }
+    base_label, baseline = found
+    rows = compare(fresh, baseline, base_label, waivers)
+    ok = all(r["ok"] or r["waived"] for r in rows)
+    return {
+        "mode": "fresh", "fresh": label, "baseline": base_label,
+        "rows": rows, "ok": ok,
+    }
+
+
+def gate_trajectory(
+    repo_root: str, waivers_path: str,
+) -> Dict[str, Any]:
+    """Every committed report gated against its newest same-shape
+    predecessor — the archive checks itself."""
+    waivers = load_waivers(waivers_path)
+    reports = committed_reports(repo_root)
+    pairs: List[Dict[str, Any]] = []
+    ok = True
+    for i, (label, report) in enumerate(reports):
+        key = shape_key(report)
+        prev = None
+        for prev_label, prev_report in reversed(reports[:i]):
+            if shape_key(prev_report) == key:
+                prev = (prev_label, prev_report)
+                break
+        if prev is None:
+            continue
+        rows = compare(report, prev[1], prev[0], waivers)
+        pair_ok = all(r["ok"] or r["waived"] for r in rows)
+        ok = ok and pair_ok
+        pairs.append({
+            "fresh": label, "baseline": prev[0],
+            "rows": rows, "ok": pair_ok,
+        })
+    return {"mode": "trajectory", "pairs": pairs, "ok": ok}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _print_rows(rows: List[Dict[str, Any]], indent: str = "") -> None:
+    for r in rows:
+        state = (
+            "ok" if r["ok"]
+            else f"WAIVED ({r['waived']})" if r["waived"]
+            else "FAIL"
+        )
+        op = ">=" if r["direction"] == "min" else "<="
+        print(
+            f"{indent}{r['metric']}: {r['fresh']:.3f} "
+            f"(baseline {r['baseline']:.3f}, must be {op} "
+            f"{r['limit']:.3f}) {state}"
+        )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ci.perf_gate",
+        description="perf-regression gate over the committed "
+                    "BENCH_LOAD_*.json trajectory",
+    )
+    mode = parser.add_mutually_exclusive_group(required=True)
+    mode.add_argument(
+        "--fresh", metavar="REPORT.json",
+        help="gate this fresh bench_load report against the newest "
+             "committed same-shape baseline",
+    )
+    mode.add_argument(
+        "--trajectory", action="store_true",
+        help="gate every committed report against its same-shape "
+             "predecessor (no bench run)",
+    )
+    parser.add_argument(
+        "--repo-root", default=".",
+        help="directory holding the committed BENCH_LOAD_*.json files",
+    )
+    parser.add_argument(
+        "--waivers", default=None,
+        help=f"waiver file (default <repo-root>/{DEFAULT_WAIVERS})",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the JSON verdict",
+    )
+    args = parser.parse_args(argv)
+    waivers_path = args.waivers or os.path.join(
+        args.repo_root, DEFAULT_WAIVERS
+    )
+    try:
+        if args.fresh:
+            verdict = gate_fresh(
+                args.fresh, args.repo_root, waivers_path,
+            )
+        else:
+            verdict = gate_trajectory(args.repo_root, waivers_path)
+    except (OSError, ValueError) as exc:
+        print(f"perf_gate: {exc}", file=sys.stderr)
+        return 2
+    if args.json:
+        # stdout stays pure JSON; the status line goes to stderr
+        print(json.dumps(verdict, indent=2))
+        print(f"perf_gate: {'PASS' if verdict['ok'] else 'FAIL'}",
+              file=sys.stderr)
+        return 0 if verdict["ok"] else 1
+    if verdict["mode"] == "fresh":
+        print(
+            f"perf_gate: {verdict['fresh']} vs "
+            f"{verdict['baseline'] or '(no baseline)'}"
+        )
+        if verdict.get("note"):
+            print(f"  {verdict['note']}")
+        _print_rows(verdict["rows"], indent="  ")
+    else:
+        for pair in verdict["pairs"]:
+            print(f"perf_gate: {pair['fresh']} vs {pair['baseline']}")
+            _print_rows(pair["rows"], indent="  ")
+        if not verdict["pairs"]:
+            print("perf_gate: no same-shape pairs in the trajectory")
+    print(f"perf_gate: {'PASS' if verdict['ok'] else 'FAIL'}")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,10 +32,13 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: counters like ``replica.expired_shed``) and "faultnet" (injected
 #: network-fault accounting) joined with the ISSUE-14 Byzantine-wire
 #: hardening.
+#: "diag" (trace-analytics report gauges) and "profile" (sampling-
+#: profiler accounting) joined with the ISSUE-15 diagnosis plane.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
     "streaming", "slo", "ts", "supervisor", "router", "wire",
-    "rollout", "tenant", "fleet", "replica", "faultnet",
+    "rollout", "tenant", "fleet", "replica", "faultnet", "diag",
+    "profile",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

@@ -54,9 +54,11 @@ only through lazy cold-path imports in ``policy``/``watchdog``
 """
 
 from sparkdl_tpu.obs.blackbox import FlightRecorder
+from sparkdl_tpu.obs.diag import diagnose, diagnose_paths
 from sparkdl_tpu.obs.export import JsonlTraceSink, prometheus_text
 from sparkdl_tpu.obs.fleet import FleetCollector
 from sparkdl_tpu.obs.hooks import FitProfiler, fit_profiler
+from sparkdl_tpu.obs.profile import StackProfiler, profile_for
 from sparkdl_tpu.obs.server import ObsServer
 from sparkdl_tpu.obs.slo import (
     SLO,
@@ -105,6 +107,13 @@ def enable_from_env() -> "JsonlTraceSink | None":
             slow_ms=float(slow_spec) if slow_spec else None,
         )
 
+    # the sampling profiler arms off its own env hook (SPARKDL_PROFILE)
+    # at the same import-time seam, so subprocess replicas profile
+    # themselves with no code changes either
+    from sparkdl_tpu.obs import profile as _profile
+
+    _profile.enable_from_env()
+
     path = os.environ.get(ENV_VAR)
     if not path or _env_sink is not None:
         return _env_sink
@@ -126,13 +135,17 @@ __all__ = [
     "SLO",
     "SLOEngine",
     "Span",
+    "StackProfiler",
     "TimeSeriesRecorder",
     "Tracer",
     "availability_slo",
     "current_span",
+    "diagnose",
+    "diagnose_paths",
     "enable_from_env",
     "fit_profiler",
     "fleet_rollout_slos",
+    "profile_for",
     "prometheus_text",
     "record_event",
     "serving_slos",

@@ -146,6 +146,17 @@ class FlightRecorder:
             "metric_samples": samples,
             "metrics_now": self._registry.snapshot(),
         }
+        # when the env-armed sampling profiler is running, the dump
+        # carries its folded stacks too: an SLO page then shows WHERE
+        # the fleet was spending time, not just that it stalled
+        try:
+            from sparkdl_tpu.obs import profile as _profile
+
+            prof = _profile.profiler()
+            if prof is not None:
+                payload["profile"] = prof.snapshot()
+        except Exception:  # never turn a dump into a crash
+            pass
         if exc is not None:
             payload["exception"] = {
                 "type": type(exc).__name__,

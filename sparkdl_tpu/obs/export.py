@@ -190,4 +190,14 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None,
                 lines.append(f'{pn}{{quantile="{label}"}} {v:g}')
         lines.append(f"{pn}_sum {h.total:g}")
         lines.append(f"{pn}_count {h.count:g}")
+        ex = h.exemplar()
+        if ex is not None:
+            # exemplar as a comment line, not OpenMetrics `# {...}`
+            # mid-line syntax: the text-format parsers in this repo (and
+            # plain Prometheus scrapers) must keep seeing valid lines,
+            # and a comment is the one forward-compatible place to put
+            # a 63-bit trace id without float-mangling it
+            lines.append(
+                f"# EXEMPLAR {pn} trace_id={ex[1]:d} value={ex[0]:g}"
+            )
     return "\n".join(lines) + ("\n" if lines else "")

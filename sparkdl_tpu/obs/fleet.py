@@ -56,6 +56,10 @@ Target = Dict[str, Any]  # {"name": str, "version": str, "url": str}
 #: slowest replica); everything else aggregates by sum
 _MAX_SUFFIXES = (".p50", ".p95", ".p99", ".mean", ".seconds")
 
+#: histogram exemplar refs — excluded from federation (a trace id is a
+#: link, not a measurement; see Histogram.exemplar())
+_EXEMPLAR_SUFFIXES = (".exemplar_trace_id", ".exemplar_value")
+
 
 def sanitize_label(label: str) -> str:
     """Metric-segment-safe form of a replica/version label
@@ -170,6 +174,11 @@ class FleetCollector:
                 if not isinstance(value, (int, float)):
                     continue
                 if not self._wanted(metric_name):
+                    continue
+                if metric_name.endswith(_EXEMPLAR_SUFFIXES):
+                    # exemplar refs are trace-id links, not samples —
+                    # summing them across replicas is meaningless and
+                    # burns a recorder series per histogram
                     continue
                 kept[metric_name] = float(value)
                 self._recorder.record(

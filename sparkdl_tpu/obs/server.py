@@ -53,7 +53,9 @@ from __future__ import annotations
 import json
 import sys
 import threading
+import time
 import traceback
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional
 
@@ -63,6 +65,40 @@ ENV_PORT = "SPARKDL_OBS_PORT"
 
 #: the env-armed process-wide server, if any (see :func:`enable_from_env`)
 _server: "Optional[ObsServer]" = None
+
+#: /debug/profile window bounds — a scraper must not park a handler
+#: thread for minutes
+MAX_PROFILE_SECONDS = 60.0
+
+
+class BadRequest(ValueError):
+    """A malformed ``/debug/*`` query parameter — surfaces as HTTP 400
+    (the caller's mistake), never a 500 (the server's)."""
+
+
+def _query_number(
+    query: Dict[str, Any], name: str, default: float,
+    lo: float, hi: float,
+) -> float:
+    """One numeric query param, validated: unparseable or out-of-range
+    values raise :class:`BadRequest`."""
+    raw = query.get(name)
+    if raw is None:
+        return default
+    if isinstance(raw, list):
+        raw = raw[-1] if raw else None
+    try:
+        value = float(raw)
+    except (TypeError, ValueError):
+        raise BadRequest(
+            f"query param {name!r} must be a number, got {raw!r}"
+        )
+    if not (lo <= value <= hi):
+        raise BadRequest(
+            f"query param {name!r} must be in [{lo:g}, {hi:g}], "
+            f"got {value:g}"
+        )
+    return value
 
 
 def _thread_dump() -> Dict[str, Any]:
@@ -140,6 +176,24 @@ class ObsServer:
                 self._fleet = fleet
         return self
 
+    #: the served paths -> metric-segment labels; anything else pools
+    #: into "other" so a URL-scanning client can't mint series
+    _ENDPOINT_LABELS = {
+        "/": "index", "/index": "index",
+        "/metrics": "metrics", "/metrics.json": "metrics_json",
+        "/healthz": "healthz", "/slo": "slo",
+        "/debug/spans": "debug_spans",
+        "/debug/threads": "debug_threads",
+        "/debug/timeseries": "debug_timeseries",
+        "/debug/fleet": "debug_fleet",
+        "/debug/diag": "debug_diag",
+        "/debug/profile": "debug_profile",
+    }
+
+    @classmethod
+    def _endpoint_label(cls, path: str) -> str:
+        return cls._ENDPOINT_LABELS.get(path, "other")
+
     # ------------------------------------------------------------------
     # payloads (each reads ONE bounded snapshot; no handler state)
     # ------------------------------------------------------------------
@@ -166,8 +220,11 @@ class ObsServer:
         )
         return payload
 
-    def _handle(self, path: str):
-        """Route one GET; returns (status, content_type, body_bytes)."""
+    def _handle(self, path: str, query: Optional[Dict[str, Any]] = None):
+        """Route one GET; returns (status, content_type, body_bytes).
+        Raises :class:`BadRequest` on malformed query params (the
+        handler maps it to 400)."""
+        query = query or {}
         with self._lock:
             recorder = self._recorder
             engine = self._slo_engine
@@ -183,7 +240,7 @@ class ObsServer:
                 "endpoints": [
                     "/metrics", "/metrics.json", "/healthz", "/slo",
                     "/debug/spans", "/debug/threads", "/debug/timeseries",
-                    "/debug/fleet",
+                    "/debug/fleet", "/debug/diag", "/debug/profile",
                 ],
             })
         if path == "/metrics":
@@ -224,6 +281,34 @@ class ObsServer:
             if fleet is None:
                 return jdump(404, {"error": "no fleet collector attached"})
             return jdump(200, fleet.snapshot())
+        if path == "/debug/diag":
+            if sink is None:
+                return jdump(404, {"error": "no span sink attached"})
+            from sparkdl_tpu.obs.diag import diagnose
+
+            top = int(_query_number(query, "top", 3.0, 0.0, 64.0))
+            return jdump(200, diagnose(
+                sink.spans(), top=top, registry=self._registry,
+            ))
+        if path == "/debug/profile":
+            from sparkdl_tpu.obs import profile as profile_mod
+
+            seconds = _query_number(
+                query, "seconds", 2.0, 0.05, MAX_PROFILE_SECONDS,
+            )
+            interval_ms = _query_number(
+                query, "interval_ms", 10.0, 1.0, 1000.0,
+            )
+            payload: Dict[str, Any] = {
+                "window": profile_mod.profile_for(
+                    seconds, interval_s=interval_ms / 1000.0,
+                ),
+            }
+            armed = profile_mod.profiler()
+            if armed is not None:
+                # the env-armed profiler's lifetime aggregate, when on
+                payload["armed"] = armed.snapshot()
+            return jdump(200, payload)
         return jdump(404, {"error": f"unknown path {path!r}"})
 
     # ------------------------------------------------------------------
@@ -241,15 +326,31 @@ class ObsServer:
                 # one handler class per server instance: the closure is
                 # the only channel to the wired components
                 def do_GET(self):  # noqa: N802 (http.server API)
-                    path = self.path.split("?", 1)[0]
+                    split = urllib.parse.urlsplit(self.path)
+                    path = split.path
+                    t0 = time.monotonic()
                     try:
-                        status, ctype, body = outer._handle(path)
+                        query = urllib.parse.parse_qs(split.query)
+                        status, ctype, body = outer._handle(path, query)
+                    except BadRequest as exc:
+                        # the caller's mistake: 400, not 500 — a typo'd
+                        # ?seconds= must not read as a server fault
+                        body = json.dumps({
+                            "error": str(exc),
+                        }).encode()
+                        status, ctype = 400, "application/json"
                     except Exception as exc:  # never kill the server
                         body = json.dumps({
                             "error": f"{type(exc).__name__}: {exc}",
                         }).encode()
                         status, ctype = 500, "application/json"
                     outer._registry.counter("sparkdl.obs_requests").add(1)
+                    # the telemetry plane measures itself, per endpoint
+                    # (bounded label set: unknown paths pool in "other")
+                    outer._registry.histogram(
+                        "sparkdl.obs_request_ms"
+                        f".{outer._endpoint_label(path)}"
+                    ).observe((time.monotonic() - t0) * 1000.0)
                     self.send_response(status)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(body)))

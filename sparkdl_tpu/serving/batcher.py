@@ -482,8 +482,9 @@ class MicroBatcher:
             }
             r.future.set_result(host[i])
             ms = (done - r.enqueued_at) * 1000.0
-            latency.observe(ms)
-            self._m_latency.observe(ms)
+            ex = r.span.trace_id if r.span is not None else None
+            latency.observe(ms, exemplar=ex)
+            self._m_latency.observe(ms, exemplar=ex)
         metrics.counter("serving.batches").add(1)
         metrics.histogram("serving.batch_occupancy").observe(
             len(live) / bucket
@@ -536,8 +537,9 @@ class MicroBatcher:
             }
             r.future.set_result(out[i])
             ms = (done - r.enqueued_at) * 1000.0
-            latency.observe(ms)
-            self._m_latency.observe(ms)
+            ex = r.span.trace_id if r.span is not None else None
+            latency.observe(ms, exemplar=ex)
+            self._m_latency.observe(ms, exemplar=ex)
         metrics.counter("serving.batches").add(1)
         metrics.histogram("serving.batch_occupancy").observe(
             len(live) / bucket

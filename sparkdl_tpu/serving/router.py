@@ -500,7 +500,9 @@ class Router:
         finally:
             self._unpick(backend)
         ms = (time.monotonic() - t0) * 1000.0
-        vm.latency.observe(ms)
+        vm.latency.observe(
+            ms, exemplar=span.trace_id if span is not None else None,
+        )
         self._observe_attempt_ms(ms)
         return reply
 
@@ -707,9 +709,14 @@ class Router:
                             tm.errors.add(1)
                         raise
                     now = time.monotonic()
-                    self._m_latency.observe((now - start) * 1000.0)
+                    e2e_ms = (now - start) * 1000.0
+                    # exemplar: the root span's trace id rides along
+                    # with every latency sample, so a p99 outlier in
+                    # /metrics.json names the stitched trace behind it
+                    exemplar = span.trace_id if span is not None else None
+                    self._m_latency.observe(e2e_ms, exemplar=exemplar)
                     if tm is not None:
-                        tm.latency.observe((now - start) * 1000.0)
+                        tm.latency.observe(e2e_ms, exemplar=exemplar)
                     shipped = reply.pop("spans", None)
                     if span is not None:
                         span.set_attribute("replica", winner.name)
@@ -721,7 +728,16 @@ class Router:
                         admission_ms=admission_ms,
                         queue_ms=(attempt_start - start) * 1000.0,
                         attempt_ms=(now - attempt_start) * 1000.0,
+                        exemplar=exemplar,
                     )
+                    if span is not None:
+                        # the merged breakdown rides the root span too:
+                        # trace-JSONL consumers (obs.diag) attribute
+                        # phases without needing the reply envelope
+                        span.set_attribute(
+                            "phases", dict(reply.get("phases") or {})
+                        )
+                        span.set_attribute("e2e_ms", e2e_ms)
                     return reply
             finally:
                 self._release()
@@ -737,7 +753,8 @@ class Router:
                 span.end()
 
     def _decompose(self, reply: Dict[str, Any], admission_ms: float,
-                   queue_ms: float, attempt_ms: float) -> None:
+                   queue_ms: float, attempt_ms: float,
+                   exemplar: Optional[int] = None) -> None:
         """Merge the router-side phases into the reply's breakdown and
         observe each as ``router.phase.<name>``.  The transport phase
         is the winning attempt's wall time minus what finer phases
@@ -767,7 +784,7 @@ class Router:
                         f"router.phase.{_sanitize_label(str(name))}"
                     ),
                 )
-            h.observe(float(ms))
+            h.observe(float(ms), exemplar=exemplar)
 
     def _send_one(self, backend: _Backend, value, model_id, deadline_ms,
                   tenant: Optional[str], timeout_s: float,

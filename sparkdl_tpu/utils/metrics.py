@@ -130,14 +130,35 @@ class Histogram:
         self.name = name
         self._lock = threading.Lock()
         self._window: "deque[float]" = deque(maxlen=int(window))
+        #: exemplar refs (trace ids) appended in lockstep with
+        #: ``_window`` — same maxlen, so index i of one matches index i
+        #: of the other; None for observations without a trace
+        self._exemplars: "deque[Optional[int]]" = deque(maxlen=int(window))
         self._count = 0
         self._sum = 0.0
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[int] = None) -> None:
         with self._lock:
             self._window.append(float(value))
+            self._exemplars.append(exemplar)
             self._count += 1
             self._sum += value
+
+    def exemplar(self) -> Optional[Tuple[float, int]]:
+        """``(value, trace_id)`` of the largest in-window observation that
+        carried an exemplar, or None when no windowed sample has one.
+
+        This is the one-hop link from a p99 outlier to its stitched
+        trace: the worst recent sample names the trace that produced it.
+        Cold path only (scraped, never on observe)."""
+        with self._lock:
+            pairs = [
+                (v, e) for v, e in zip(self._window, self._exemplars)
+                if e is not None
+            ]
+        if not pairs:
+            return None
+        return max(pairs, key=lambda p: p[0])
 
     def quantile(self, q: float) -> Optional[float]:
         """Linear-interpolated quantile over the window; None when empty."""
@@ -252,6 +273,12 @@ class MetricsRegistry:
                 v = h.quantile(q)
                 if v is not None:
                     out[f"{name}.{label}"] = v
+            ex = h.exemplar()
+            if ex is not None:
+                # trace ids are 63-bit ints; JSON carries them exactly,
+                # a float cast would corrupt the low bits
+                out[name + ".exemplar_value"] = ex[0]
+                out[name + ".exemplar_trace_id"] = ex[1]  # type: ignore[assignment]
         return out
 
     def images_per_sec(self) -> Optional[float]:

@@ -333,6 +333,32 @@ class TestFleetCollector:
         fc.scrape_once(now=2.0)
         assert sorted(fc.snapshot()["targets"]) == ["replica-0"]
 
+    def test_exemplar_samples_skipped(self):
+        """Exemplar trace ids are links, not gauges — summing them
+        across replicas (or maxing a trace id) is meaningless, so the
+        federation skips the ``.exemplar_*`` snapshot keys."""
+        snaps = {
+            "http://a": {
+                "serving.requests": 5.0,
+                "serving.latency_ms.p99": 10.0,
+                "serving.latency_ms.exemplar_value": 10.0,
+                "serving.latency_ms.exemplar_trace_id": 12345,
+            },
+            "http://b": dict(self.SNAPS["http://b"]),
+        }
+        fc, rec = collector(self.TARGETS, snaps)
+        assert fc.scrape_once(now=1.0) == 2
+        assert rec.latest(
+            "fleet.replica.replica_0.serving.latency_ms.p99"
+        ) == 10.0
+        assert rec.latest(
+            "fleet.replica.replica_0.serving.latency_ms"
+            ".exemplar_trace_id"
+        ) is None
+        assert rec.latest(
+            "fleet.version.v2.serving.latency_ms.exemplar_value"
+        ) is None
+
     def test_prometheus_block_carries_labels(self):
         fc, _ = collector(self.TARGETS, self.SNAPS)
         fc.scrape_once(now=1.0)

@@ -120,6 +120,45 @@ def test_empty_histogram_not_exported():
     assert "never.count" not in reg.snapshot()
 
 
+def test_histogram_exemplar_is_windowed_max():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", window=4)
+    assert h.exemplar() is None
+    h.observe(50.0)           # no exemplar attached
+    assert h.exemplar() is None
+    h.observe(9.0, exemplar=111)
+    h.observe(30.0, exemplar=222)
+    h.observe(12.0, exemplar=333)
+    # of the exemplar-carrying samples, the largest value wins
+    assert h.exemplar() == (30.0, 222)
+    # the window slides: two more samples roll 50.0 and 111 out
+    h.observe(1.0, exemplar=444)
+    h.observe(2.0, exemplar=555)
+    assert h.exemplar() == (30.0, 222)
+    h.observe(3.0)  # now 222 itself rolled out; 333 is the window max
+    assert h.exemplar() == (12.0, 333)
+
+
+def test_histogram_exemplar_in_snapshot_exact_int():
+    reg = MetricsRegistry()
+    # trace ids are 63-bit: the snapshot must carry them as exact ints
+    # (a float cast silently corrupts the low bits)
+    big = (1 << 62) + 12345
+    reg.histogram("lat").observe(7.5, exemplar=big)
+    snap = reg.snapshot()
+    assert snap["lat.exemplar_value"] == 7.5
+    assert snap["lat.exemplar_trace_id"] == big
+    assert isinstance(snap["lat.exemplar_trace_id"], int)
+
+
+def test_histogram_without_exemplars_has_no_snapshot_keys():
+    reg = MetricsRegistry()
+    reg.histogram("lat").observe(7.5)
+    snap = reg.snapshot()
+    assert "lat.exemplar_value" not in snap
+    assert "lat.exemplar_trace_id" not in snap
+
+
 def test_counters_thread_safe():
     reg = MetricsRegistry()
 

@@ -408,6 +408,20 @@ class TestPrometheusText:
         snap = metrics.snapshot(prefix="serving.")
         assert snap == {"serving.requests": 2.0}
 
+    def test_histogram_exemplar_rendered_as_comment(self):
+        h = metrics.histogram("serving.latency_ms")
+        h.observe(2.0, exemplar=111)
+        h.observe(9.0, exemplar=42)
+        text = prometheus_text(metrics)
+        # parse-safe comment form, not OpenMetrics mid-line syntax —
+        # plain-Prometheus scrapers must keep parsing the exposition
+        assert "# EXEMPLAR serving_latency_ms trace_id=42 value=9" \
+            in text
+        # exemplar-free histograms render no EXEMPLAR line
+        metrics.reset()
+        metrics.histogram("serving.latency_ms").observe(2.0)
+        assert "EXEMPLAR" not in prometheus_text(metrics)
+
 
 # ----------------------------------------------------------------------
 # fit profiler

@@ -190,6 +190,116 @@ class TestEndpoints:
 
 
 # ----------------------------------------------------------------------
+# diagnosis endpoints (/debug/diag, /debug/profile) + self-telemetry
+# ----------------------------------------------------------------------
+def _diag_span(name, tid, sid, parent=None, dur=5.0, **attrs):
+    return {
+        "name": name, "trace_id": tid, "span_id": sid,
+        "parent_id": parent, "start_unix_s": 0.0,
+        "duration_ms": dur, "attributes": attrs, "events": [],
+    }
+
+
+class TestDiagnosisEndpoints:
+    @pytest.fixture(autouse=True)
+    def unarm_profiler(self):
+        from sparkdl_tpu.obs import profile
+
+        yield
+        if profile._profiler is not None:
+            profile._profiler.stop()
+            profile._profiler = None
+
+    def _stitched_sink(self):
+        sink = JsonlTraceSink(capacity=16)
+        sink(_diag_span(
+            "router.request", 42, 1, dur=10.0, e2e_ms=10.0,
+            phases={"transport": 6.0, "forward": 4.0},
+            replica="replica-0",
+        ))
+        sink(_diag_span("replica.serve", 42, 2, parent=1, dur=6.0))
+        return sink
+
+    def test_debug_diag_report(self, registry):
+        sink = self._stitched_sink()
+        with ObsServer(registry=registry, span_sink=sink) as srv:
+            status, payload = _get_json(srv.url + "/debug/diag")
+        assert status == 200
+        assert payload["requests"] == 1
+        assert payload["stitched_requests"] == 1
+        assert payload["attribution"]["coverage_p50"] == 1.0
+        assert payload["slowest"][0]["trace_id"] == 42
+        # the report's headline gauges land in the process registry
+        # (the wired one only resolves exemplars)
+        assert metrics.snapshot()["diag.requests"] == 1.0
+
+    def test_debug_diag_top_param(self, registry):
+        sink = self._stitched_sink()
+        with ObsServer(registry=registry, span_sink=sink) as srv:
+            status, payload = _get_json(
+                srv.url + "/debug/diag?top=0")
+        assert status == 200
+        assert payload["slowest"] == []
+
+    def test_debug_diag_404_without_sink(self, registry):
+        with ObsServer(registry=registry) as srv:
+            status, payload = _get_json(srv.url + "/debug/diag")
+        assert status == 404
+        assert "span sink" in payload["error"]
+
+    def test_debug_profile_window(self, registry):
+        with ObsServer(registry=registry) as srv:
+            status, payload = _get_json(
+                srv.url + "/debug/profile?seconds=0.1&interval_ms=5")
+        assert status == 200
+        window = payload["window"]
+        assert window["running"] is False
+        assert window["duration_s"] >= 0.05
+        # no env-armed profiler -> no "armed" section
+        assert "armed" not in payload
+
+    def test_debug_profile_reports_armed_profiler(self, registry,
+                                                  monkeypatch):
+        from sparkdl_tpu.obs import profile
+
+        monkeypatch.setenv(profile.ENV_PROFILE, "1")
+        profile.enable_from_env()
+        with ObsServer(registry=registry) as srv:
+            status, payload = _get_json(
+                srv.url + "/debug/profile?seconds=0.05")
+        assert status == 200
+        assert payload["armed"]["running"] is True
+
+    def test_malformed_query_params_are_400_not_500(self, registry):
+        sink = self._stitched_sink()
+        with ObsServer(registry=registry, span_sink=sink) as srv:
+            for url in (
+                "/debug/profile?seconds=banana",
+                "/debug/profile?seconds=9999",   # > 60s cap
+                "/debug/profile?interval_ms=0",  # below floor
+                "/debug/diag?top=-5",
+            ):
+                status, payload = _get_json(srv.url + url)
+                assert status == 400, url
+                assert "query param" in payload["error"], url
+            # the caller's typo never killed the server
+            assert _get_json(srv.url + "/healthz")[0] == 200
+
+    def test_per_endpoint_latency_histogram(self, registry):
+        with ObsServer(registry=registry) as srv:
+            _get(srv.url + "/healthz")
+            _get(srv.url + "/metrics")
+            _get(srv.url + "/made-up-path")
+        snap = registry.snapshot(prefix="sparkdl.obs_request_ms")
+        assert snap["sparkdl.obs_request_ms.healthz.count"] == 1.0
+        assert snap["sparkdl.obs_request_ms.metrics.count"] == 1.0
+        # unknown paths pool into "other" — a URL-scanning client
+        # cannot mint unbounded label series
+        assert snap["sparkdl.obs_request_ms.other.count"] == 1.0
+        assert "sparkdl.obs_request_ms.healthz.p99" in snap
+
+
+# ----------------------------------------------------------------------
 # lifecycle
 # ----------------------------------------------------------------------
 class TestLifecycle:

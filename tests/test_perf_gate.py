@@ -1,0 +1,239 @@
+"""Perf-regression gate (``ci/perf_gate.py``): shape matching,
+tolerance bands, waivers, wrapper unpacking, and both CLI modes —
+all over synthetic report files in a tmp repo root; no bench runs.
+"""
+
+import json
+
+import pytest
+
+from ci import perf_gate
+from ci.perf_gate import (
+    extract_reports,
+    find_baseline,
+    gate_fresh,
+    gate_trajectory,
+    load_waivers,
+    shape_key,
+)
+
+
+def _report(**over):
+    base = {
+        "benchmark": "bench_load", "scenario": "kill", "replicas": 2,
+        "workers": 2, "target_rps": 60.0, "duration_s": 12.0,
+        "compile": False, "transport_mode": "auto", "obs": True,
+        "goodput_rps": 50.0,
+        "latency_ms": {"p50": 3.0, "p99": 10.0},
+        "router_overhead_ms": {"p50": 1.5},
+    }
+    base.update(over)
+    return base
+
+
+def _commit(root, name, payload):
+    path = root / name
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+class TestShapes:
+    def test_obs_armed_runs_never_gate_obs_off(self):
+        assert shape_key(_report(obs=True)) != \
+            shape_key(_report(obs=False))
+        # a "trace" section counts as obs-armed too
+        assert shape_key(_report(obs=False, trace={"out": "x"})) == \
+            shape_key(_report(obs=True))
+
+    def test_extract_reports_unpacks_wrappers(self):
+        plain = _report()
+        assert extract_reports("/x/B.json", plain) == \
+            [("B.json", plain)]
+        wrapper = {
+            "benchmark": "bench_load",  # wrapper, but not a report
+            "profile_on": _report(goodput_rps=49.0),
+            "profile_off": _report(goodput_rps=50.0),
+            "hedging": {"p99_delta_ms": 1.0},
+        }
+        labels = [lbl for lbl, _ in
+                  extract_reports("/x/B.json", wrapper)]
+        assert labels == ["B.json:profile_off", "B.json:profile_on"]
+
+    def test_find_baseline_newest_same_shape(self, tmp_path):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report(
+            goodput_rps=10.0))
+        _commit(tmp_path, "BENCH_LOAD_r2.json", _report(
+            goodput_rps=20.0))
+        _commit(tmp_path, "BENCH_LOAD_r3.json", _report(
+            goodput_rps=30.0, scenario="faultnet"))  # other shape
+        label, base = find_baseline(_report(), str(tmp_path))
+        assert label == "BENCH_LOAD_r2.json"
+        assert base["goodput_rps"] == 20.0
+
+    def test_find_baseline_honors_exclusions(self, tmp_path):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        _commit(tmp_path, "BENCH_LOAD_r2.json", _report())
+        label, _ = find_baseline(
+            _report(), str(tmp_path),
+            exclude_labels=["BENCH_LOAD_r2.json"],
+        )
+        assert label == "BENCH_LOAD_r1.json"
+
+
+class TestGateFresh:
+    def _gate(self, tmp_path, fresh, name="fresh.json"):
+        fresh_path = _commit(tmp_path, name, fresh)
+        return gate_fresh(
+            fresh_path, str(tmp_path),
+            str(tmp_path / "waivers.json"),
+        )
+
+    def test_clean_run_passes(self, tmp_path):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        verdict = self._gate(tmp_path, _report(goodput_rps=48.0))
+        assert verdict["ok"]
+        assert verdict["baseline"] == "BENCH_LOAD_r1.json"
+        assert all(r["ok"] for r in verdict["rows"])
+
+    def test_no_baseline_passes_with_note(self, tmp_path):
+        verdict = self._gate(tmp_path, _report())
+        assert verdict["ok"]
+        assert verdict["baseline"] is None
+        assert "no committed same-shape baseline" in verdict["note"]
+
+    def test_doubled_p99_fails(self, tmp_path):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        verdict = self._gate(
+            tmp_path,
+            _report(latency_ms={"p50": 3.0, "p99": 22.0}),
+        )
+        assert not verdict["ok"]
+        bad = [r for r in verdict["rows"] if not r["ok"]]
+        assert [r["metric"] for r in bad] == ["latency_ms.p99"]
+
+    def test_noise_floor_absorbs_small_absolute_wobble(self, tmp_path):
+        """+75% of a 2ms p99 is 1.5ms of scheduler noise, not a
+        regression — the absolute floor must absorb it."""
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report(
+            latency_ms={"p50": 1.0, "p99": 2.0}))
+        verdict = self._gate(
+            tmp_path, _report(latency_ms={"p50": 1.8, "p99": 5.0}),
+        )
+        assert verdict["ok"]
+
+    def test_goodput_collapse_fails(self, tmp_path):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        verdict = self._gate(tmp_path, _report(goodput_rps=30.0))
+        assert not verdict["ok"]
+
+    def test_fresh_file_in_repo_root_never_self_gates(self, tmp_path):
+        """A --out into the repo root (the pre-commit workflow) must
+        gate against the PREVIOUS archive entry, not itself."""
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        fresh = {
+            "benchmark": "bench_load",
+            "profile_off": _report(goodput_rps=48.0),
+            "profile_on": _report(goodput_rps=47.0),
+        }
+        fresh_path = _commit(tmp_path, "BENCH_LOAD_r2.json", fresh)
+        verdict = gate_fresh(
+            fresh_path, str(tmp_path),
+            str(tmp_path / "waivers.json"),
+        )
+        assert verdict["baseline"] == "BENCH_LOAD_r1.json"
+
+    def test_missing_report_raises(self, tmp_path):
+        path = _commit(tmp_path, "empty.json", {"benchmark": "other"})
+        with pytest.raises(ValueError):
+            gate_fresh(path, str(tmp_path),
+                       str(tmp_path / "waivers.json"))
+
+
+class TestWaivers:
+    def test_waived_breach_passes_with_reason(self, tmp_path):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        waivers = _commit(tmp_path, "waivers.json", {"waivers": [
+            {"metric": "latency_ms.p99",
+             "reason": "tracing now on by default"},
+        ]})
+        fresh = _commit(tmp_path, "fresh.json", _report(
+            latency_ms={"p50": 3.0, "p99": 30.0}))
+        verdict = gate_fresh(fresh, str(tmp_path), waivers)
+        assert verdict["ok"]
+        row = next(r for r in verdict["rows"]
+                   if r["metric"] == "latency_ms.p99")
+        assert row["waived"] == "tracing now on by default"
+
+    def test_waiver_scoped_to_other_baseline_does_not_apply(
+            self, tmp_path):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        waivers = _commit(tmp_path, "waivers.json", {"waivers": [
+            {"metric": "latency_ms.p99", "reason": "x",
+             "baseline": "BENCH_LOAD_r9.json"},
+        ]})
+        fresh = _commit(tmp_path, "fresh.json", _report(
+            latency_ms={"p50": 3.0, "p99": 30.0}))
+        assert not gate_fresh(fresh, str(tmp_path), waivers)["ok"]
+
+    def test_malformed_waiver_raises(self, tmp_path):
+        path = _commit(tmp_path, "waivers.json", {"waivers": [
+            {"metric": "latency_ms.p99"},  # no reason
+        ]})
+        with pytest.raises(ValueError):
+            load_waivers(path)
+
+    def test_absent_waiver_file_is_empty(self, tmp_path):
+        assert load_waivers(str(tmp_path / "nope.json")) == []
+
+
+class TestTrajectory:
+    def test_walks_same_shape_pairs_in_rn_order(self, tmp_path):
+        _commit(tmp_path, "BENCH_LOAD_r2.json", _report(
+            goodput_rps=50.0))
+        _commit(tmp_path, "BENCH_LOAD_r10.json", _report(
+            goodput_rps=49.0))  # lexically before r2, numerically after
+        _commit(tmp_path, "BENCH_LOAD_r11.json", _report(
+            scenario="steady"))  # no predecessor of its shape
+        verdict = gate_trajectory(
+            str(tmp_path), str(tmp_path / "waivers.json"))
+        assert verdict["ok"]
+        assert [(p["fresh"], p["baseline"])
+                for p in verdict["pairs"]] == [
+            ("BENCH_LOAD_r10.json", "BENCH_LOAD_r2.json"),
+        ]
+
+    def test_regressed_archive_entry_fails(self, tmp_path):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        _commit(tmp_path, "BENCH_LOAD_r2.json", _report(
+            goodput_rps=20.0))
+        verdict = gate_trajectory(
+            str(tmp_path), str(tmp_path / "waivers.json"))
+        assert not verdict["ok"]
+
+
+class TestCli:
+    def test_fresh_pass_and_fail_exit_codes(self, tmp_path, capsys):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        good = _commit(tmp_path, "good.json", _report())
+        bad = _commit(tmp_path, "bad.json", _report(goodput_rps=5.0))
+        assert perf_gate.main(
+            ["--fresh", good, "--repo-root", str(tmp_path)]) == 0
+        assert "PASS" in capsys.readouterr().out
+        assert perf_gate.main(
+            ["--fresh", bad, "--repo-root", str(tmp_path)]) == 1
+        assert "FAIL" in capsys.readouterr().out
+
+    def test_trajectory_json_mode(self, tmp_path, capsys):
+        _commit(tmp_path, "BENCH_LOAD_r1.json", _report())
+        _commit(tmp_path, "BENCH_LOAD_r2.json", _report())
+        assert perf_gate.main(
+            ["--trajectory", "--repo-root", str(tmp_path),
+             "--json"]) == 0
+        verdict = json.loads(capsys.readouterr().out)
+        assert verdict["mode"] == "trajectory"
+        assert len(verdict["pairs"]) == 1
+
+    def test_unreadable_fresh_file_is_usage_error(self, tmp_path):
+        assert perf_gate.main(
+            ["--fresh", str(tmp_path / "missing.json"),
+             "--repo-root", str(tmp_path)]) == 2

@@ -132,6 +132,50 @@ class TestTimeSeriesRecorder:
         with pytest.raises(ValueError):
             _interpolated_quantile([1.0], 1.5)
 
+    def test_quantile_empty_window_is_none(self, registry, recorder):
+        """No series at all, and a window that slid past every point,
+        must both read as None — never 0.0, never a raise."""
+        assert recorder.quantile_over_window(
+            "serving.lag", 0.99, 10.0, now=1.0
+        ) is None
+        g = registry.gauge("serving.lag")
+        g.set(5.0)
+        recorder.sample_once(now=1.0)
+        assert recorder.quantile_over_window(
+            "serving.lag", 0.99, 10.0, now=500.0
+        ) is None
+
+    def test_quantile_single_point_window(self, registry, recorder):
+        """One in-window point: every quantile IS that point."""
+        g = registry.gauge("serving.lag")
+        g.set(7.0)
+        recorder.sample_once(now=1.0)
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert recorder.quantile_over_window(
+                "serving.lag", q, 10.0, now=1.0
+            ) == 7.0
+
+    def test_quantile_window_straddles_ring_drop(self, registry):
+        """A window reaching back past points the bounded ring already
+        dropped must quantile over the survivors only — the dropped
+        prefix silently narrows the window, it must not corrupt it."""
+        rec = TimeSeriesRecorder(registry=registry, max_points=5)
+        g = registry.gauge("serving.lag")
+        for t in range(10):  # ring keeps t=5..9 (values 5.0..9.0)
+            g.set(float(t))
+            rec.sample_once(now=float(t))
+        # the 100s window nominally covers all ten points; only the
+        # five surviving the ring participate
+        assert rec.quantile_over_window(
+            "serving.lag", 0.0, 100.0, now=9.0
+        ) == 5.0
+        assert rec.quantile_over_window(
+            "serving.lag", 0.5, 100.0, now=9.0
+        ) == 7.0
+        assert rec.quantile_over_window(
+            "serving.lag", 1.0, 100.0, now=9.0
+        ) == 9.0
+
     def test_validation(self, registry):
         with pytest.raises(ValueError):
             TimeSeriesRecorder(registry=registry, interval_s=0)
