@@ -133,6 +133,13 @@ _FAULTNET_COUNTERS = (
     "wire.crc_fail", "faultnet.injected",
 )
 
+#: router-process result-cache counters the cache report tracks as
+#: deltas (ISSUE-16; run() can execute several times per process)
+_CACHE_COUNTERS = (
+    "router.cache.hit", "router.cache.miss", "router.cache.evicted",
+    "router.cache.uncacheable", "router.cache.collapsed",
+)
+
 
 def _load_wire():
     """The wire module by file path — no ``sparkdl_tpu`` package import,
@@ -169,6 +176,19 @@ def _worker(worker_id, host, port, args_dict, out_queue):
     weights = _zipf_weights(len(endpoints), args_dict["zipf_s"])
     dim = args_dict["dim"]
     value = np.ones(dim, dtype=np.float32)
+    # result-cache runs draw each request's INPUT from a Zipf-weighted
+    # key pool too — a constant input would turn any cache bench into a
+    # 100%-hit-rate test of nothing (cum_weights keeps the per-request
+    # draw O(log pool))
+    key_pool = args_dict.get("key_pool")
+    key_cum = None
+    if key_pool:
+        import itertools
+
+        key_cum = list(itertools.accumulate(
+            _zipf_weights(key_pool, args_dict["zipf_s"])
+        ))
+        key_range = range(key_pool)
     duration = args_dict["duration_s"]
     scenario = args_dict["scenario"]
     # rate is per-worker; each arrival event is a burst, so the event
@@ -177,7 +197,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
     mean_burst = 1.0 / (1.0 - burst_p)
     base_event_rate = max(args_dict["rate_per_worker"] / mean_burst, 0.1)
 
-    records = []  # (t_rel, latency_ms, outcome, server_ms, phases)
+    records = []  # (t_rel, latency_ms, outcome, server_ms, phases, cache)
     sock = None
     start = time.monotonic()
     while True:
@@ -195,9 +215,13 @@ def _worker(worker_id, host, port, args_dict, out_queue):
             if time.monotonic() - start >= duration:
                 break
             endpoint = rng.choices(endpoints, weights=weights)[0]
+            if key_cum is not None:
+                idx = rng.choices(key_range, cum_weights=key_cum)[0]
+                value = np.full(dim, 1.0 + idx * 1e-3, dtype=np.float32)
             t0 = time.monotonic()
             server_ms = None
             phases = None
+            cache_flag = None
             try:
                 if sock is None:
                     sock = wire.connect(host, port, 5.0)
@@ -217,6 +241,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                     outcome = "ok"
                     server_ms = reply.get("server_ms")
                     phases = reply.get("phases")
+                    cache_flag = reply.get("cache")
                 else:
                     outcome = reply.get("error_class", "UnknownError")
             except Exception as exc:
@@ -245,7 +270,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                     phases["egress"] = (t1 - t_send) * 1000.0
             records.append((
                 round(t0 - start, 4), round(latency_ms, 3), outcome,
-                server_ms, phases,
+                server_ms, phases, cache_flag,
             ))
     if sock is not None:
         try:
@@ -418,6 +443,13 @@ def run(args):
     if args.scenario == "faultnet":
         # before the supervisor constructs its Router (env read once)
         os.environ["SPARKDL_HEDGE"] = "1" if args.hedge == "on" else "0"
+    result_cache_on = getattr(args, "result_cache", "off") == "on"
+    if result_cache_on:
+        # before the supervisor constructs its Router, and inherited by
+        # replica children (arms their single-flight/negative tier)
+        os.environ["SPARKDL_RESULT_CACHE"] = "1"
+    else:
+        os.environ.pop("SPARKDL_RESULT_CACHE", None)
 
     from sparkdl_tpu.serving.replica import ReplicaSpec
     from sparkdl_tpu.serving.supervisor import ReplicaSupervisor
@@ -427,6 +459,9 @@ def run(args):
     # every counter the report quotes is a delta from here
     counters_base = {
         name: metrics.counter(name).value for name in _FAULTNET_COUNTERS
+    }
+    cache_base = {
+        name: metrics.counter(name).value for name in _CACHE_COUNTERS
     }
 
     obs_on = args.obs == "on"
@@ -449,11 +484,15 @@ def run(args):
         # envelopes and ingested into the ROUTER-side sink above
         os.environ["SPARKDL_TRACE_OUT"] = trace_path + ".replica"
 
-    factory = (
-        "sparkdl_tpu.serving.replica:demo_server"
-        if args.compile else
-        "sparkdl_tpu.serving.replica:demo_server_plain"
-    )
+    if getattr(args, "metered", False):
+        # the Zipf-sweep fleet: per-item metered forward cost, so
+        # replica capacity is a known constant the hit ratio multiplies
+        os.environ["SPARKDL_DEMO_COST_MS"] = str(args.forward_cost_ms)
+        factory = "sparkdl_tpu.serving.replica:demo_server_metered"
+    elif args.compile:
+        factory = "sparkdl_tpu.serving.replica:demo_server"
+    else:
+        factory = "sparkdl_tpu.serving.replica:demo_server_plain"
     fault_plans = None
     if args.scenario == "kill":
         fault_plans = {0: [{
@@ -500,6 +539,12 @@ def run(args):
         "workers": args.workers,
         "endpoints": args.endpoints,
         "zipf_s": args.zipf_s,
+        "result_cache": result_cache_on,
+        "key_pool": getattr(args, "key_pool", 0) or None,
+        "forward_cost_ms": (
+            args.forward_cost_ms if getattr(args, "metered", False)
+            else None
+        ),
         "burst_p": args.burst_p,
         "compile": bool(args.compile),
         "compile_cache": bool(args.cache_dir),
@@ -600,6 +645,7 @@ def run(args):
             "burst_p": args.burst_p,
             "burst_max": args.burst_max,
             "request_timeout_s": 15.0,
+            "key_pool": getattr(args, "key_pool", 0) or None,
             "tenants": (
                 args.tenants.split(",") if args.tenants else None
             ),
@@ -775,6 +821,70 @@ def run(args):
                 },
             },
         })
+        if result_cache_on:
+            # counter deltas FIRST (pure reads), then the byte-identity
+            # probe — its own routes must not pollute the run's deltas
+            cache_deltas = {
+                name: metrics.counter(name).value - cache_base[name]
+                for name in _CACHE_COUNTERS
+            }
+            hit_rows = [r for r in ok if len(r) > 5 and r[5] == "hit"]
+            collapsed_rows = [
+                r for r in ok if len(r) > 5 and r[5] == "collapsed"
+            ]
+            scored_rows = [r for r in ok if len(r) > 5 and not r[5]]
+            cache_bytes = metrics.gauge("router.cache.bytes").value
+            byte_identity = None
+            if getattr(args, "metered", False) \
+                    and supervisor.router.result_cache is not None:
+                # hit-path results must be byte-identical to a forced
+                # re-score: route, route again (hit), flush, route again
+                # (forced miss) — all three must carry the same bytes
+                try:
+                    import numpy as np
+
+                    rc = supervisor.router.result_cache
+                    # a value OUTSIDE the key pool (pool values are all
+                    # >= 1.0): the first route is a guaranteed fresh
+                    # miss, so all three scores share a batch shape and
+                    # the comparison is bitwise-fair
+                    probe = np.full(64, -3.75, dtype=np.float32)
+                    first = np.asarray(
+                        supervisor.router.route(probe, model_id="ep0")
+                    )
+                    hits_before = rc.snapshot(top=0)["hit"]
+                    again = np.asarray(
+                        supervisor.router.route(probe, model_id="ep0")
+                    )
+                    was_hit = rc.snapshot(top=0)["hit"] > hits_before
+                    rc.clear()
+                    forced = np.asarray(
+                        supervisor.router.route(probe, model_id="ep0")
+                    )
+                    byte_identity = bool(
+                        was_hit
+                        and again.tobytes() == first.tobytes()
+                        and forced.tobytes() == first.tobytes()
+                    )
+                except Exception:
+                    byte_identity = False
+            report["cache"] = {
+                "enabled": True,
+                "hit": len(hit_rows),
+                "collapsed": len(collapsed_rows),
+                "scored": len(scored_rows),
+                "hit_ratio": round(len(hit_rows) / len(ok), 4)
+                if ok else None,
+                "hit_latency_ms": _latency_stats(
+                    [r[1] for r in hit_rows]
+                ),
+                "miss_latency_ms": _latency_stats(
+                    [r[1] for r in scored_rows]
+                ),
+                "bytes": cache_bytes,
+                "counters": cache_deltas,
+                "byte_identity": byte_identity,
+            }
         if obs_on:
             fleet = supervisor.fleet_collector
             fleet_snap = None
@@ -916,6 +1026,26 @@ def main():
                     help="generator processes")
     ap.add_argument("--endpoints", type=int, default=3)
     ap.add_argument("--zipf-s", type=float, default=1.1)
+    ap.add_argument("--result-cache", default="off",
+                    choices=["on", "off"],
+                    help="arm the two-tier content-addressed result "
+                    "cache (SPARKDL_RESULT_CACHE=1: router LRU + "
+                    "replica single-flight/negative tier)")
+    ap.add_argument("--key-pool", type=int, default=0,
+                    help="draw each request's input from a Zipf-"
+                    "weighted pool of N distinct values (0 = the "
+                    "classic constant input); cache runs need this or "
+                    "every request is the same key")
+    ap.add_argument("--forward-cost-ms", type=float, default=15.0,
+                    help="zipf-sweep fleet: per-item metered forward "
+                    "cost (SPARKDL_DEMO_COST_MS) — fixes replica "
+                    "capacity so the hit ratio is the only variable")
+    ap.add_argument("--zipf-sweep", action="store_true",
+                    help="result-cache proof: sweep zipf_s over "
+                    "{0, 0.8, 1.1, 1.4} with the cache on and a metered "
+                    "fleet; assert goodput multiplies with skew while "
+                    "the miss path's p99 stays flat and hit bytes match "
+                    "forced re-scores")
     ap.add_argument("--burst-p", type=float, default=0.3,
                     help="geometric burst continuation probability")
     ap.add_argument("--burst-max", type=int, default=8)
@@ -997,6 +1127,7 @@ def main():
                     "profiler armed then unarmed) to measure profiler "
                     "overhead A/B")
     args = ap.parse_args()
+    args.metered = False
 
     if args.diag:
         args.obs = "on"
@@ -1031,6 +1162,117 @@ def main():
         args.workers = 2
         args.kill_at_requests = 100
         args.compile = False
+
+    if args.zipf_sweep:
+        # the Zipf-sweep proof (ISSUE-16): same metered fleet, same key
+        # pool, cache on — only the skew s varies.  Each pass is a
+        # smoke-shaped report nested under "s_<s>" so ci/perf_gate.py
+        # gates every point of the sweep independently (zipf_s is part
+        # of the shape key).
+        args.scenario = "steady"
+        args.compile = False
+        args.result_cache = "on"
+        args.metered = True
+        args.key_pool = args.key_pool or 16384
+        args.replicas = 2
+        args.duration = 15.0
+        # workers round-trip synchronously, so offered load must sit
+        # far above the metered miss-path capacity (2 replicas at 15
+        # ms/item ~= 133 rps) for the hit ratio — not the generators —
+        # to be what limits goodput; ONE endpoint, or per-endpoint
+        # batcher parallelism varies with the skew and confounds the
+        # capacity the sweep holds constant
+        args.rate = 960.0
+        args.workers = 24
+        args.endpoints = 1
+        if args.obs == "auto":
+            args.obs = "off"
+        passes = {}
+        for s in (0.0, 0.8, 1.1, 1.4):
+            args.zipf_s = s
+            passes[f"s_{s:g}"] = run(args)
+        base, mid = passes["s_0"], passes["s_1.1"]
+
+        def _cache_stat(rep, *path):
+            cur = rep.get("cache") or {}
+            for p in path:
+                cur = (cur or {}).get(p) if isinstance(cur, dict) \
+                    else None
+            return cur
+
+        multiplier = (
+            round(mid["goodput_rps"] / base["goodput_rps"], 2)
+            if base["goodput_rps"] else None
+        )
+        miss_p99_base = _cache_stat(base, "miss_latency_ms", "p99")
+        miss_p99_mid = _cache_stat(mid, "miss_latency_ms", "p99")
+        summary = {
+            "goodput_rps": {
+                k: p["goodput_rps"] for k, p in passes.items()
+            },
+            "hit_ratio": {
+                k: _cache_stat(p, "hit_ratio") for k, p in passes.items()
+            },
+            "miss_p99_ms": {
+                k: _cache_stat(p, "miss_latency_ms", "p99")
+                for k, p in passes.items()
+            },
+            "goodput_multiplier_s1.1_vs_s0": multiplier,
+            "byte_identity": {
+                k: _cache_stat(p, "byte_identity")
+                for k, p in passes.items()
+            },
+            "lost_accepted": {
+                k: p["lost_accepted"] for k, p in passes.items()
+            },
+        }
+        report = dict(
+            {"benchmark_suite": "bench_load_zipf_sweep",
+             "seed": args.seed, "summary": summary},
+            **passes,
+        )
+        print(json.dumps(report, indent=2, default=str))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+            print(f"wrote {args.out}", file=sys.stderr)
+        problems = []
+        if multiplier is None or multiplier < 2.0:
+            problems.append(
+                f"goodput multiplier at s=1.1 vs s=0 is {multiplier} "
+                "(want >= 2.0x at equal replicas)"
+            )
+        if miss_p99_base and miss_p99_mid \
+                and miss_p99_mid > 1.75 * miss_p99_base:
+            problems.append(
+                f"miss-path p99 not flat: {miss_p99_mid}ms at s=1.1 vs "
+                f"{miss_p99_base}ms at s=0 (want <= 1.75x)"
+            )
+        for key, p in passes.items():
+            if p["lost_accepted"] != 0:
+                problems.append(
+                    f"{key}: lost {p['lost_accepted']} accepted "
+                    f"requests ({p['lost_detail']})"
+                )
+            if _cache_stat(p, "byte_identity") is not True:
+                problems.append(
+                    f"{key}: hit-path bytes did not match the forced "
+                    "re-score"
+                )
+        if problems:
+            print("ZIPF SWEEP FAIL: " + "; ".join(problems),
+                  file=sys.stderr)
+            return 1
+        print(
+            "ZIPF SWEEP PASS: goodput "
+            + " -> ".join(
+                f"{k}={p['goodput_rps']}rps" for k, p in passes.items()
+            )
+            + f", multiplier(s=1.1 vs s=0)={multiplier}x, "
+            f"miss p99 {miss_p99_base} -> {miss_p99_mid} ms, 0 lost",
+            file=sys.stderr,
+        )
+        return 0
 
     if args.scenario == "faultnet" and not args.smoke:
         # the A/B proof: same seed and traffic shape, hedging on then

@@ -155,6 +155,25 @@ if python -m ci.perf_gate --fresh "$GATE_BAD"; then
 fi
 echo "perf gate: clean run passed, injected regression caught" >&2
 
+# result-cache fail-open smoke (ISSUE-16): arm the two-tier result
+# cache AND an error rule at p=1.0 on the cache.lookup site (router
+# process only — the supervisor strips SPARKDL_FAULT_PLAN from replica
+# children without explicit fault_plans).  Every single lookup now
+# throws before the cache can answer; the contract is that a broken
+# cache layer degrades to miss-path scoring — the kill smoke's own
+# invariants (zero accepted loss, recovery, nonzero goodput) must hold
+# exactly as if the cache weren't there.
+if ! timeout -k 10 60 env \
+    SPARKDL_FAULT_PLAN='[{"site":"cache.lookup","error":"transient","p":1.0}]' \
+    python benchmarks/bench_load.py --smoke \
+    --result-cache on 2>&1 | tee "$SMOKE_LOG"; then
+  echo "result-cache fail-open smoke FAILED: with every cache lookup" >&2
+  echo "faulted, serving must fall back to the miss path with zero" >&2
+  echo "accepted-request loss — see above" >&2
+  print_fleet_snapshot
+  exit 1
+fi
+
 # full static-analysis pass (replaces the per-script lints: one AST
 # parse per file, all nine rules); on failure print the JSON report so
 # CI logs carry the machine-readable findings, not just the exit code

@@ -94,9 +94,15 @@ def _is_report(obj: Any) -> bool:
 
 def shape_key(report: Dict[str, Any]) -> Tuple:
     """The comparability key; obs-armed runs never gate obs-off ones
-    (tracing is measured overhead, not regression)."""
+    (tracing is measured overhead, not regression).  Likewise a
+    result-cache run measures hit-path serving — its goodput must not
+    gate (or be gated by) cache-off baselines — and Zipf skew changes
+    the workload itself, so ``zipf_s`` joins the key (older reports
+    without the field read as None and keep matching each other)."""
     return tuple(report.get(f) for f in SHAPE_FIELDS) + (
         bool(report.get("obs") or report.get("trace")),
+        bool(report.get("result_cache")),
+        report.get("zipf_s"),
     )
 
 

@@ -34,11 +34,14 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: hardening.
 #: "diag" (trace-analytics report gauges) and "profile" (sampling-
 #: profiler accounting) joined with the ISSUE-15 diagnosis plane.
+#: "cache" (replica-tier single-flight / negative-cache accounting;
+#: the router tier rides the existing "router" prefix as
+#: ``router.cache.*``) joined with the ISSUE-16 result cache.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
     "streaming", "slo", "ts", "supervisor", "router", "wire",
     "rollout", "tenant", "fleet", "replica", "faultnet", "diag",
-    "profile",
+    "profile", "cache",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

@@ -87,7 +87,11 @@ class FleetCollector:
         targets_fn: Callable[[], Iterable[Target]],
         interval_s: float = 2.0,
         timeout_s: float = 1.0,
-        metric_prefixes: Iterable[str] = ("serving.", "sparkdl.up"),
+        metric_prefixes: Iterable[str] = (
+            # "cache." covers the replica-tier single-flight / negative
+            # cache counters so the ISSUE-16 result-cache series federate
+            "serving.", "sparkdl.up", "cache.",
+        ),
         registry: Optional[MetricsRegistry] = None,
         clock=time.monotonic,
     ):

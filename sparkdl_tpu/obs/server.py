@@ -25,6 +25,11 @@ serves the process's existing telemetry over HTTP:
 ``/debug/fleet``      :meth:`FleetCollector.snapshot` — per-replica
                       scrape state (who answered, who is failing, with
                       what) on the supervisor
+``/debug/cache``      the wired result-cache view (hit ratio, bytes,
+                      top-N hot keys, single-flight collapse count) —
+                      a :class:`~sparkdl_tpu.serving.result_cache.
+                      ResultCache`-like object or a ``(top) -> dict``
+                      callable
 ====================  ====================================================
 
 Design rules:
@@ -137,6 +142,7 @@ class ObsServer:
         span_sink=None,
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         fleet=None,
+        cache=None,
     ):
         self.host = host
         self._requested_port = int(port)
@@ -147,6 +153,7 @@ class ObsServer:
         self._span_sink = span_sink
         self._health_fn = health_fn
         self._fleet = fleet
+        self._cache = cache
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -160,6 +167,7 @@ class ObsServer:
         span_sink=None,
         health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         fleet=None,
+        cache=None,
     ) -> "ObsServer":
         """Wire components after construction (each is optional; a
         later attach replaces an earlier one for that slot)."""
@@ -174,6 +182,8 @@ class ObsServer:
                 self._health_fn = health_fn
             if fleet is not None:
                 self._fleet = fleet
+            if cache is not None:
+                self._cache = cache
         return self
 
     #: the served paths -> metric-segment labels; anything else pools
@@ -188,6 +198,7 @@ class ObsServer:
         "/debug/fleet": "debug_fleet",
         "/debug/diag": "debug_diag",
         "/debug/profile": "debug_profile",
+        "/debug/cache": "debug_cache",
     }
 
     @classmethod
@@ -230,6 +241,7 @@ class ObsServer:
             engine = self._slo_engine
             sink = self._span_sink
             fleet = self._fleet
+            cache = self._cache
 
         def jdump(status: int, obj: Any):
             body = json.dumps(obj, indent=2, default=str).encode()
@@ -241,6 +253,7 @@ class ObsServer:
                     "/metrics", "/metrics.json", "/healthz", "/slo",
                     "/debug/spans", "/debug/threads", "/debug/timeseries",
                     "/debug/fleet", "/debug/diag", "/debug/profile",
+                    "/debug/cache",
                 ],
             })
         if path == "/metrics":
@@ -309,6 +322,15 @@ class ObsServer:
                 # the env-armed profiler's lifetime aggregate, when on
                 payload["armed"] = armed.snapshot()
             return jdump(200, payload)
+        if path == "/debug/cache":
+            if cache is None:
+                return jdump(404, {"error": "no result cache attached"})
+            top = int(_query_number(query, "top", 10.0, 0.0, 64.0))
+            # duck-typed slot: the router wires a ResultCache-like
+            # object, replica/supervisor wire a (top) -> dict closure
+            if hasattr(cache, "snapshot"):
+                return jdump(200, cache.snapshot(top=top))
+            return jdump(200, cache(top))
         return jdump(404, {"error": f"unknown path {path!r}"})
 
     # ------------------------------------------------------------------

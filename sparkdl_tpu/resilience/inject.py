@@ -83,6 +83,11 @@ KNOWN_SITES = {
     # frame leaving a process (both lanes), ``faultnet.request`` /
     # ``faultnet.reply`` bracket a FaultyTransport round trip.
     "faultnet": ("faultnet.request", "faultnet.reply", "faultnet.tx"),
+    # result-cache lookup in the router's request path (ISSUE-16).  The
+    # site fires BEFORE fingerprint resolution, so an error rule here
+    # exercises the fail-open contract: any cache-layer failure must
+    # degrade to the miss path (full scoring), never to a request error.
+    "cache": ("cache.lookup",),
 }
 
 

@@ -54,6 +54,7 @@ __all__ = [
     "ReplicaSpec",
     "ReplicaSupervisor",
     "Request",
+    "ResultCache",
     "RolloutController",
     "Router",
     "ServerClosed",
@@ -88,6 +89,10 @@ def __getattr__(name):
         from sparkdl_tpu.serving.rollout import RolloutController
 
         return RolloutController
+    if name in ("ResultCache",):
+        from sparkdl_tpu.serving.result_cache import ResultCache
+
+        return ResultCache
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )

@@ -575,6 +575,13 @@ class MicroBatcher:
         return self._breaker
 
     @property
+    def fingerprint(self) -> Optional[str]:
+        """The durable model identity this endpoint was registered with
+        (None = uncacheable: no persistent compile cache AND no
+        result-cache keying)."""
+        return self._fingerprint
+
+    @property
     def degraded(self) -> bool:
         """True while the endpoint's circuit is not closed — new batches
         fail fast with ``CircuitOpen`` (or are probing, when half-open)."""
@@ -588,6 +595,7 @@ class MicroBatcher:
             ),
             "dtype": self._dtype.name,
             "compiled": self._compile,
+            "fingerprint": self._fingerprint,
             "queue_depth": self.queue_depth,
             "queue_capacity": self._queue.capacity,
             "worker_alive": self.worker_alive,

@@ -17,9 +17,11 @@ Lifecycle contract (what the supervisor and router rely on):
 
 - **ready line** — exactly one JSON line on stdout once warm and
   listening: ``{"ready": true, "pid", "port", "obs_port", "lanes",
-  "warmup"}``; everything after goes to stderr.  ``lanes`` is the wire
-  transports this replica accepts (the supervisor forwards it to
-  ``router.add``, where lane selection happens).
+  "warmup", "fingerprints"}``; everything after goes to stderr.
+  ``lanes`` is the wire transports this replica accepts and
+  ``fingerprints`` maps endpoints to their engine fingerprints (the
+  supervisor forwards both to ``router.add``, where lane selection and
+  result-cache keying happen).
 - **SIGTERM = drain** — stop admitting (new requests get the transient
   :class:`~sparkdl_tpu.serving.errors.ReplicaDraining`, which the router
   re-routes), finish every in-flight request, flush/close the server,
@@ -54,7 +56,17 @@ from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.serving import transport as transport_mod
 from sparkdl_tpu.serving import wire
-from sparkdl_tpu.serving.errors import DeadlineExceeded, ReplicaDraining
+from sparkdl_tpu.serving.errors import (
+    DeadlineExceeded,
+    ReplicaDraining,
+    ServerClosed,
+)
+from sparkdl_tpu.serving.result_cache import (
+    ENV_RESULT_CACHE,
+    NegativeCache,
+    SingleFlight,
+    canonical_digest,
+)
 from sparkdl_tpu.utils.metrics import metrics
 
 ENV_SPEC = "SPARKDL_REPLICA_SPEC"
@@ -163,6 +175,40 @@ def demo_server_plain():
     return demo_server(compile=False)
 
 
+def demo_server_metered(endpoints: int = 3):
+    """A fingerprinted, deliberately *metered* demo build for the
+    result-cache sweeps (ISSUE-16): plain numpy forwards that cost
+    ``SPARKDL_DEMO_COST_MS`` (default 15) per batched item — a stand-in
+    for real chip time, so replica throughput is capacity-bound and a
+    cache hit (which skips the replica entirely) visibly multiplies
+    goodput.  Fingerprints are durable across boots (the weights are
+    deterministic), so the router tier can key on them without any
+    compilation."""
+    from sparkdl_tpu.serving.batcher import ServingConfig
+    from sparkdl_tpu.serving.server import ModelServer
+
+    cost_s = float(os.environ.get("SPARKDL_DEMO_COST_MS", "15")) / 1000.0
+    dim = 64
+    server = ModelServer(config=ServingConfig(
+        max_batch=16, max_wait_ms=1.0, queue_capacity=512,
+    ))
+    for i in range(int(endpoints)):
+        weight = np.linspace(
+            -1.0, 1.0, dim * dim, dtype=np.float32
+        ).reshape(dim, dim) * (i + 1)
+
+        def forward(x, _w=weight):
+            x = np.asarray(x)
+            time.sleep(cost_s * max(1, int(x.shape[0])))
+            return np.tanh(x @ _w)
+
+        server.register(
+            f"ep{i}", forward, item_shape=(dim,), compile=False,
+            fingerprint=f"demo:ep{i}:dim{dim}:metered:v1",
+        )
+    return server
+
+
 def demo_server_slow(endpoints: int = 3):
     """A deliberately *regressed* demo build: every forward stalls
     ``SPARKDL_DEMO_DELAY_MS`` (default 80) before answering.  This is
@@ -267,6 +313,13 @@ class ReplicaService:
         self._m_requests = metrics.counter("supervisor.replica_requests")
         self._m_inflight = metrics.gauge("supervisor.replica_inflight")
         self._m_expired_shed = metrics.counter("replica.expired_shed")
+        # replica-tier result cache (ISSUE-16): single-flight collapses
+        # concurrent identical requests into one forward; the negative
+        # cache replays typed-permanent-error replies for poison inputs.
+        # Armed by the same env switch as the router tier.
+        cache_on = os.environ.get(ENV_RESULT_CACHE) == "1"
+        self._single_flight = SingleFlight() if cache_on else None
+        self._negative = NegativeCache() if cache_on else None
         # harvest this process's finished spans per trace so replies can
         # piggyback them back to the router for cross-process stitching
         self._harvest = _SpanHarvest()
@@ -328,7 +381,9 @@ class ReplicaService:
         staged = self._submit(msg)
         if staged[0] == "reply":
             return staged[1]
-        return self._finish(staged[1], staged[2], staged[3])
+        if staged[0] == "collapse":
+            return self._finish_collapse(*staged[1:])
+        return self._finish(*staged[1:])
 
     def _handle_batch(
         self, msgs: list
@@ -349,14 +404,20 @@ class ReplicaService:
                 replies.append(item[1])
                 continue
             try:
-                replies.append(self._finish(item[1], item[2], item[3]))
+                if item[0] == "collapse":
+                    replies.append(self._finish_collapse(*item[1:]))
+                else:
+                    replies.append(self._finish(*item[1:]))
             except Exception as exc:
                 replies.append(wire.encode_error(exc))
         return replies
 
     def _submit(self, msg: Dict[str, Any]):
         """Admit + submit one request; returns ``("reply", dict)`` for
-        control ops or ``("future", fut, t0, span)`` for inference."""
+        control ops, ``("future", fut, t0, span, flight, sf_key)`` for
+        inference, or ``("collapse", flight, t0, span)`` when the
+        single-flight map folded this request into an identical one
+        already being forwarded."""
         op = msg.get("op")
         if op == "ping":
             return ("reply", {"ok": True, "pid": os.getpid(),
@@ -385,9 +446,36 @@ class ReplicaService:
             self._inflight += 1
             self._m_inflight.set(self._inflight)
         ok = False
+        flight = None
+        sf_key = None
         try:
             inject.fire("supervisor.replica_serve")
             self._m_requests.add(1)
+            if self._single_flight is not None:
+                try:
+                    sf_key = (
+                        msg.get("model_id"), canonical_digest(msg["value"])
+                    )
+                except Exception:
+                    sf_key = None  # fail-open: undigestable -> forward
+            if sf_key is not None:
+                neg = self._negative.get(sf_key)
+                if neg is not None:
+                    # known-poison input: replay the typed error reply
+                    # without burning a batch slot (ok stays False so
+                    # the finally releases this request's inflight)
+                    reply = dict(neg)
+                    reply["cache"] = "negative"
+                    if span is not None:
+                        span.set_attribute("cache", "negative")
+                    self._end_span(span)
+                    return ("reply", reply)
+                flight, leader = self._single_flight.claim(sf_key)
+                if not leader:
+                    # collapsed: ride the leader's forward (ok=True —
+                    # _finish_collapse owns the inflight release)
+                    ok = True
+                    return ("collapse", flight, time.monotonic(), span)
             # the serve span is current for the submit, so the micro-
             # batcher's "serving.request" span becomes its child — one
             # stitched lineage from the router's root down to the batch
@@ -399,9 +487,13 @@ class ReplicaService:
                     tenant=msg.get("tenant"),
                 )
             ok = True
-            return ("future", fut, time.monotonic(), span)
+            return ("future", fut, time.monotonic(), span, flight, sf_key)
         except Exception as exc:
             self._end_span(span, type(exc))
+            if flight is not None:
+                # a failed leader must still publish, or followers hang
+                self._single_flight.resolve(flight, exc=exc)
+            self._maybe_negative(sf_key, exc)
             raise
         finally:
             if not ok:
@@ -431,7 +523,8 @@ class ReplicaService:
             span.set_attribute("error", exc_type.__name__)
         span.end()
 
-    def _finish(self, fut, t0: float, span=None) -> Dict[str, Any]:
+    def _finish(self, fut, t0: float, span=None, flight=None,
+                sf_key=None) -> Dict[str, Any]:
         try:
             result = fut.result(timeout=self._request_timeout_s)
             reply = {
@@ -446,6 +539,10 @@ class ReplicaService:
             phases = getattr(fut, "sparkdl_phases", None)
             if phases:
                 reply["phases"] = dict(phases)
+            if flight is not None:
+                # fan the result out to collapsed followers — minus
+                # "spans", which belong to this request's trace only
+                self._single_flight.resolve(flight, reply=dict(reply))
             if span is not None:
                 span.end()
                 # piggyback this trace's finished replica-side spans
@@ -454,9 +551,73 @@ class ReplicaService:
             return reply
         except Exception as exc:
             self._end_span(span, type(exc))
+            if flight is not None:
+                self._single_flight.resolve(flight, exc=exc)
+            self._maybe_negative(sf_key, exc)
             raise
         finally:
             self._done_one()
+
+    def _finish_collapse(self, flight, t0: float, span=None) -> Dict[str, Any]:
+        """Follower half of the single-flight: wait for the leader's
+        outcome and restamp it as this request's reply.  The leader's
+        phase breakdown is dropped (it decomposes the *leader's* wall
+        time, which is longer than this follower's wait) and
+        ``server_ms`` becomes the follower's own submit->fan-out time so
+        router-side phase accounting still sums to what the client saw."""
+        try:
+            if not flight.event.wait(timeout=self._request_timeout_s):
+                raise TimeoutError(
+                    "single-flight leader never resolved "
+                    f"(key={flight.key!r})"
+                )
+            if flight.exc is not None:
+                raise flight.exc
+            reply = dict(flight.reply)
+            reply.pop("phases", None)
+            reply.pop("spans", None)
+            reply["cache"] = "collapsed"
+            reply["server_ms"] = round((time.monotonic() - t0) * 1000.0, 3)
+            if span is not None:
+                span.set_attribute("cache", "collapsed")
+                span.end()
+                reply["spans"] = self._harvest.take(span.trace_id)
+            return reply
+        except Exception as exc:
+            self._end_span(span, type(exc))
+            raise
+        finally:
+            self._done_one()
+
+    def _maybe_negative(self, sf_key, exc: BaseException) -> None:
+        """Remember a typed-permanent error reply for this exact input.
+        Transient refusals (overload, drain), deadline expiries, close
+        races, and connection-shaped failures are about the *moment*;
+        only input-determined failures may replay from memory."""
+        if sf_key is None or self._negative is None:
+            return
+        if isinstance(exc, (DeadlineExceeded, ServerClosed,
+                            ConnectionError, OSError)):
+            return
+        try:
+            from sparkdl_tpu.resilience.errors import is_transient
+
+            if is_transient(exc):
+                return
+            self._negative.put(sf_key, wire.encode_error(exc))
+        except Exception:
+            pass  # the negative cache is an optimization, never a risk
+
+    def cache_snapshot(self, top: int = 10) -> Dict[str, Any]:
+        """Replica-tier view for ``/debug/cache``: single-flight and
+        negative-cache state (the router tier owns the LRU view)."""
+        out: Dict[str, Any] = {"tier": "replica", "enabled":
+                               self._single_flight is not None}
+        if self._single_flight is not None:
+            out["singleflight"] = self._single_flight.stats()
+        if self._negative is not None:
+            out["negative"] = self._negative.stats()
+        return out
 
     def _done_one(self) -> None:
         with self._idle:
@@ -528,7 +689,8 @@ def main() -> int:
     from sparkdl_tpu.obs.server import ObsServer
 
     obs = ObsServer(
-        port=spec.obs_port, host=spec.host, health_fn=server.status
+        port=spec.obs_port, host=spec.host, health_fn=server.status,
+        cache=service.cache_snapshot,
     ).start()
 
     stop = threading.Event()
@@ -545,6 +707,9 @@ def main() -> int:
         "obs_port": obs.port,
         "lanes": list(service.lanes),
         "warmup": warmup_report,
+        # endpoint -> engine fingerprint: the version half of every
+        # result-cache key; the supervisor forwards it to router.add
+        "fingerprints": getattr(server, "fingerprints", dict)(),
     }), flush=True)
 
     while not stop.wait(0.5):

@@ -63,6 +63,13 @@ from sparkdl_tpu.serving.errors import (
     NoLiveReplicas,
     ServerOverloaded,
 )
+from sparkdl_tpu.serving.result_cache import (
+    ENV_RESULT_CACHE,
+    ENV_RESULT_CACHE_BYTES,
+    ResultCache,
+    canonical_digest,
+    result_key,
+)
 from sparkdl_tpu.utils.metrics import metrics
 
 #: version every backend belongs to unless told otherwise
@@ -198,6 +205,7 @@ class Router:
         hedge: Optional[bool] = None,
         retry_budget_ratio: Optional[float] = None,
         retry_budget_burst: Optional[float] = None,
+        result_cache: Optional[ResultCache] = None,
     ):
         self._lock = threading.Lock()
         self._backends: Dict[str, _Backend] = {}
@@ -251,18 +259,35 @@ class Router:
                 else float(os.environ.get(ENV_RETRY_BURST, "32"))
             ),
         )
+        # content-addressed result cache (ISSUE-16) — opt-in: the bench
+        # generators send constant inputs, so an always-on cache would
+        # silently turn every established baseline into a hit-rate test
+        if result_cache is None and os.environ.get(ENV_RESULT_CACHE) == "1":
+            result_cache = ResultCache(max_bytes=int(
+                os.environ.get(ENV_RESULT_CACHE_BYTES, str(64 * 1024 * 1024))
+            ))
+        self._result_cache = result_cache
+        #: (version, model_id) -> engine fingerprint, fed by :meth:`add`
+        #: from each replica's ready-line advertisement.  Entries are
+        #: keyed by version, never flushed: a rollout flip simply makes
+        #: requests resolve v2's fingerprint, so v1 keys stop matching.
+        self._fingerprints: Dict[Tuple[str, str], str] = {}
+        self._m_cache_collapsed = metrics.counter("router.cache.collapsed")
 
     # ------------------------------------------------------------------
     # membership (the supervisor's side of the interface)
     # ------------------------------------------------------------------
     def add(self, name: str, host: str, port: int,
             lanes: Tuple[str, ...] = ("tcp",),
-            version: str = DEFAULT_VERSION) -> None:
+            version: str = DEFAULT_VERSION,
+            fingerprints: Optional[Dict[str, str]] = None) -> None:
         """Register a replica.  ``lanes`` is what it advertised in its
         ready line; the transport factory (and the
         ``SPARKDL_WIRE_TRANSPORT`` override) picks the lane.
         ``version`` is the deployment group weighted placement splits
-        over."""
+        over.  ``fingerprints`` maps the replica's endpoint ids to their
+        engine fingerprints — the version half of every result-cache
+        key; an endpoint that advertises none stays uncacheable."""
         backend = _Backend(
             name, host, port, lanes=tuple(lanes), version=version,
             connect_timeout_s=self._connect_timeout_s,
@@ -272,8 +297,17 @@ class Router:
             old = self._backends.pop(name, None)
             self._backends[name] = backend
             self._m_replicas.set(len(self._backends))
+            for mid, fp in (fingerprints or {}).items():
+                if fp:
+                    self._fingerprints[(str(version), str(mid))] = str(fp)
         if old is not None:
             old.close()
+
+    @property
+    def result_cache(self) -> Optional[ResultCache]:
+        """The router-tier result cache, or None when disabled (what
+        the supervisor hands ``/debug/cache``)."""
+        return self._result_cache
 
     def remove(self, name: str) -> None:
         """Stop placing on ``name`` (drain-begin or death).  In-flight
@@ -376,6 +410,37 @@ class Router:
             tm = self._tm.setdefault(tenant, _TenantInstruments(tenant))
         return tm
 
+    def _roll_version(self, pin: Optional[str]) -> Optional[str]:
+        """The deployment version this request will be served by: the
+        pin when given, else one weighted roll over the live versions —
+        made ONCE, before the cache lookup, so the cache key and the
+        placement agree (the miss path then pins ``_pick`` to the
+        rolled version instead of rolling again).  None when no live
+        version exists or every candidate is zero-weighted (placement
+        unpredictable -> uncacheable this request)."""
+        with self._lock:
+            versions = sorted({
+                b.version for b in self._backends.values()
+                if not b.removed and (pin is None or b.version == pin)
+            })
+            if not versions:
+                return None
+            if pin is not None:
+                return pin
+            if len(versions) == 1:
+                return versions[0]
+            weighted = [(v, self._weights.get(v, 1.0)) for v in versions]
+            total = sum(w for _, w in weighted)
+            if total <= 0:
+                return None
+            roll = self._rng.random() * total
+            acc = 0.0
+            for v, w in weighted:
+                acc += w
+                if roll < acc:
+                    return v
+            return weighted[-1][0]
+
     def _pick(self, tried, pin: Optional[str] = None) -> Optional[_Backend]:
         """Choose a version by weight (or honour ``pin``), then the
         backend with the fewest in-flight within it, excluding
@@ -456,6 +521,75 @@ class Router:
         if remaining_s <= 0:
             return None
         return min(delay_ms / 1000.0, remaining_s / 2.0)
+
+    def _observe_phase(self, name: str, ms: float,
+                       exemplar: Optional[int] = None) -> None:
+        h = self._m_phase.get(name)
+        if h is None:
+            h = self._m_phase.setdefault(
+                name,
+                metrics.histogram(
+                    f"router.phase.{_sanitize_label(str(name))}"
+                ),
+            )
+        h.observe(float(ms), exemplar=exemplar)
+
+    def _cache_lookup(self, base_id, pin, value, tm, span):
+        """Router-tier result-cache step (ISSUE-16).  Returns
+        ``(hit_reply, key, version, lookup_ms)``: a non-None
+        ``hit_reply`` is served NOW — before admission, placement, or
+        any wire frame; a non-None ``key`` tells the miss path to pin
+        placement to ``version`` and populate the key on success.
+        Fail-open by contract: any failure in here (including an
+        injected ``cache.lookup`` fault) degrades the request to plain
+        miss-path scoring, never to an error."""
+        cache = self._result_cache
+        if cache is None or base_id is None or value is None:
+            return None, None, None, None
+        t0 = time.monotonic()
+        try:
+            inject.fire("cache.lookup")
+            version = self._roll_version(pin)
+            fp = (
+                self._fingerprints.get((version, base_id))
+                if version is not None else None
+            )
+            if fp is None:
+                # no fingerprint -> no stable identity to key on (the
+                # PR-5 rule at request granularity)
+                cache.uncacheable()
+                return None, None, None, (time.monotonic() - t0) * 1000.0
+            key = result_key(fp, canonical_digest(value))
+            hit = cache.get(key)
+            lookup_ms = (time.monotonic() - t0) * 1000.0
+            if hit is None:
+                return None, key, version, lookup_ms
+        except Exception:
+            return None, None, None, None
+        # the hit: charged to the tenant (same DRR accounting as a
+        # scored request) but consuming no admission slot and no
+        # replica inflight budget; stamped as its own ``cache`` phase
+        # so diag attribution still explains e2e p50
+        self._m_requests.add(1)
+        if tm is not None:
+            tm.requests.add(1)
+        exemplar = span.trace_id if span is not None else None
+        self._m_latency.observe(lookup_ms, exemplar=exemplar)
+        if tm is not None:
+            tm.latency.observe(lookup_ms, exemplar=exemplar)
+        self._observe_phase("cache", lookup_ms, exemplar)
+        reply = {
+            "ok": True,
+            "result": hit,
+            "server_ms": None,
+            "cache": "hit",
+            "phases": {"cache": lookup_ms},
+        }
+        if span is not None:
+            span.set_attribute("cache", "hit")
+            span.set_attribute("phases", {"cache": lookup_ms})
+            span.set_attribute("e2e_ms", lookup_ms)
+        return reply, key, version, lookup_ms
 
     def _classify(self, exc: BaseException) -> str:
         """``"retry"`` for connection-shaped or transient-typed
@@ -630,6 +764,15 @@ class Router:
             if tracer.enabled else None
         )
         try:
+            hit_reply, cache_key, cache_version, cache_ms = (
+                self._cache_lookup(base_id, pin, value, tm, span)
+            )
+            if hit_reply is not None:
+                return hit_reply
+            # a cacheable miss pins placement to the version the key
+            # was rolled for, so the populate below can never store a
+            # v1 result under a v2 key (or vice versa)
+            effective_pin = pin if cache_version is None else cache_version
             t_in = time.monotonic()
             self._admit(tm)
             start = time.monotonic()
@@ -673,7 +816,16 @@ class Router:
                             tm.errors.add(1)
                         assert last_exc is not None
                         raise last_exc
-                    backend = self._pick(tried, pin=pin)
+                    backend = self._pick(tried, pin=effective_pin)
+                    if (backend is None and cache_version is not None
+                            and pin is None):
+                        # the cache-rolled version lost its replicas
+                        # mid-request: availability beats key affinity —
+                        # unpin, stop populating, and re-place
+                        effective_pin = None
+                        cache_key = None
+                        cache_version = None
+                        continue
                     if backend is None:
                         self._m_errors.add(1)
                         if tm is not None:
@@ -687,7 +839,7 @@ class Router:
                         )
                     try:
                         reply, winner, attempt_start = self._attempt_or_hedge(
-                            backend, tried, pin, value, base_id,
+                            backend, tried, effective_pin, value, base_id,
                             deadline_ms is not None, tenant, deadline, span,
                         )
                     except Exception as exc:
@@ -723,11 +875,28 @@ class Router:
                         span.set_attribute("version", winner.version)
                         for remote_span in shipped or ():
                             tracer.ingest(remote_span)
+                    if reply.get("cache") == "collapsed":
+                        # the replica's single-flight folded this
+                        # request into another's forward
+                        self._m_cache_collapsed.add(1)
+                        if span is not None:
+                            span.set_attribute("cache", "collapsed")
+                    if (cache_key is not None
+                            and winner.version == cache_version):
+                        try:
+                            # hedge-safe: only the race winner reaches
+                            # here, and put() is idempotent besides
+                            self._result_cache.put(
+                                cache_key, reply["result"]
+                            )
+                        except Exception:
+                            pass  # populate is best-effort, fail-open
                     self._decompose(
                         reply,
                         admission_ms=admission_ms,
                         queue_ms=(attempt_start - start) * 1000.0,
                         attempt_ms=(now - attempt_start) * 1000.0,
+                        cache_ms=cache_ms,
                         exemplar=exemplar,
                     )
                     if span is not None:
@@ -754,17 +923,22 @@ class Router:
 
     def _decompose(self, reply: Dict[str, Any], admission_ms: float,
                    queue_ms: float, attempt_ms: float,
+                   cache_ms: Optional[float] = None,
                    exemplar: Optional[int] = None) -> None:
         """Merge the router-side phases into the reply's breakdown and
         observe each as ``router.phase.<name>``.  The transport phase
         is the winning attempt's wall time minus what finer phases
         already account for (client-side wire work stamped by the
-        transport, replica-side ``server_ms``), clamped at zero."""
+        transport, replica-side ``server_ms``), clamped at zero.
+        ``cache_ms`` is the miss-path lookup cost — tiny, but part of
+        the e2e latency the decomposition promises to explain."""
         phases = reply.get("phases")
         if not isinstance(phases, dict):
             phases = reply["phases"] = {}
         phases["admission"] = admission_ms
         phases["router_queue"] = queue_ms
+        if cache_ms is not None:
+            phases["cache"] = cache_ms
         try:
             accounted = (
                 float(phases.get("wire") or 0.0)
@@ -776,15 +950,7 @@ class Router:
         for name, ms in phases.items():
             if not isinstance(ms, (int, float)):
                 continue
-            h = self._m_phase.get(name)
-            if h is None:
-                h = self._m_phase.setdefault(
-                    name,
-                    metrics.histogram(
-                        f"router.phase.{_sanitize_label(str(name))}"
-                    ),
-                )
-            h.observe(float(ms), exemplar=exemplar)
+            self._observe_phase(str(name), float(ms), exemplar)
 
     def _send_one(self, backend: _Backend, value, model_id, deadline_ms,
                   tenant: Optional[str], timeout_s: float,
@@ -849,6 +1015,11 @@ class Router:
                                 "result": inner["result"],
                                 "server_ms": inner.get("server_ms"),
                             }
+                            if inner.get("cache"):
+                                # hit / collapsed marker, so clients
+                                # (and the bench report) can split
+                                # hit-path from miss-path latency
+                                reply["cache"] = inner["cache"]
                             phases = inner.get("phases")
                             if isinstance(phases, dict):
                                 phases = dict(phases)

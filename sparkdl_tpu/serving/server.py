@@ -186,6 +186,17 @@ class ModelServer:
                 f"{sorted(self._endpoints)}"
             ) from None
 
+    def fingerprints(self) -> Dict[str, str]:
+        """Endpoint id -> durable fingerprint, for every endpoint that
+        has one.  What a replica advertises in its ready line — the
+        version half of the router's result-cache keys; endpoints
+        without a fingerprint are simply absent (uncacheable)."""
+        return {
+            mid: ep.fingerprint
+            for mid, ep in self._endpoints.items()
+            if ep.fingerprint
+        }
+
     def submit(
         self,
         value,

@@ -88,6 +88,7 @@ class ReplicaHandle:
         self.obs_port: Optional[int] = None
         self.lanes: Tuple[str, ...] = ("tcp",)
         self.warmup: Dict[str, Any] = {}
+        self.fingerprints: Dict[str, str] = {}
         self.health_bad = 0
         self.fault_armed = False
         self.last_exit: Optional[int] = None
@@ -299,6 +300,7 @@ class ReplicaSupervisor:
             handle.obs_port = int(ready["obs_port"])
             handle.lanes = tuple(ready.get("lanes", ("tcp",)))
             handle.warmup = ready.get("warmup", {})
+            handle.fingerprints = dict(ready.get("fingerprints") or {})
             handle.generation += 1
             handle.attempt = 0
             handle.restart_at = None
@@ -311,6 +313,7 @@ class ReplicaSupervisor:
         self.router.add(
             handle.name, handle.spec.host, handle.port,
             lanes=handle.lanes, version=handle.version,
+            fingerprints=handle.fingerprints,
         )
         self._m_spawn_time.add_seconds(time.monotonic() - started)
         logger.info(
@@ -791,6 +794,18 @@ class ReplicaSupervisor:
             fleet = FleetCollector(
                 recorder, self.obs_targets, interval_s=fleet_interval_s,
             ).start()
+        cache_view = None
+        if self.router.result_cache is not None:
+            result_cache = self.router.result_cache
+
+            def cache_view(top: int = 10):
+                # the router-tier LRU view plus the collapse count the
+                # replicas reported back through reply markers
+                snap = result_cache.snapshot(top=top)
+                snap["collapsed"] = metrics.counter(
+                    "router.cache.collapsed"
+                ).value
+                return snap
         server = ObsServer(
             port=port,
             host=host,
@@ -798,6 +813,7 @@ class ReplicaSupervisor:
             slo_engine=engine,
             health_fn=self.status,
             fleet=fleet,
+            cache=cache_view,
         ).start()
         self._telemetry = {
             "server": server, "recorder": recorder, "engine": engine,

@@ -126,9 +126,11 @@ ENVELOPE_FIELDS = frozenset({
     "op", "model_id", "value", "deadline_ms", "tenant", "trace", "seq",
     # shm lane upgrade handshake
     "shm", "ring_bytes",
-    # replies
+    # replies ("cache" marks how the result was produced — "hit" from
+    # the router tier, "collapsed" when single-flight fanned a leader's
+    # reply out, "negative" when a poison-input error replayed)
     "ok", "result", "server_ms", "phases", "spans",
-    "pid", "draining", "replicas",
+    "pid", "draining", "replicas", "cache",
     # typed errors
     "error", "error_class",
 })

@@ -43,7 +43,7 @@ PLAIN_FACTORY = "sparkdl_tpu.serving.replica:demo_server_plain"
 # ----------------------------------------------------------------------
 # versioned routing (in-process replica services, real sockets)
 # ----------------------------------------------------------------------
-def versioned_service(counter=None, scale=2.0):
+def versioned_service(counter=None, scale=2.0, fingerprint=None):
     server = ModelServer(ServingConfig(
         max_batch=8, max_wait_ms=1.0, queue_capacity=64,
     ))
@@ -54,7 +54,8 @@ def versioned_service(counter=None, scale=2.0):
             counter.extend([1] * batch.shape[0])
         return batch * scale
 
-    server.register("ep0", forward, item_shape=(4,), compile=False)
+    server.register("ep0", forward, item_shape=(4,), compile=False,
+                    fingerprint=fingerprint)
     return ReplicaService(server).start()
 
 
@@ -186,6 +187,49 @@ class TestVersionedRouter:
         with Router() as router:
             with pytest.raises(ValueError):
                 router.set_weights({"v2": -0.1})
+
+    def test_rollout_flip_invalidates_result_cache(self, monkeypatch):
+        # ISSUE-16 invalidation-by-construction: the result-cache key
+        # embeds the endpoint-version fingerprint, so promoting v2 (a
+        # weight flip — exactly what RolloutController.set_primary
+        # drives) retargets every lookup at v2's key space.  v1's
+        # cached result must never be served for v2 traffic, with ZERO
+        # manual flushes, and flipping BACK must re-serve v1's still-
+        # warm entries without re-scoring.
+        monkeypatch.setenv("SPARKDL_RESULT_CACHE", "1")
+        served_v1, served_v2 = [], []
+        svc1 = versioned_service(served_v1, scale=2.0,
+                                 fingerprint="weights:v1")
+        svc2 = versioned_service(served_v2, scale=3.0,
+                                 fingerprint="weights:v2")
+        with Router(seed=7) as router:
+            router.add("r1", "127.0.0.1", svc1.port,
+                       fingerprints={"ep0": "weights:v1"})
+            router.add("r2", "127.0.0.1", svc2.port, version="v2",
+                       fingerprints={"ep0": "weights:v2"})
+            router.set_weights({"v1": 1.0, "v2": 0.0})
+            x = np.ones(4, np.float32)
+            try:
+                # warm v1's cache entry, then serve it from cache
+                for _ in range(3):
+                    out = router.route(x, model_id="ep0")
+                    np.testing.assert_allclose(np.asarray(out), 2.0)
+                assert len(served_v1) == 1
+                # the rollout flip: all weight to v2, no cache flush
+                router.set_weights({"v1": 0.0, "v2": 1.0})
+                for _ in range(3):
+                    out = router.route(x, model_id="ep0")
+                    # THE assertion: v2 traffic never sees v1's 2.0
+                    np.testing.assert_allclose(np.asarray(out), 3.0)
+                assert len(served_v2) == 1  # miss once, then v2 hits
+                # flip back: v1's entry is still warm — zero re-scores
+                router.set_weights({"v1": 1.0, "v2": 0.0})
+                out = router.route(x, model_id="ep0")
+                np.testing.assert_allclose(np.asarray(out), 2.0)
+                assert len(served_v1) == 1
+            finally:
+                svc1.close()
+                svc2.close()
 
 
 # ----------------------------------------------------------------------

@@ -148,6 +148,7 @@ class TestEnvelopeSchema:
             "spans": [{"name": "replica.serve", "trace_id": 12345}],
             "pid": 4242, "draining": False,
             "replicas": ("replica-0",), "seq": 7,
+            "cache": "hit",
         },
         "error": {
             "ok": False, "error": "boom",
