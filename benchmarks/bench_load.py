@@ -197,7 +197,9 @@ def _worker(worker_id, host, port, args_dict, out_queue):
     mean_burst = 1.0 / (1.0 - burst_p)
     base_event_rate = max(args_dict["rate_per_worker"] / mean_burst, 0.1)
 
-    records = []  # (t_rel, latency_ms, outcome, server_ms, phases, cache)
+    # (t_rel, latency_ms, outcome, server_ms, phases, cache, endpoint,
+    # tenant) — consumers index, so new fields only ever append
+    records = []
     sock = None
     start = time.monotonic()
     while True:
@@ -270,7 +272,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                     phases["egress"] = (t1 - t_send) * 1000.0
             records.append((
                 round(t0 - start, 4), round(latency_ms, 3), outcome,
-                server_ms, phases, cache_flag,
+                server_ms, phases, cache_flag, endpoint, tenant,
             ))
     if sock is not None:
         try:
@@ -944,6 +946,8 @@ def run(args):
                 "target": autoscaler.target,
                 "decisions": autoscaler.decisions(),
             }
+        if getattr(args, "record_traces", None):
+            _write_trace_file(args.record_traces, args, report, records)
     finally:
         if rollout is not None:
             rollout.close()
@@ -951,6 +955,53 @@ def run(args):
             autoscaler.close()
         supervisor.close()
     return report
+
+
+def _write_trace_file(path, args, report, records):
+    """``--record-traces``: dump a replay-ready sparkdl_trace JSONL —
+    header (run shape + the live latency/phase summary the simulator's
+    fidelity check compares against) followed by one record per request
+    in arrival order.  ``sparkdl_tpu.sim`` replays this file against
+    the real control plane on a virtual clock."""
+    from sparkdl_tpu.sim.trace import TraceRecord, write_trace
+
+    rows = []
+    for r in sorted(records, key=lambda r: r[0]):
+        phases = {
+            str(k): float(v)
+            for k, v in (r[4] or {}).items()
+            if isinstance(v, (int, float)) and not str(k).startswith("t_")
+        } if isinstance(r[4], dict) else {}
+        rows.append(TraceRecord(
+            t=float(r[0]),
+            endpoint=str(r[6]) if len(r) > 6 and r[6] else "ep0",
+            tenant=r[7] if len(r) > 7 else None,
+            outcome=str(r[2]),
+            latency_ms=float(r[1]),
+            server_ms=float(r[3]) if r[3] is not None else None,
+            phases=phases,
+        ))
+    meta = {
+        "benchmark": "bench_load",
+        "scenario": args.scenario,
+        "duration_s": args.duration,
+        "rate": args.rate,
+        "endpoints": args.endpoints,
+        "replicas": args.replicas,
+        "seed": args.seed,
+        "tenants": args.tenants.split(",") if args.tenants else None,
+        "live": {
+            "sent": report.get("sent"),
+            "ok": report.get("ok"),
+            "shed": report.get("shed"),
+            "expired": report.get("expired"),
+            "latency_ms": report.get("latency_ms"),
+            "phases_ms": report.get("phases_ms"),
+        },
+    }
+    n = write_trace(path, meta, rows)
+    report["trace_records"] = {"out": path, "records": n}
+    return n
 
 
 def _print_fleet_on_fail(report):
@@ -1116,6 +1167,11 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="write the JSON report here (stdout always)")
+    ap.add_argument("--record-traces", default=None, metavar="PATH",
+                    help="dump a replay-ready sparkdl_trace JSONL "
+                    "(arrival times + 8-phase decomposition + tenant/"
+                    "endpoint labels) sparkdl_tpu.sim can re-run "
+                    "against the real control plane on a virtual clock")
     ap.add_argument("--smoke", action="store_true",
                     help="CI mode: short kill run, assert zero "
                     "accepted-request loss + recovery, exit non-zero "

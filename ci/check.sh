@@ -54,4 +54,14 @@ EOF
 
 python -m ci.perf_gate --trajectory || rc=1
 
+# Sim flavor: replay the committed fixture trace against the committed
+# ci/sim_tuned.json recommendation — deterministic event log, still
+# beats the default config on SLO burn, burn within drift band.  Skips
+# (with a note) when the fixture or artifact is not committed yet.
+if [[ -f tests/fixtures/sim_trace_small.jsonl && -f ci/sim_tuned.json ]]; then
+    python -m ci.perf_gate --sim || rc=1
+else
+    echo "perf_gate: --sim skipped (no committed trace/artifact)"
+fi
+
 exit "$rc"

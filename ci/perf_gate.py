@@ -98,11 +98,15 @@ def shape_key(report: Dict[str, Any]) -> Tuple:
     result-cache run measures hit-path serving — its goodput must not
     gate (or be gated by) cache-off baselines — and Zipf skew changes
     the workload itself, so ``zipf_s`` joins the key (older reports
-    without the field read as None and keep matching each other)."""
+    without the field read as None and keep matching each other).
+    Simulated replays (``"sim": true`` — virtual clock, no device)
+    measure a model of the fleet, never the fleet: they must not gate
+    live ``BENCH_LOAD_r*.json`` numbers in either direction."""
     return tuple(report.get(f) for f in SHAPE_FIELDS) + (
         bool(report.get("obs") or report.get("trace")),
         bool(report.get("result_cache")),
         report.get("zipf_s"),
+        bool(report.get("sim")),
     )
 
 
@@ -278,6 +282,96 @@ def gate_fresh(
     }
 
 
+DEFAULT_SIM_TRACE = os.path.join(
+    "tests", "fixtures", "sim_trace_small.jsonl"
+)
+DEFAULT_SIM_ARTIFACT = os.path.join("ci", "sim_tuned.json")
+
+#: drift band for the committed-artifact replay: the fresh burn may
+#: exceed the recorded number by at most this ratio + floor before the
+#: artifact must be regenerated (simulator changes move the numbers —
+#: the artifact is pinned OUTPUT, so it must move in the same diff)
+SIM_BURN_BAND = 0.10
+SIM_BURN_FLOOR = 5.0
+
+
+def gate_sim(
+    trace_path: str, artifact_path: str,
+) -> Dict[str, Any]:
+    """Replay the committed trace against the committed tuned config
+    (``ci/sim_tuned.json``): the recommendation stays deterministic,
+    still beats the default config on SLO burn, and its burn has not
+    drifted past the recorded number — so a control-plane change that
+    invalidates the tuned config fails CI instead of shipping."""
+    from sparkdl_tpu.sim.replay import FleetReplay
+    from sparkdl_tpu.sim.trace import load_trace
+    from sparkdl_tpu.sim.tune import EVAL_HARNESS
+
+    with open(artifact_path) as fh:
+        artifact = json.load(fh)
+    if artifact.get("kind") != "sim_tuned":
+        raise ValueError(
+            f"{artifact_path}: not a sim_tuned artifact"
+        )
+    _, records = load_trace(trace_path)
+    if not records:
+        raise ValueError(f"{trace_path}: no trace records")
+    seed = int(artifact.get("seed", 0))
+    time_scale = float(artifact.get("time_scale", 4.0))
+
+    def replay(config: Dict[str, Any]) -> Dict[str, Any]:
+        return FleetReplay(
+            records, config={**EVAL_HARNESS, **config},
+            seed=seed, time_scale=time_scale,
+        ).run()
+
+    rec_cfg = artifact["recommended"]["config"]
+    first = replay(rec_cfg)
+    second = replay(rec_cfg)
+    default_run = replay(artifact["default"]["config"])
+    rec_burn = first["slo"]["burn_integral"]
+    default_burn = default_run["slo"]["burn_integral"]
+    recorded = float(artifact["recommended"]["burn_integral"])
+    limit = round(recorded * (1.0 + SIM_BURN_BAND) + SIM_BURN_FLOOR, 4)
+    rows = [
+        {
+            "metric": "sim.deterministic",
+            "baseline": 1.0,
+            "fresh": float(
+                first["event_log_sha256"] == second["event_log_sha256"]
+            ),
+            "limit": 1.0, "direction": "min",
+            "ok": first["event_log_sha256"]
+            == second["event_log_sha256"],
+            "waived": None,
+        },
+        {
+            "metric": "sim.recommended_burn_vs_default",
+            "baseline": default_burn,
+            "fresh": rec_burn,
+            "limit": default_burn, "direction": "max",
+            "ok": rec_burn <= default_burn,
+            "waived": None,
+        },
+        {
+            "metric": "sim.recommended_burn_drift",
+            "baseline": recorded,
+            "fresh": rec_burn,
+            "limit": limit, "direction": "max",
+            "ok": rec_burn <= limit,
+            "waived": None,
+        },
+    ]
+    return {
+        "mode": "sim",
+        "fresh": os.path.basename(trace_path),
+        "baseline": os.path.basename(artifact_path),
+        "rows": rows,
+        "ok": all(r["ok"] for r in rows),
+        "speedup": first.get("speedup"),
+    }
+
+
 def gate_trajectory(
     repo_root: str, waivers_path: str,
 ) -> Dict[str, Any]:
@@ -342,6 +436,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="gate every committed report against its same-shape "
              "predecessor (no bench run)",
     )
+    mode.add_argument(
+        "--sim", action="store_true",
+        help="replay the committed fixture trace against the "
+             "committed ci/sim_tuned.json recommendation "
+             "(deterministic, still beats the default on SLO burn)",
+    )
+    parser.add_argument(
+        "--sim-trace", default=None, metavar="TRACE.jsonl",
+        help=f"trace for --sim (default <repo-root>/{DEFAULT_SIM_TRACE})",
+    )
+    parser.add_argument(
+        "--sim-artifact", default=None, metavar="TUNED.json",
+        help="tuned-config artifact for --sim "
+             f"(default <repo-root>/{DEFAULT_SIM_ARTIFACT})",
+    )
     parser.add_argument(
         "--repo-root", default=".",
         help="directory holding the committed BENCH_LOAD_*.json files",
@@ -362,6 +471,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             verdict = gate_fresh(
                 args.fresh, args.repo_root, waivers_path,
             )
+        elif args.sim:
+            verdict = gate_sim(
+                args.sim_trace or os.path.join(
+                    args.repo_root, DEFAULT_SIM_TRACE
+                ),
+                args.sim_artifact or os.path.join(
+                    args.repo_root, DEFAULT_SIM_ARTIFACT
+                ),
+            )
         else:
             verdict = gate_trajectory(args.repo_root, waivers_path)
     except (OSError, ValueError) as exc:
@@ -373,11 +491,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"perf_gate: {'PASS' if verdict['ok'] else 'FAIL'}",
               file=sys.stderr)
         return 0 if verdict["ok"] else 1
-    if verdict["mode"] == "fresh":
+    if verdict["mode"] in ("fresh", "sim"):
         print(
             f"perf_gate: {verdict['fresh']} vs "
             f"{verdict['baseline'] or '(no baseline)'}"
         )
+        if verdict.get("speedup"):
+            print(f"  replay speedup: {verdict['speedup']}x")
         if verdict.get("note"):
             print(f"  {verdict['note']}")
         _print_rows(verdict["rows"], indent="  ")

@@ -10,6 +10,7 @@ from ci.sparkdl_check.rules import (  # noqa: F401
     host_sync,
     lock_discipline,
     metric_names,
+    raw_clock,
     raw_jit,
     recompile_hazard,
     resource_lifecycle,

@@ -1,0 +1,99 @@
+"""``raw-clock`` — controller modules must read time through the seam.
+
+The simulator (``sparkdl_tpu/sim/``) drives the router, batcher,
+admission queue, autoscaler, rollout controller, and SLO plane on a
+virtual clock by injecting ``clock=`` at construction.  One raw
+``time.time()`` / ``time.monotonic()`` *call* inside those modules
+silently splits the control plane across two timelines: deadlines
+computed on the wall clock expire instantly (or never) under replay,
+and the determinism contract — same trace, same seed, byte-identical
+event log — quietly dies.
+
+Only **calls** are flagged.  Bare references — ``clock=time.monotonic``
+ctor defaults, ``field(default_factory=time.monotonic)`` — are the seam
+itself and pass.  A deliberate wall-clock read (there is one: the
+``now=None`` fallback in ``Request.expired``, which live callers hit
+off-thread) carries an inline ``# sparkdl: disable=raw-clock`` with its
+justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+
+MESSAGE = (
+    "raw {name}() call in a clock-seamed controller module — read "
+    "self._clock() (or take now=) so the sim can drive this code on "
+    "virtual time"
+)
+
+#: the modules the replay harness re-runs on a virtual clock; every one
+#: takes ``clock=`` at construction and must route every read through it
+CONTROLLER_MODULES = frozenset({
+    "serving/router.py",
+    "serving/batcher.py",
+    "serving/admission.py",
+    "serving/autoscale.py",
+    "serving/rollout.py",
+    "obs/slo.py",
+    "obs/timeseries.py",
+})
+
+#: the wall-clock reads that matter for control decisions; sleep stays
+#: sleep-retry's business, perf_counter is profiling not control flow
+CLOCK_FNS = frozenset({"time", "monotonic"})
+
+
+def _collect_aliases(tree: ast.AST):
+    """(aliases of the ``time`` module, direct-import aliases keyed by
+    local name -> original fn name) in this file."""
+    time_aliases, fn_aliases = set(), {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in CLOCK_FNS:
+                    fn_aliases[a.asname or a.name] = a.name
+    return time_aliases, fn_aliases
+
+
+def _clock_call_name(call: ast.Call, time_aliases, fn_aliases):
+    """The wall-clock function a call resolves to, or None."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in CLOCK_FNS:
+        if isinstance(fn.value, ast.Name) and fn.value.id in time_aliases:
+            return f"{fn.value.id}.{fn.attr}"
+    if isinstance(fn, ast.Name) and fn.id in fn_aliases:
+        return fn.id
+    return None
+
+
+@rule
+class RawClockRule(Rule):
+    id = "raw-clock"
+    severity = "error"
+    doc = ("no raw time.time()/time.monotonic() calls in clock-seamed "
+           "controller modules (the sim replays them on virtual time)")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath in CONTROLLER_MODULES
+
+    def check(self, ctx: FileContext):
+        time_aliases, fn_aliases = _collect_aliases(ctx.tree)
+        if not time_aliases and not fn_aliases:
+            return ()
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _clock_call_name(node, time_aliases, fn_aliases)
+            if name is not None:
+                findings.append(self.finding(
+                    ctx, node, MESSAGE.format(name=name),
+                ))
+        return findings

@@ -1,0 +1,88 @@
+"""Record -> replay -> tune, end to end, in seconds.
+
+ISSUE-17's closed loop over the serving plane's knobs, run against the
+committed fixture trace (any ``benchmarks/bench_load.py
+--record-traces`` dump works the same way):
+
+1. **load** a recorded trace — one JSONL row per live request with its
+   arrival time and per-phase latencies;
+2. **replay** it against the *real* control-plane objects (router,
+   micro-batcher, admission queue, SLO engine) on a virtual
+   event-loop clock, 100x+ faster than the wall clock, and check the
+   replay reproduces the live tail within tolerance;
+3. **stress** the same trace at 4x the recorded arrival rate — the
+   dial that shows where the current config runs out of headroom
+   without touching production;
+4. **tune**: random search + successive halving over the knob space
+   against SLO burn, emitting the same artifact shape
+   ``ci/perf_gate.py --sim`` regression-gates as ``ci/sim_tuned.json``.
+
+No devices needed — the simulator never runs a forward pass:
+
+    python examples/sim_tune.py
+"""
+
+import json
+
+from sparkdl_tpu.sim import (
+    FleetReplay,
+    fidelity_report,
+    load_trace,
+    replay_trace,
+    summarize,
+)
+from sparkdl_tpu.sim.tune import tune
+
+TRACE = "tests/fixtures/sim_trace_small.jsonl"
+
+#: the config the fixture was recorded under (the demo fleet's
+#: serving/replica.py factories) — fidelity means replaying the
+#: live run's own knobs, not the sim defaults
+LIVE_CONFIG = {
+    "replicas": 2, "max_batch": 16, "max_wait_ms": 1.0,
+    "queue_capacity": 512,
+}
+
+meta, records = load_trace(TRACE)
+print(f"trace: {len(records)} requests over "
+      f"{records[-1].t - records[0].t:.1f}s "
+      f"({meta.get('scenario')}, {meta.get('rate')} rps offered)")
+
+# -- replay at recorded speed: does the model match the fleet? --------
+report = replay_trace(records, config=LIVE_CONFIG, seed=0)
+print(f"replay: {report['virtual_s']:.1f} virtual seconds in "
+      f"{report['wall_s']*1000:.0f} ms wall "
+      f"({report['speedup']:.0f}x real time)")
+
+# fidelity over the steady-state window (warmup compiles are one-time)
+def steady(rs):
+    return summarize([r for r in rs if r.t >= 1.0])
+
+
+fr = FleetReplay(records, config=LIVE_CONFIG, seed=0)
+fr.run()
+fid = fidelity_report(steady(records), steady(fr.results),
+                      tolerance=0.15, floor_ms=0.25)
+print(f"fidelity: {'PASS' if fid['pass'] else 'FAIL'} "
+      f"({len(fid['rows'])} p50/p99 comparisons within 15% or 0.25ms)")
+for label in ("e2e.p50", "e2e.p99"):
+    row = fid["rows"][label]
+    print(f"  {label}: live {row['live']:.2f}ms  sim {row['sim']:.2f}ms")
+
+# -- stress: the same trace at 4x the recorded arrival rate -----------
+stressed = replay_trace(records, config=LIVE_CONFIG, seed=0,
+                        time_scale=4.0)
+print(f"4x stress: p99 {report['latency_ms']['p99']:.1f}ms -> "
+      f"{stressed['latency_ms']['p99']:.1f}ms, "
+      f"shed {stressed['shed']}, expired {stressed['expired']} — "
+      f"this config has no 4x headroom")
+
+# -- tune: search the knob space against SLO burn under stress --------
+artifact = tune(records, budget=8, seed=0, time_scale=4.0,
+                trace_path=TRACE)
+rec, dfl = artifact["recommended"], artifact["default"]
+print(f"tuned:  burn {dfl['burn_integral']:.1f} -> "
+      f"{rec['burn_integral']:.1f} "
+      f"(score {dfl['score']:.2f} -> {rec['score']:.2f})")
+print("recommended config:",
+      json.dumps(rec["config"], sort_keys=True))

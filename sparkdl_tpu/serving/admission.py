@@ -68,7 +68,9 @@ class Request:
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
             return False
-        return (time.monotonic() if now is None else now) > self.deadline
+        if now is None:
+            now = time.monotonic()  # sparkdl: disable=raw-clock
+        return now > self.deadline
 
 
 @dataclass(frozen=True)
@@ -178,11 +180,15 @@ class AdmissionQueue:
     """
 
     def __init__(self, capacity: int, depth_gauge=None, shed_counter=None,
-                 tenant_policy: Optional[TenantPolicy] = None):
+                 tenant_policy: Optional[TenantPolicy] = None,
+                 clock=time.monotonic):
         if capacity < 1:
             raise ValueError(f"queue capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self.tenant_policy = tenant_policy
+        #: injectable time source — the sim drives the queue in virtual
+        #: time; wall-clock threads keep the monotonic default
+        self._clock = clock
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
@@ -342,7 +348,7 @@ class AdmissionQueue:
         blocked after ``timeout_s`` (None = wait indefinitely); raises
         :class:`ServerClosed` once the queue closes."""
         deadline = (
-            time.monotonic() + timeout_s if timeout_s is not None else None
+            self._clock() + timeout_s if timeout_s is not None else None
         )
         with self._not_full:
             while True:
@@ -354,7 +360,7 @@ class AdmissionQueue:
                 if deadline is None:
                     self._not_full.wait()
                 else:
-                    remaining = deadline - time.monotonic()
+                    remaining = deadline - self._clock()
                     if remaining <= 0 or not self._not_full.wait(remaining):
                         if self._blocked_locked(request):
                             return False
@@ -385,12 +391,12 @@ class AdmissionQueue:
             if not self._size:
                 return []
             batch = [self._pop_drr_locked()]
-            linger_until = time.monotonic() + max_wait_s
+            linger_until = self._clock() + max_wait_s
             while len(batch) < max_n and not self._closed:
                 if self._size:
                     batch.append(self._pop_drr_locked())
                     continue
-                remaining = linger_until - time.monotonic()
+                remaining = linger_until - self._clock()
                 if remaining <= 0:
                     break
                 self._not_empty.wait(remaining)

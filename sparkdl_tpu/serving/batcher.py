@@ -131,11 +131,15 @@ class MicroBatcher:
         dtype: Any = np.float32,
         compile: bool = True,
         fingerprint: Optional[str] = None,
+        clock=time.monotonic,
     ):
         self.model_id = model_id
         self._forward = forward
         self._config = config
         self._cache = cache
+        #: injectable time source — the sim drives the endpoint in
+        #: virtual time; live serving keeps the monotonic default
+        self._clock = clock
         # per-endpoint instruments alongside the process-wide serving.*
         # aggregates: the sampled `serving.latency_ms.<id>.p99` /
         # `serving.errors.<id>` / `serving.requests.<id>` series are what
@@ -168,6 +172,7 @@ class MicroBatcher:
                 if config.tenant_policy is not None
                 else TenantPolicy.from_env()
             ),
+            clock=clock,
         )
         self._breaker = CircuitBreaker(
             name=f"serving.{model_id}",
@@ -210,11 +215,11 @@ class MicroBatcher:
         if deadline_ms is None:
             deadline_ms = self._config.default_deadline_ms
         deadline = (
-            time.monotonic() + deadline_ms / 1000.0
+            self._clock() + deadline_ms / 1000.0
             if deadline_ms is not None
             else None
         )
-        if deadline is not None and deadline <= time.monotonic():
+        if deadline is not None and deadline <= self._clock():
             # expired on arrival (upstream ships *remaining* budget):
             # fail fast without burning a queue slot or a batch seat
             metrics.counter("serving.expired").add(1)
@@ -224,7 +229,10 @@ class MicroBatcher:
                 f"({deadline_ms}ms budget)"
             ))
             return fut
-        req = Request(value=arr, deadline=deadline, tenant=tenant)
+        req = Request(
+            value=arr, deadline=deadline, tenant=tenant,
+            enqueued_at=self._clock(),
+        )
         if tracer.enabled:
             # one span per request, child of the caller's current span;
             # it ends when the future resolves (on the worker thread),
@@ -328,7 +336,7 @@ class MicroBatcher:
                 )
 
     def _run_batch(self, reqs) -> None:
-        now = time.monotonic()
+        now = self._clock()
         live = []
         for r in reqs:
             if r.expired(now):
@@ -413,7 +421,7 @@ class MicroBatcher:
             self._m_errors.add(len(live))
             self._fail_batch(live, bspan, e, record=True)
             return
-        t_dispatched = time.monotonic()
+        t_dispatched = self._clock()
         for host, meta in self._window.submit(
             out_dev, meta=(live, bucket, bspan, now, t_dispatched)
         ):
@@ -468,7 +476,7 @@ class MicroBatcher:
             self._fail_batch(live, bspan, host.error, record=True)
             return
         self._breaker.record_success()
-        done = time.monotonic()
+        done = self._clock()
         latency = metrics.histogram("serving.latency_ms")
         for i, r in enumerate(live):
             # the phase decomposition rides the future (set BEFORE the
@@ -526,7 +534,7 @@ class MicroBatcher:
                 r.future.set_exception(e)
             return
         self._breaker.record_success()
-        done = time.monotonic()
+        done = self._clock()
         latency = metrics.histogram("serving.latency_ms")
         for i, r in enumerate(live):
             # synchronous path: forward and fetch are one region

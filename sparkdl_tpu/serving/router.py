@@ -44,6 +44,7 @@ process load generators (``benchmarks/bench_load.py``) connect to.
 
 from __future__ import annotations
 
+import bisect
 import collections
 import os
 import queue
@@ -52,7 +53,7 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
@@ -149,17 +150,22 @@ class _Backend:
                  lanes: Tuple[str, ...] = ("tcp",),
                  version: str = DEFAULT_VERSION,
                  connect_timeout_s: float = 2.0,
-                 io_timeout_s: float = 30.0):
+                 io_timeout_s: float = 30.0,
+                 transport=None):
         self.name = name
         self.host = host
         self.port = int(port)
         self.version = str(version)
         self.inflight = 0
         self.removed = False
-        self.transport = transport_mod.make_transport(
-            host, int(port), lanes=lanes,
-            connect_timeout_s=connect_timeout_s,
-            io_timeout_s=io_timeout_s,
+        # an injected transport (the sim's virtual replica) skips the
+        # socket handshake entirely; live fleets use the lane factory
+        self.transport = transport if transport is not None else (
+            transport_mod.make_transport(
+                host, int(port), lanes=lanes,
+                connect_timeout_s=connect_timeout_s,
+                io_timeout_s=io_timeout_s,
+            )
         )
 
     def close(self) -> None:
@@ -203,11 +209,19 @@ class Router:
         connect_timeout_s: float = 2.0,
         seed: int = 0,
         hedge: Optional[bool] = None,
+        hedge_quantile: Optional[float] = None,
+        hedge_min_ms: Optional[float] = None,
+        hedge_warmup: Optional[int] = None,
         retry_budget_ratio: Optional[float] = None,
         retry_budget_burst: Optional[float] = None,
         result_cache: Optional[ResultCache] = None,
+        clock=time.monotonic,
     ):
         self._lock = threading.Lock()
+        #: injectable time source — every latency stamp, deadline check,
+        #: and hedge trigger below reads this instead of the wall clock,
+        #: so the sim can drive the router in virtual time
+        self._clock = clock
         self._backends: Dict[str, _Backend] = {}
         self._weights: Dict[str, float] = {}
         self._rng = random.Random(seed)
@@ -240,14 +254,25 @@ class Router:
         if hedge is None:
             hedge = os.environ.get(ENV_HEDGE, "1") != "0"
         self._hedge_enabled = bool(hedge)
-        self._hedge_quantile = float(
-            os.environ.get(ENV_HEDGE_QUANTILE, "0.95")
+        self._hedge_quantile = (
+            float(hedge_quantile) if hedge_quantile is not None
+            else float(os.environ.get(ENV_HEDGE_QUANTILE, "0.95"))
         )
-        self._hedge_min_ms = float(os.environ.get(ENV_HEDGE_MIN_MS, "10"))
-        self._hedge_warmup = int(os.environ.get(ENV_HEDGE_WARMUP, "20"))
+        self._hedge_min_ms = (
+            float(hedge_min_ms) if hedge_min_ms is not None
+            else float(os.environ.get(ENV_HEDGE_MIN_MS, "10"))
+        )
+        self._hedge_warmup = (
+            int(hedge_warmup) if hedge_warmup is not None
+            else int(os.environ.get(ENV_HEDGE_WARMUP, "20"))
+        )
         self._attempt_ms: collections.deque = collections.deque(
             maxlen=_HEDGE_WINDOW
         )
+        # the same window kept sorted (insort on observe, evictee
+        # removed by bisect) so the hedge-trigger quantile is two index
+        # reads per request instead of a full sort of the window
+        self._attempt_ms_sorted: List[float] = []
         self._sample_lock = threading.Lock()
         self._retry_budget = _RetryBudget(
             ratio=(
@@ -280,18 +305,22 @@ class Router:
     def add(self, name: str, host: str, port: int,
             lanes: Tuple[str, ...] = ("tcp",),
             version: str = DEFAULT_VERSION,
-            fingerprints: Optional[Dict[str, str]] = None) -> None:
+            fingerprints: Optional[Dict[str, str]] = None,
+            transport=None) -> None:
         """Register a replica.  ``lanes`` is what it advertised in its
         ready line; the transport factory (and the
         ``SPARKDL_WIRE_TRANSPORT`` override) picks the lane.
         ``version`` is the deployment group weighted placement splits
         over.  ``fingerprints`` maps the replica's endpoint ids to their
         engine fingerprints — the version half of every result-cache
-        key; an endpoint that advertises none stays uncacheable."""
+        key; an endpoint that advertises none stays uncacheable.
+        ``transport`` injects a ready-made transport (the sim's virtual
+        replica) instead of dialing ``host:port``."""
         backend = _Backend(
             name, host, port, lanes=tuple(lanes), version=version,
             connect_timeout_s=self._connect_timeout_s,
             io_timeout_s=self._request_timeout_s,
+            transport=transport,
         )
         with self._lock:
             old = self._backends.pop(name, None)
@@ -492,7 +521,13 @@ class Router:
 
     def _observe_attempt_ms(self, ms: float) -> None:
         with self._sample_lock:
+            if len(self._attempt_ms) == self._attempt_ms.maxlen:
+                evicted = self._attempt_ms[0]
+                del self._attempt_ms_sorted[
+                    bisect.bisect_left(self._attempt_ms_sorted, evicted)
+                ]
             self._attempt_ms.append(ms)
+            bisect.insort(self._attempt_ms_sorted, ms)
 
     def _hedge_delay_s(self, deadline: float) -> Optional[float]:
         """Seconds to wait on the primary before firing a hedge, or
@@ -510,14 +545,15 @@ class Router:
         if live < 2:
             return None
         with self._sample_lock:
-            if len(self._attempt_ms) < self._hedge_warmup:
+            samples = self._attempt_ms_sorted
+            if len(samples) < self._hedge_warmup:
                 return None
-            samples = sorted(self._attempt_ms)
-        idx = min(
-            len(samples) - 1, int(self._hedge_quantile * len(samples))
-        )
-        delay_ms = max(self._hedge_min_ms, samples[idx])
-        remaining_s = deadline - time.monotonic()
+            idx = min(
+                len(samples) - 1,
+                int(self._hedge_quantile * len(samples)),
+            )
+            delay_ms = max(self._hedge_min_ms, samples[idx])
+        remaining_s = deadline - self._clock()
         if remaining_s <= 0:
             return None
         return min(delay_ms / 1000.0, remaining_s / 2.0)
@@ -546,7 +582,7 @@ class Router:
         cache = self._result_cache
         if cache is None or base_id is None or value is None:
             return None, None, None, None
-        t0 = time.monotonic()
+        t0 = self._clock()
         try:
             inject.fire("cache.lookup")
             version = self._roll_version(pin)
@@ -558,10 +594,10 @@ class Router:
                 # no fingerprint -> no stable identity to key on (the
                 # PR-5 rule at request granularity)
                 cache.uncacheable()
-                return None, None, None, (time.monotonic() - t0) * 1000.0
+                return None, None, None, (self._clock() - t0) * 1000.0
             key = result_key(fp, canonical_digest(value))
             hit = cache.get(key)
-            lookup_ms = (time.monotonic() - t0) * 1000.0
+            lookup_ms = (self._clock() - t0) * 1000.0
             if hit is None:
                 return None, key, version, lookup_ms
         except Exception:
@@ -616,7 +652,7 @@ class Router:
         vm = self._version_instruments(backend.version)
         vm.requests.add(1)
         self._m_attempts.add(1)
-        t0 = time.monotonic()
+        t0 = self._clock()
         try:
             reply = self._send_one(
                 backend, value, base_id,
@@ -633,7 +669,7 @@ class Router:
             raise
         finally:
             self._unpick(backend)
-        ms = (time.monotonic() - t0) * 1000.0
+        ms = (self._clock() - t0) * 1000.0
         vm.latency.observe(
             ms, exemplar=span.trace_id if span is not None else None,
         )
@@ -653,7 +689,7 @@ class Router:
         last for the outer retry loop.  When hedging can't trigger,
         this degrades to a plain inline call — no extra threads."""
         delay = self._hedge_delay_s(deadline)
-        t_start = time.monotonic()
+        t_start = self._clock()
         if delay is None:
             try:
                 reply = self._one_attempt(
@@ -773,9 +809,9 @@ class Router:
             # was rolled for, so the populate below can never store a
             # v1 result under a v2 key (or vice versa)
             effective_pin = pin if cache_version is None else cache_version
-            t_in = time.monotonic()
+            t_in = self._clock()
             self._admit(tm)
-            start = time.monotonic()
+            start = self._clock()
             admission_ms = (start - t_in) * 1000.0
             budget = (
                 timeout_s if timeout_s is not None
@@ -799,7 +835,7 @@ class Router:
                 last_exc: Optional[BaseException] = None
                 retries = 0
                 while True:
-                    if time.monotonic() >= deadline:
+                    if self._clock() >= deadline:
                         self._m_expired.add(1)
                         self._m_errors.add(1)
                         if tm is not None:
@@ -860,7 +896,7 @@ class Router:
                         if tm is not None:
                             tm.errors.add(1)
                         raise
-                    now = time.monotonic()
+                    now = self._clock()
                     e2e_ms = (now - start) * 1000.0
                     # exemplar: the root span's trace id rides along
                     # with every latency sample, so a p99 outlier in
@@ -1000,7 +1036,7 @@ class Router:
                         }
                     else:
                         try:
-                            t_route = time.monotonic()
+                            t_route = outer._clock()
                             inner = outer.route_reply(
                                 msg["value"],
                                 model_id=msg.get("model_id"),
@@ -1008,7 +1044,7 @@ class Router:
                                 tenant=msg.get("tenant"),
                             )
                             route_ms = (
-                                time.monotonic() - t_route
+                                outer._clock() - t_route
                             ) * 1000.0
                             reply = {
                                 "ok": True,
@@ -1042,7 +1078,7 @@ class Router:
                                 # side phase can see.  Phase consumers
                                 # skip "t_"-prefixed keys.
                                 phases["t_route"] = t_route
-                                phases["t_send"] = time.monotonic()
+                                phases["t_send"] = outer._clock()
                                 reply["phases"] = phases
                         except Exception as exc:
                             reply = wire.encode_error(exc)

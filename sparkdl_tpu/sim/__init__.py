@@ -1,0 +1,52 @@
+"""Trace-driven fleet simulator + self-tuning control plane (ISSUE-17).
+
+The closed loop over the serving plane's knobs:
+
+- :mod:`sparkdl_tpu.sim.clock` — the virtual clock + deterministic
+  discrete-event loop every controller's injectable-clock seam plugs
+  into;
+- :mod:`sparkdl_tpu.sim.trace` — record/replay trace format (the JSONL
+  ``benchmarks/bench_load.py --record-traces`` dumps) and the seeded
+  empirical phase sampler;
+- :mod:`sparkdl_tpu.sim.replica` — virtual replicas: the *real*
+  :class:`~sparkdl_tpu.serving.batcher.MicroBatcher` admission/coalesce
+  path driven by events instead of a worker thread, with device time
+  replayed from the trace;
+- :mod:`sparkdl_tpu.sim.replay` — the fleet replay harness: real
+  Router / AdmissionQueue / Autoscaler / RolloutController / SLOEngine
+  objects on virtual time, 100-1000x faster than the wall clock;
+- :mod:`sparkdl_tpu.sim.tune` — knob-space search (random +
+  successive halving) against SLO burn, emitting the reviewable
+  ``ci/sim_tuned.json`` artifact ``ci/perf_gate.py --sim`` regresses.
+"""
+
+from sparkdl_tpu.sim.clock import EventLoop, VirtualClock
+from sparkdl_tpu.sim.replay import (
+    DEFAULT_CONFIG,
+    FleetReplay,
+    fidelity_report,
+    replay_trace,
+)
+from sparkdl_tpu.sim.trace import (
+    PhaseSampler,
+    TraceRecord,
+    load_trace,
+    records_from_spans,
+    summarize,
+    write_trace,
+)
+
+__all__ = [
+    "DEFAULT_CONFIG",
+    "EventLoop",
+    "FleetReplay",
+    "PhaseSampler",
+    "TraceRecord",
+    "VirtualClock",
+    "fidelity_report",
+    "load_trace",
+    "records_from_spans",
+    "replay_trace",
+    "summarize",
+    "write_trace",
+]

@@ -71,12 +71,13 @@ def rule_lines(report, rule_id):
 # framework plumbing
 # ---------------------------------------------------------------------------
 
-def test_registry_has_all_fourteen_rules():
+def test_registry_has_all_fifteen_rules():
     assert set(all_rule_ids()) == {
         "lock-order", "lock-blocking", "host-sync", "recompile-hazard",
         "donation-safety", "contextvar-leak", "sleep-retry", "metric-name",
         "raw-jit", "exception-safety", "resource-lifecycle",
         "fault-site-coverage", "wire-envelope", "error-taxonomy",
+        "raw-clock",
     }
 
 
@@ -1939,3 +1940,104 @@ def test_error_taxonomy_clean_family_is_quiet(tmp_path):
         rules=["error-taxonomy"],
     )
     assert report.findings == [], [f.message for f in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# raw-clock (ISSUE-17)
+# ---------------------------------------------------------------------------
+
+def test_raw_clock_flags_wall_clock_call_in_controller(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/router.py",
+        """
+        import time
+
+        class Router:
+            def _admit(self):
+                return time.monotonic() + 1.0
+        """,
+        rules=["raw-clock"],
+    )
+    assert rule_lines(report, "raw-clock") == [6]
+    assert "virtual time" in report.findings[0].message
+
+
+def test_raw_clock_allows_bare_reference_as_seam_default(tmp_path):
+    """``clock=time.monotonic`` ctor defaults ARE the seam — only calls
+    split the timeline."""
+    report = check_snippet(
+        tmp_path, "serving/batcher.py",
+        """
+        import time
+
+        class AdmissionQueue:
+            def __init__(self, clock=time.monotonic):
+                self._clock = clock
+
+            def now(self):
+                return self._clock()
+        """,
+        rules=["raw-clock"],
+    )
+    assert report.findings == [], [f.message for f in report.findings]
+
+
+def test_raw_clock_flags_from_import_alias(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/admission.py",
+        """
+        from time import monotonic as mono
+
+        def deadline():
+            return mono() + 0.5
+        """,
+        rules=["raw-clock"],
+    )
+    assert rule_lines(report, "raw-clock") == [5]
+
+
+def test_raw_clock_ignores_non_controller_modules(tmp_path):
+    """Benchmarks, engine code, tests: wall-clock reads are fine
+    anywhere the sim does not replay."""
+    report = check_snippet(
+        tmp_path, "engine/runner.py",
+        """
+        import time
+
+        def stamp():
+            return time.time()
+        """,
+        rules=["raw-clock"],
+    )
+    assert report.findings == []
+
+
+def test_raw_clock_ignores_sleep_and_perf_counter(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/router.py",
+        """
+        import time
+
+        def pause():
+            time.sleep(0.01)
+            return time.perf_counter()
+        """,
+        rules=["raw-clock"],
+    )
+    assert report.findings == []
+
+
+def test_raw_clock_inline_suppression(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/admission.py",
+        """
+        import time
+
+        def expired(now=None):
+            now = now if now is not None else time.monotonic()  # sparkdl: disable=raw-clock
+            return now
+        """,
+        rules=["raw-clock"],
+    )
+    assert report.findings == []
+    assert len(report.suppressed) == 1
