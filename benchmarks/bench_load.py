@@ -198,7 +198,11 @@ def _worker(worker_id, host, port, args_dict, out_queue):
     base_event_rate = max(args_dict["rate_per_worker"] / mean_burst, 0.1)
 
     # (t_rel, latency_ms, outcome, server_ms, phases, cache, endpoint,
-    # tenant) — consumers index, so new fields only ever append
+    # tenant, decode) — consumers index, so new fields only ever append;
+    # ``decode`` is None for one-shot rows, else the per-stream timing
+    # dict (ttft_ms, gaps_ms, steps)
+    decode_mix = args_dict.get("decode_mix") or 0.0
+    decode_max_steps = int(args_dict.get("decode_max_steps") or 24)
     records = []
     sock = None
     start = time.monotonic()
@@ -216,6 +220,92 @@ def _worker(worker_id, host, port, args_dict, out_queue):
         for _ in range(burst):
             if time.monotonic() - start >= duration:
                 break
+            if decode_mix and rng.random() < decode_mix:
+                # a streaming decode instead of a one-shot infer: send
+                # the request, then drain KIND_STREAM frames until the
+                # final one.  The prompt sums to s, so the deterministic
+                # demo endpoint must stream exactly s, s+1, ... — the
+                # client itself verifies byte-identity against that
+                # one-shot-replayable contract on every completed
+                # stream.  A stream broken AFTER its first token is a
+                # typed failure the client replays ("stream:<class>"),
+                # distinct from accepted-request loss: two half-streams
+                # from different replicas cannot be spliced.
+                steps = rng.randint(4, decode_max_steps)
+                s = float(rng.randint(0, 9))
+                t0 = time.monotonic()
+                server_ms = None
+                phases = None
+                frame_t = []
+                tokens = []
+                outcome = "ok"
+                try:
+                    if sock is None:
+                        sock = wire.connect(host, port, 5.0)
+                        sock.settimeout(args_dict["request_timeout_s"])
+                    msg = {
+                        "op": "decode", "model_id": "dec0",
+                        "value": np.asarray([s], np.float32),
+                        "max_steps": steps,
+                    }
+                    if tenant is not None:
+                        msg["tenant"] = tenant
+                    wire.send_msg(sock, msg)
+                    while True:
+                        got = wire.recv_any(sock)
+                        if got is None:
+                            raise ConnectionError("front door EOF")
+                        frame = got[1]
+                        if not frame.get("ok", True):
+                            outcome = frame.get(
+                                "error_class", "UnknownError"
+                            )
+                            if tokens:
+                                outcome = f"stream:{outcome}"
+                            break
+                        if frame.get("final"):
+                            server_ms = frame.get("server_ms")
+                            phases = frame.get("phases")
+                            break
+                        frame_t.append(time.monotonic())
+                        tokens.append(
+                            float(np.asarray(frame.get("result")))
+                        )
+                except Exception as exc:
+                    cls = f"conn:{type(exc).__name__}"
+                    outcome = f"stream:{cls}" if tokens else cls
+                    try:
+                        if sock is not None:
+                            sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+                t1 = time.monotonic()
+                if outcome == "ok" and tokens != [
+                    s + i for i in range(steps)
+                ]:
+                    outcome = "decode_corrupt"
+                if isinstance(phases, dict):
+                    phases = dict(phases)
+                    phases.pop("t_route", None)
+                    phases.pop("t_send", None)
+                records.append((
+                    round(t0 - start, 4),
+                    round((t1 - t0) * 1000.0, 3), outcome,
+                    server_ms, phases, None, "dec0", tenant,
+                    {
+                        "ttft_ms": round(
+                            (frame_t[0] - t0) * 1000.0, 3
+                        ) if frame_t else None,
+                        "gaps_ms": [
+                            round((b - a) * 1000.0, 3)
+                            for a, b in zip(frame_t, frame_t[1:])
+                        ],
+                        "steps": len(tokens),
+                        "asked_steps": steps,
+                    },
+                ))
+                continue
             endpoint = rng.choices(endpoints, weights=weights)[0]
             if key_cum is not None:
                 idx = rng.choices(key_range, cum_weights=key_cum)[0]
@@ -272,7 +362,7 @@ def _worker(worker_id, host, port, args_dict, out_queue):
                     phases["egress"] = (t1 - t_send) * 1000.0
             records.append((
                 round(t0 - start, 4), round(latency_ms, 3), outcome,
-                server_ms, phases, cache_flag, endpoint, tenant,
+                server_ms, phases, cache_flag, endpoint, tenant, None,
             ))
     if sock is not None:
         try:
@@ -486,7 +576,15 @@ def run(args):
         # envelopes and ingested into the ROUTER-side sink above
         os.environ["SPARKDL_TRACE_OUT"] = trace_path + ".replica"
 
-    if getattr(args, "metered", False):
+    decode_mix = float(getattr(args, "decode_mix", 0.0) or 0.0)
+    if decode_mix:
+        # the streaming fleet: demo_server_plain endpoints plus the
+        # deterministic dec0 decode endpoint (8 slots; per-step stall
+        # from SPARKDL_DEMO_STEP_MS keeps streams in flight long enough
+        # to measure admission and to be worth killing)
+        os.environ["SPARKDL_DEMO_STEP_MS"] = str(args.decode_step_ms)
+        factory = "sparkdl_tpu.serving.replica:demo_server_decode"
+    elif getattr(args, "metered", False):
         # the Zipf-sweep fleet: per-item metered forward cost, so
         # replica capacity is a known constant the hit ratio multiplies
         os.environ["SPARKDL_DEMO_COST_MS"] = str(args.forward_cost_ms)
@@ -560,6 +658,10 @@ def run(args):
         "seed": args.seed,
         "obs": obs_on,
     }
+    if decode_mix:
+        # perf_gate's shape key reads bool(report["decode"]) — the full
+        # section replaces this placeholder after aggregation
+        report["decode"] = {"mix": decode_mix}
     try:
         if not supervisor.wait_live(args.replicas, args.spawn_timeout_s):
             raise RuntimeError(
@@ -655,6 +757,8 @@ def run(args):
                 args.faultnet_deadline_ms
                 if args.scenario == "faultnet" else None
             ),
+            "decode_mix": decode_mix,
+            "decode_max_steps": getattr(args, "decode_max_steps", 24),
         }
         procs = [
             ctx.Process(
@@ -721,16 +825,85 @@ def run(args):
         poller.join(timeout=5)
         wall_s = time.monotonic() - bench_start
 
+        # --- continuous-admission probe (decode-mix) -------------------
+        # the load generators are done; the fleet is idle.  Start ONE
+        # long decode, wait for its first token (it now owns a slot
+        # mid-flight), then time a short decode submitted behind it: on
+        # a barrier engine the short one waits out the long stream, on
+        # the slot plane it's admitted into a free slot and finishes
+        # while the long decode is still running.
+        admission_probe = None
+        if decode_mix:
+            import numpy as np
+
+            long_done = threading.Event()
+            long_first = threading.Event()
+            long_err = []
+
+            def _long():
+                try:
+                    supervisor.router.route_stream(
+                        [0.0], model_id="dec0",
+                        on_frame=lambda f: long_first.set(),
+                        max_steps=10_000, timeout_s=60.0,
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    long_err.append(f"{type(exc).__name__}: {exc}")
+                finally:
+                    long_done.set()
+
+            lt = threading.Thread(target=_long, daemon=True)
+            lt.start()
+            short_ms = None
+            short_correct = None
+            long_running = None
+            if long_first.wait(timeout=30.0):
+                t0 = time.monotonic()
+                try:
+                    short = supervisor.router.route_stream(
+                        [5.0], model_id="dec0", max_steps=3,
+                        timeout_s=30.0,
+                    )
+                    short_ms = round(
+                        (time.monotonic() - t0) * 1000.0, 3
+                    )
+                    short_correct = np.asarray(
+                        short["result"]
+                    ).tolist() == [5.0, 6.0, 7.0]
+                except Exception as exc:  # noqa: BLE001
+                    short_correct = f"{type(exc).__name__}: {exc}"
+                long_running = not long_done.is_set()
+            long_done.wait(timeout=120.0)
+            admission_probe = {
+                "short_ms": short_ms,
+                "short_correct": short_correct,
+                # True == the short stream returned while the long
+                # decode was still mid-flight: no barrier on the
+                # slowest sequence
+                "short_before_long": bool(long_running)
+                and short_ms is not None,
+                "long_error": long_err[0] if long_err else None,
+            }
+
         # --- aggregate -------------------------------------------------
         records.sort(key=lambda r: r[0])
         ok = [r for r in records if r[2] == "ok"]
         shed = [r for r in records if r[2] in _SHED_CLASSES]
         expired = [r for r in records if r[2] in _EXPIRED_CLASSES]
+        # "stream:<class>" rows are decode streams that died TYPED
+        # after their first forwarded token — the documented replay
+        # contract (half-streams from two replicas cannot be spliced),
+        # not accepted-request loss.  Corruption ("decode_corrupt") and
+        # untyped stream failures still count as lost.
+        broken_streams = [
+            r for r in records if r[2].startswith("stream:")
+        ]
         lost = [
             r for r in records
             if r[2] != "ok"
             and r[2] not in _SHED_CLASSES
             and r[2] not in _EXPIRED_CLASSES
+            and not r[2].startswith("stream:")
         ]
         kill_t = None
         if args.scenario == "kill":
@@ -747,9 +920,13 @@ def run(args):
         # router-added overhead: front-door round trip minus the time
         # the replica itself spent on the request (queue + forward) —
         # what the data plane costs on top of the model
-        server_vals = [r[3] for r in ok if r[3] is not None]
+        # one-shot rows only: stream walls are token-count-shaped and
+        # would drown the request-path latency stats (streams get their
+        # own TTFT/inter-token section below)
+        ok_one = [r for r in ok if len(r) <= 8 or r[8] is None]
+        server_vals = [r[3] for r in ok_one if r[3] is not None]
         overhead_vals = [
-            r[1] - r[3] for r in ok if r[3] is not None
+            r[1] - r[3] for r in ok_one if r[3] is not None
         ]
         # wire.* codec accounting from the router process (the replica
         # side keeps its own registry; the router's is what the front
@@ -783,10 +960,10 @@ def run(args):
             else None,
             "goodput_rps": round(len(ok) / wall_s, 2),
             "offered_rps": round(len(records) / wall_s, 2),
-            "latency_ms": _latency_stats([r[1] for r in ok]),
+            "latency_ms": _latency_stats([r[1] for r in ok_one]),
             "server_ms": _latency_stats(server_vals),
             "router_overhead_ms": _latency_stats(overhead_vals),
-            "phases_ms": _phase_table(ok),
+            "phases_ms": _phase_table(ok_one),
             "wire": {
                 "breakdown": breakdown,
                 "total_s": round(wire_total_s, 4),
@@ -886,6 +1063,92 @@ def run(args):
                 "bytes": cache_bytes,
                 "counters": cache_deltas,
                 "byte_identity": byte_identity,
+            }
+        if decode_mix:
+            dec_rows = [
+                r for r in records if len(r) > 8 and r[8] is not None
+            ]
+            dec_ok = [r for r in dec_rows if r[2] == "ok"]
+            corrupt = [
+                r for r in dec_rows if r[2] == "decode_corrupt"
+            ]
+            ttfts = [
+                r[8]["ttft_ms"] for r in dec_ok
+                if r[8]["ttft_ms"] is not None
+            ]
+            gaps = [g for r in dec_ok for g in r[8]["gaps_ms"]]
+            lens = [r[8]["steps"] for r in dec_ok]
+            # padding waste, both ways, from the same completed
+            # streams.  Bucket-pad baseline: barrier batching in
+            # admission order — the whole 8-slot pool is held until the
+            # slowest stream of each group finishes, so every group
+            # costs 8 * max(len) slot-steps.  Continuous (measured):
+            # the replicas' actual fused-step counters, federated
+            # through the fleet scraper — tokens emitted over slot-steps
+            # actually computed.
+            n_slots = 8
+            pad_bucket = None
+            if lens:
+                cost = work = 0
+                for i in range(0, len(lens), n_slots):
+                    grp = lens[i:i + n_slots]
+                    cost += max(grp) * n_slots
+                    work += sum(grp)
+                pad_bucket = round(1.0 - work / cost, 4) if cost else None
+            pad_continuous = None
+            fleet = supervisor.fleet_collector
+            if fleet is not None:
+                fleet.scrape_once()  # final counters, not 0.5s stale
+                snap = fleet.snapshot()
+                steps_total = tokens_total = 0.0
+                for row in snap["targets"].values():
+                    m = row.get("metrics") or {}
+                    steps_total += m.get("decode.steps", 0.0)
+                    tokens_total += m.get("decode.tokens", 0.0)
+                if steps_total:
+                    pad_continuous = round(
+                        1.0 - tokens_total / (steps_total * n_slots), 4
+                    )
+            stitched = None
+            if obs_on and router_sink is not None:
+                rows = router_sink.spans()
+                stream_traces = {
+                    sp["trace_id"] for sp in rows
+                    if sp.get("name") == "router.stream"
+                }
+                stitched = len({
+                    sp["trace_id"] for sp in rows
+                    if sp.get("name") == "decode.request"
+                    and sp["trace_id"] in stream_traces
+                })
+            report["decode"] = {
+                "mix": decode_mix,
+                "step_ms": args.decode_step_ms,
+                "streams": len(dec_rows),
+                "completed": len(dec_ok),
+                "broken_typed": len(broken_streams),
+                "broken_detail": sorted(
+                    {r[2] for r in broken_streams}
+                ),
+                "corrupt": len(corrupt),
+                # every completed stream's tokens matched the one-shot
+                # replay contract (s, s+1, ... from its prompt sum)
+                "byte_identity": bool(dec_ok) and not corrupt,
+                "ttft_ms": _latency_stats(ttfts),
+                "inter_token_ms": _latency_stats(gaps),
+                "stream_wall_ms": _latency_stats(
+                    [r[1] for r in dec_ok]
+                ),
+                "steps_mean": round(sum(lens) / len(lens), 2)
+                if lens else None,
+                "tokens_per_s": round(sum(lens) / wall_s, 2),
+                "pad_fraction": {
+                    "n_slots": n_slots,
+                    "continuous": pad_continuous,
+                    "bucket_baseline": pad_bucket,
+                },
+                "stitched_traces": stitched,
+                "admission_probe": admission_probe,
             }
         if obs_on:
             fleet = supervisor.fleet_collector
@@ -1097,6 +1360,22 @@ def main():
                     "fleet; assert goodput multiplies with skew while "
                     "the miss path's p99 stays flat and hit bytes match "
                     "forced re-scores")
+    ap.add_argument("--decode-mix", type=float, default=0.0,
+                    metavar="FRAC",
+                    help="fraction of requests sent as streaming "
+                    "decodes to the dec0 slot plane (demo_server_decode "
+                    "fleet); reports TTFT + inter-token p50/p99, "
+                    "client-verified byte-identity vs the one-shot "
+                    "replay, the continuous-admission probe, and "
+                    "pad-fraction vs the bucket-pad barrier baseline")
+    ap.add_argument("--decode-max-steps", type=int, default=24,
+                    help="decode-mix: per-stream steps drawn uniform "
+                    "from [4, N] — the ragged-length distribution the "
+                    "pad comparison is computed over")
+    ap.add_argument("--decode-step-ms", type=float, default=3.0,
+                    help="decode-mix: per fused step stall on the "
+                    "replicas (SPARKDL_DEMO_STEP_MS) — stretches "
+                    "streams so admission/kill behavior is observable")
     ap.add_argument("--burst-p", type=float, default=0.3,
                     help="geometric burst continuation probability")
     ap.add_argument("--burst-max", type=int, default=8)
@@ -1218,6 +1497,11 @@ def main():
         args.workers = 2
         args.kill_at_requests = 100
         args.compile = False
+        if args.decode_mix:
+            # workers round-trip synchronously, so concurrent streams
+            # are bounded by worker count — give the slot pools
+            # something to interleave
+            args.workers = 4
 
     if args.zipf_sweep:
         # the Zipf-sweep proof (ISSUE-16): same metered fleet, same key
@@ -1545,14 +1829,49 @@ def main():
             problems.extend(_obs_problems(report))
         if args.diag:
             problems.extend(_diag_problems(report))
+        if args.decode_mix:
+            dec = report.get("decode") or {}
+            probe = dec.get("admission_probe") or {}
+            if not dec.get("completed"):
+                problems.append("no decode stream ever completed")
+            if dec.get("corrupt"):
+                problems.append(
+                    f"{dec['corrupt']} completed streams carried "
+                    "corrupt tokens (byte-identity vs one-shot replay "
+                    "violated)"
+                )
+            elif dec.get("byte_identity") is not True:
+                problems.append(
+                    "stream byte-identity never verified "
+                    f"(decode={dec.get('completed')})"
+                )
+            if probe.get("short_before_long") is not True:
+                problems.append(
+                    "continuous-admission probe failed: a short decode "
+                    "did not complete while the long one was mid-flight "
+                    f"(probe={probe})"
+                )
+            if args.obs == "on" and not dec.get("stitched_traces"):
+                problems.append(
+                    "no stitched decode trace (router.stream + "
+                    "decode.request sharing a trace_id)"
+                )
         if problems:
             print("SMOKE FAIL: " + "; ".join(problems), file=sys.stderr)
             _print_fleet_on_fail(report)
             return 1
+        decode_note = ""
+        if args.decode_mix:
+            dec = report.get("decode") or {}
+            decode_note = (
+                f", {dec.get('completed')} streams ok "
+                f"({dec.get('broken_typed')} broken typed, "
+                f"ttft p99={((dec.get('ttft_ms') or {}).get('p99'))}ms)"
+            )
         print(
             "SMOKE PASS: "
             f"{report['ok']} ok / {report['sent']} sent, 0 lost, "
-            f"replica back in {kill['recovery_live_s']}s",
+            f"replica back in {kill['recovery_live_s']}s" + decode_note,
             file=sys.stderr,
         )
     return 0

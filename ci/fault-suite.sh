@@ -117,6 +117,24 @@ if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
   exit 1
 fi
 
+# mixed one-shot + decode smoke (<60 s, ISSUE-18): 35% of the traffic
+# becomes streaming decodes on the dec0 slot plane while the usual
+# replica kill fires mid-run.  The harness asserts zero accepted loss
+# (a stream broken after its first token fails TYPED and is excluded
+# by contract — half-streams cannot be spliced), byte-identity of
+# every completed stream against the one-shot replay of its prompt,
+# the continuous-admission probe (a short decode completes while a
+# long one is still mid-flight), and >= 1 stitched decode trace
+# (router.stream + decode.request sharing a trace_id).
+if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
+    --decode-mix 0.35 2>&1 | tee "$SMOKE_LOG"; then
+  echo "decode smoke FAILED: accepted loss, stream corruption, a" >&2
+  echo "barrier on the slowest sequence, a missing stitched decode" >&2
+  echo "trace, or >60s wall — see above" >&2
+  print_fleet_snapshot
+  exit 1
+fi
+
 # perf-regression gate smoke (ISSUE-15): the gate must (a) PASS a
 # fresh clean smoke run against the newest committed same-shape
 # BENCH_LOAD_*.json baseline, and (b) FAIL the same run under a

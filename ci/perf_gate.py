@@ -101,12 +101,16 @@ def shape_key(report: Dict[str, Any]) -> Tuple:
     without the field read as None and keep matching each other).
     Simulated replays (``"sim": true`` — virtual clock, no device)
     measure a model of the fleet, never the fleet: they must not gate
-    live ``BENCH_LOAD_r*.json`` numbers in either direction."""
+    live ``BENCH_LOAD_r*.json`` numbers in either direction.  A
+    ``--decode-mix`` run (``"decode": true``) interleaves streaming
+    decodes with the one-shot load — its walls are token-count-shaped,
+    so it only ever gates other decode-mix runs."""
     return tuple(report.get(f) for f in SHAPE_FIELDS) + (
         bool(report.get("obs") or report.get("trace")),
         bool(report.get("result_cache")),
         report.get("zipf_s"),
         bool(report.get("sim")),
+        bool(report.get("decode")),
     )
 
 

@@ -37,11 +37,15 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: "cache" (replica-tier single-flight / negative-cache accounting;
 #: the router tier rides the existing "router" prefix as
 #: ``router.cache.*``) joined with the ISSUE-16 result cache.
+#: "decode" (slot-pool occupancy, TTFT/step latency, token/eviction
+#: counters of the continuous-batching decode plane) and "batcher"
+#: (one-shot coalescing internals: pad fraction, early-flush count)
+#: joined with the ISSUE-18 token-streaming decode plane.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
     "streaming", "slo", "ts", "supervisor", "router", "wire",
     "rollout", "tenant", "fleet", "replica", "faultnet", "diag",
-    "profile", "cache",
+    "profile", "cache", "decode", "batcher",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

@@ -35,6 +35,7 @@ CONTROLLER_MODULES = frozenset({
     "serving/router.py",
     "serving/batcher.py",
     "serving/admission.py",
+    "serving/decode.py",
     "serving/autoscale.py",
     "serving/rollout.py",
     "obs/slo.py",

@@ -27,6 +27,7 @@ from sparkdl_tpu.engine.executor import (
     FetchFailure,
     dispatch_depth,
 )
+from sparkdl_tpu.engine.slots import Slot, SlotPool
 
 #: the process-wide engine used by transformers, UDFs, and estimators
 #: (serving's ProgramCache builds its own so cache_size eviction is real)
@@ -39,6 +40,8 @@ __all__ = [
     "ExecutionEngine",
     "PersistentCompileCache",
     "ProgramHandle",
+    "Slot",
+    "SlotPool",
     "cache_key",
     "default_cache_dir",
     "dispatch_depth",

@@ -1,0 +1,212 @@
+"""Fixed slot pool for continuous-batching decode (ISSUE-18).
+
+Autoregressive decode is a loop of small steps over *long-lived*
+per-request state, which inverts the one-shot batcher's economics: the
+cost of padding is paid every step, and a barrier on the slowest
+sequence stalls every other sequence in the batch.  The classic fix —
+what this module implements the state half of — is a **fixed pool of N
+device slots**:
+
+- the fused step program is compiled once for the pool shape
+  ``(N, *carry_shape)`` and never again (one executable per slot-pool
+  shape, not per batch shape — the engine-cache discipline);
+- each slot holds one request's carry row (KV state, sampler state —
+  whatever the endpoint packs into its carry) plus its step counter;
+  the backing buffer is allocated once and **reused across steps and
+  across requests**;
+- a request finishing frees its slot immediately; the next queued
+  request is admitted into it *mid-flight*, with no barrier on the
+  sequences still decoding in the other slots.
+
+The pool is deliberately just bookkeeping + buffers: admission policy,
+step execution, eviction triggers (eos/deadline/disconnect), and
+streaming live in :mod:`sparkdl_tpu.serving.decode`.  Single-owner
+discipline: one decode worker thread owns the pool; only the
+occupancy gauge is read from other threads (a plain int read).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class Slot:
+    """One device slot: index into the pool's carry stack, the occupying
+    request (opaque to the engine layer), and per-stream counters."""
+
+    __slots__ = (
+        "index", "request", "step", "stream_seq", "acquired_at",
+        "first_token_at",
+    )
+
+    def __init__(self, index: int):
+        self.index = index
+        self.request: Any = None
+        self.step = 0
+        #: next stream frame's sequence number (gap-free from 0)
+        self.stream_seq = 0
+        self.acquired_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+
+    @property
+    def occupied(self) -> bool:
+        return self.request is not None
+
+    def __repr__(self):
+        return (
+            f"Slot({self.index}, occupied={self.occupied}, "
+            f"step={self.step})"
+        )
+
+
+class SlotPool:
+    """N slots over one reused carry stack of shape ``(N, *carry_shape)``.
+
+    The carry dtype/shape bind on the first :meth:`acquire` (the same
+    first-request-binds contract as the one-shot endpoints); after that
+    every request's init carry must match.  :meth:`release` zeroes the
+    slot's carry row — slot state must never leak into the next
+    request, and a zeroed row makes a leak a test-visible all-zeros
+    instead of a silent wrong answer.
+    """
+
+    def __init__(self, n_slots: int, occupied_gauge=None):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.n_slots = int(n_slots)
+        self._slots = [Slot(i) for i in range(self.n_slots)]
+        self._free: "deque[int]" = deque(range(self.n_slots))
+        self._carries: Optional[np.ndarray] = None
+        self._carry_shape: Optional[Tuple[int, ...]] = None
+        self._carry_dtype: Optional[np.dtype] = None
+        self._gauge = occupied_gauge
+        self._set_gauge()
+
+    # ------------------------------------------------------------------
+    def _set_gauge(self) -> None:
+        if self._gauge is not None:
+            self._gauge.set(self.n_occupied)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_occupied(self) -> int:
+        return self.n_slots - len(self._free)
+
+    @property
+    def carry_shape(self) -> Optional[Tuple[int, ...]]:
+        """Per-slot carry shape (no leading slot dim), once bound."""
+        return self._carry_shape
+
+    @property
+    def carry_dtype(self) -> Optional[np.dtype]:
+        return self._carry_dtype
+
+    def _bind(self, carry: np.ndarray) -> None:
+        self._carry_shape = tuple(carry.shape)
+        self._carry_dtype = carry.dtype
+        self._carries = np.zeros(
+            (self.n_slots, *self._carry_shape), dtype=self._carry_dtype
+        )
+
+    # ------------------------------------------------------------------
+    def acquire(self, request: Any, carry, now: Optional[float] = None
+                ) -> Optional[Slot]:
+        """Admit ``request`` into a free slot, writing its init ``carry``
+        into the slot's row; None when the pool is full."""
+        if not self._free:
+            return None
+        arr = np.asarray(carry)
+        if self._carries is None:
+            self._bind(arr)
+        elif (tuple(arr.shape) != self._carry_shape
+              or arr.dtype != self._carry_dtype):
+            raise ValueError(
+                f"carry of shape {tuple(arr.shape)}/{arr.dtype} does not "
+                f"match the pool's bound {self._carry_shape}/"
+                f"{self._carry_dtype} — one pool serves one carry shape"
+            )
+        slot = self._slots[self._free.popleft()]
+        slot.request = request
+        slot.step = 0
+        slot.stream_seq = 0
+        slot.acquired_at = now
+        slot.first_token_at = None
+        self._carries[slot.index] = arr
+        self._set_gauge()
+        return slot
+
+    def release(self, slot: Slot) -> None:
+        """Free ``slot`` and zero its carry row (no state carryover)."""
+        if slot.request is None:
+            return
+        slot.request = None
+        slot.step = 0
+        slot.stream_seq = 0
+        slot.acquired_at = None
+        slot.first_token_at = None
+        if self._carries is not None:
+            self._carries[slot.index] = 0
+        self._free.append(slot.index)
+        self._set_gauge()
+
+    def release_all(self) -> List[Slot]:
+        """Evict every occupied slot (shutdown/drain); returns them with
+        their ``request`` still attached so the caller can fail/finish
+        the futures — the pool itself is cleared."""
+        out = []
+        for slot in self._slots:
+            if slot.occupied:
+                evicted = Slot(slot.index)
+                evicted.request = slot.request
+                evicted.step = slot.step
+                evicted.stream_seq = slot.stream_seq
+                out.append(evicted)
+                self.release(slot)
+        return out
+
+    # ------------------------------------------------------------------
+    def occupied(self) -> List[Slot]:
+        """The occupied slots in index order — the fused step's rows."""
+        return [s for s in self._slots if s.occupied]
+
+    def carries(self) -> np.ndarray:
+        """The full ``(N, *carry_shape)`` stack (vacant rows are zeros).
+        The fused step runs over ALL rows every iteration — constant
+        shape is the whole point — and vacant rows' outputs are never
+        read."""
+        if self._carries is None:
+            raise RuntimeError("pool has no bound carry shape yet")
+        return self._carries
+
+    def store_carries(self, new_carries) -> None:
+        """Write the fused step's updated ``(N, *carry_shape)`` stack
+        back into the reused buffer (no reallocation)."""
+        arr = np.asarray(new_carries)
+        if arr.shape != self._carries.shape:
+            raise ValueError(
+                f"step returned carries of shape {arr.shape}; pool "
+                f"expects {self._carries.shape}"
+            )
+        np.copyto(self._carries, arr)
+
+    def snapshot(self) -> dict:
+        return {
+            "n_slots": self.n_slots,
+            "occupied": self.n_occupied,
+            "carry_shape": (
+                list(self._carry_shape) if self._carry_shape else None
+            ),
+            "steps": {s.index: s.step for s in self._slots if s.occupied},
+        }
+
+    def __repr__(self):
+        return (
+            f"SlotPool(n_slots={self.n_slots}, "
+            f"occupied={self.n_occupied})"
+        )

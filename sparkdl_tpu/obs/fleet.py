@@ -89,8 +89,12 @@ class FleetCollector:
         timeout_s: float = 1.0,
         metric_prefixes: Iterable[str] = (
             # "cache." covers the replica-tier single-flight / negative
-            # cache counters so the ISSUE-16 result-cache series federate
-            "serving.", "sparkdl.up", "cache.",
+            # cache counters so the ISSUE-16 result-cache series federate;
+            # "decode." / "batcher." federate the ISSUE-18 streaming
+            # plane (slot occupancy, step/token counters, pad fraction)
+            # so padding waste is measurable fleet-side, not just in the
+            # replica process
+            "serving.", "sparkdl.up", "cache.", "decode.", "batcher.",
         ),
         registry: Optional[MetricsRegistry] = None,
         clock=time.monotonic,

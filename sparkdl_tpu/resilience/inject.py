@@ -88,6 +88,13 @@ KNOWN_SITES = {
     # exercises the fail-open contract: any cache-layer failure must
     # degrade to the miss path (full scoring), never to a request error.
     "cache": ("cache.lookup",),
+    # continuous-batching decode plane (ISSUE-18): ``decode.step`` fires
+    # before each fused step over the occupied slots (an error rule
+    # fails every in-flight stream on that replica, typed; a kill rule
+    # is the SIGKILL-mid-decode case), ``decode.stream`` fires before
+    # each emitted stream frame (exercises a stream torn between
+    # tokens).
+    "decode": ("decode.step", "decode.stream"),
 }
 
 

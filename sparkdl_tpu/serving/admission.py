@@ -32,7 +32,7 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Mapping, Optional
+from typing import Any, Callable, Dict, List, Mapping, Optional
 
 from sparkdl_tpu.serving.errors import (
     ServerClosed,
@@ -377,6 +377,7 @@ class AdmissionQueue:
         max_n: int,
         max_wait_s: float,
         poll_s: float = 0.05,
+        flush_early: Optional[Callable[[], bool]] = None,
     ) -> List[Request]:
         """Coalesce up to ``max_n`` requests.
 
@@ -384,6 +385,14 @@ class AdmissionQueue:
         worker notices promptly); once one arrives, lingers up to
         ``max_wait_s`` — measured from the first request — for more.
         Returns ``[]`` on an idle poll or when closed.
+
+        ``flush_early`` (checked whenever the queue runs dry mid-linger)
+        cuts the linger short while it returns True — the consumer's
+        "the device could run this batch NOW" signal.  Lingering exists
+        to trade latency for occupancy; when the downstream dispatch
+        window has a free slot that trade is pure added latency, so the
+        batch in hand flushes immediately and the next one coalesces
+        naturally while this one computes.
         """
         with self._not_empty:
             if not self._size and not self._closed:
@@ -396,6 +405,9 @@ class AdmissionQueue:
                 if self._size:
                     batch.append(self._pop_drr_locked())
                     continue
+                if flush_early is not None and flush_early():
+                    metrics.counter("batcher.flush_early").add(1)
+                    break
                 remaining = linger_until - self._clock()
                 if remaining <= 0:
                     break

@@ -305,6 +305,7 @@ class MicroBatcher:
                     batch = self._queue.take(
                         self._config.max_batch,
                         self._config.max_wait_ms / 1000.0,
+                        flush_early=self._device_free,
                     )
                     if batch:
                         self._run_batch(batch)
@@ -334,6 +335,13 @@ class MicroBatcher:
                     "batches at shutdown",
                     self.model_id,
                 )
+
+    def _device_free(self) -> bool:
+        """True while the dispatch window can absorb another batch
+        without blocking on an older fetch — the idle-device signal
+        that cuts the coalesce linger short (holding a batch while the
+        device sits idle buys no occupancy, only latency)."""
+        return len(self._window) <= self._window.depth
 
     def _run_batch(self, reqs) -> None:
         now = self._clock()
@@ -497,6 +505,9 @@ class MicroBatcher:
         metrics.histogram("serving.batch_occupancy").observe(
             len(live) / bucket
         )
+        metrics.histogram("batcher.pad_fraction").observe(
+            (bucket - len(live)) / bucket
+        )
         if bspan is not None:
             bspan.end()
 
@@ -551,6 +562,9 @@ class MicroBatcher:
         metrics.counter("serving.batches").add(1)
         metrics.histogram("serving.batch_occupancy").observe(
             len(live) / bucket
+        )
+        metrics.histogram("batcher.pad_fraction").observe(
+            (bucket - len(live)) / bucket
         )
 
     # ------------------------------------------------------------------

@@ -1,0 +1,604 @@
+"""Continuous-batching decode plane: slot-based autoregressive endpoints
+(ISSUE-18).
+
+The one-shot batcher coalesces, pads to a bucket, fires once, and
+resolves every future together — the right shape for scoring, the wrong
+one for autoregressive decode, where requests run for *hundreds* of
+steps of per-step state and finish at different times.  This module is
+the decode analog of :class:`~sparkdl_tpu.serving.batcher.MicroBatcher`:
+
+- a fixed :class:`~sparkdl_tpu.engine.slots.SlotPool` of N device slots
+  holds per-request carry state; the **fused step** runs over all N
+  rows every iteration, so exactly one executable exists per slot-pool
+  shape (compiled through the engine cache, never per batch shape);
+- new requests are admitted into freed slots **mid-flight** — no
+  barrier on the slowest sequence; a short request admitted behind a
+  long in-flight decode completes without waiting for it;
+- slots are evicted on completion (``eos_fn`` / ``max_steps``), on
+  deadline expiry, and on client disconnect (the ``emit`` callback
+  returning False or raising) — a gone client must not burn device
+  steps;
+- each emitted token flows to the request's ``emit`` callback as a
+  stream-frame-shaped dict (``{"result", "stream_seq", "final"}``) —
+  the replica wraps these into :data:`~sparkdl_tpu.serving.wire
+  .KIND_STREAM` frames; in-process callers can pass ``emit=None`` and
+  read the stitched result off the future.
+
+Endpoint contract (``ModelServer.register_decode``):
+
+- ``init_fn(prompt) -> carry`` — one host call per request, producing
+  the slot's initial carry row (pack KV state, the prompt encoding,
+  sampler state — whatever the step needs — into one fixed-shape
+  array);
+- ``step_fn(carries) -> (new_carries, tokens)`` — jax-traceable over
+  the full ``(N, *carry_shape)`` stack; row i of ``tokens`` is slot
+  i's next token.  Vacant rows compute garbage nobody reads (constant
+  shape is what kills the padding-waste);
+- ``eos_fn(token, step) -> bool`` — host-side stop predicate, else the
+  stream runs to its step cap;
+- ``max_steps`` — the endpoint cap; requests may ask for fewer via
+  ``max_steps`` in the envelope (clamped, never raised).
+
+Observability: ``decode.slots_occupied`` gauge, ``decode.ttft_ms`` /
+``decode.step_ms`` histograms (exemplared with the request/step-group
+trace ids), ``decode.request`` spans per stream and ``decode.steps``
+spans per fused step-group carrying member span ids — the same fan-in
+stitching the batch plane uses, so e2e attribution explains streams
+too.  Fault sites: ``decode.step`` before each fused step,
+``decode.stream`` before each emitted frame.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sparkdl_tpu.engine.slots import SlotPool
+from sparkdl_tpu.obs.slo import sanitize_name
+from sparkdl_tpu.obs.trace import tracer
+from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.serving.admission import AdmissionQueue, Request, TenantPolicy
+from sparkdl_tpu.serving.errors import DeadlineExceeded, ServerClosed
+from sparkdl_tpu.utils.metrics import metrics
+
+logger = logging.getLogger(__name__)
+
+#: how long the worker sleeps on an idle poll (no occupied slots, no
+#: queued requests) before re-checking for work
+_IDLE_POLL_S = 0.02
+
+
+class ClientGone(ConnectionError):
+    """The streaming client disconnected mid-decode; its slot was
+    evicted.  ``ConnectionError`` so the replica/router layers treat it
+    like any peer death — and never retry it onto another replica (the
+    client is gone everywhere)."""
+
+
+@dataclass
+class DecodeRequest(Request):
+    """One in-flight decode stream.
+
+    ``emit`` receives one dict per token (``result``/``stream_seq``/
+    ``final=False``) plus a terminal ``final=True`` dict; returning
+    False (or raising) marks the client gone and evicts the slot.
+    ``future`` resolves with the stacked ``(steps, *token_shape)``
+    output — byte-identical to the concatenation of the streamed
+    tokens.
+    """
+
+    emit: Optional[Callable[[dict], Any]] = None
+    max_steps: Optional[int] = None
+    #: set by the transport layer when the client's connection drops
+    cancelled: threading.Event = field(default_factory=threading.Event)
+    tokens: List[np.ndarray] = field(default_factory=list)
+
+
+class DecodeEndpoint:
+    """One autoregressive endpoint: admission queue + slot pool + one
+    decode worker running the fused step over occupied slots.
+
+    ``compile=False`` runs ``step_fn`` as plain Python (deterministic —
+    what the fault tests use); ``compile=True`` resolves one executable
+    for the pool shape through the process engine cache.
+    """
+
+    def __init__(
+        self,
+        model_id: str,
+        step_fn: Callable[[Any], Tuple[Any, Any]],
+        init_fn: Callable[[Any], Any],
+        max_steps: int,
+        eos_fn: Optional[Callable[[np.ndarray, int], bool]] = None,
+        n_slots: int = 8,
+        queue_capacity: int = 256,
+        dtype: Any = np.float32,
+        compile: bool = True,
+        fingerprint: Optional[str] = None,
+        tenant_policy: Optional[TenantPolicy] = None,
+        clock=time.monotonic,
+    ):
+        if max_steps < 1:
+            raise ValueError(f"max_steps must be >= 1, got {max_steps}")
+        self.model_id = model_id
+        self._step_fn = step_fn
+        self._init_fn = init_fn
+        self.max_steps = int(max_steps)
+        self._eos_fn = eos_fn
+        self._dtype = np.dtype(dtype)
+        self._compile = bool(compile)
+        self._fingerprint = fingerprint
+        #: injectable time source (the raw-clock seam shared with the
+        #: batcher/admission plane)
+        self._clock = clock
+        mid = sanitize_name(model_id)
+        self._m_requests = metrics.counter(f"decode.requests.{mid}")
+        self._m_ttft = metrics.histogram("decode.ttft_ms")
+        self._m_step = metrics.histogram("decode.step_ms")
+        self._m_tokens = metrics.counter("decode.tokens")
+        self._pool = SlotPool(
+            n_slots, occupied_gauge=metrics.gauge("decode.slots_occupied")
+        )
+        self._queue = AdmissionQueue(
+            queue_capacity,
+            depth_gauge=metrics.gauge(f"serving.queue_depth.{model_id}"),
+            shed_counter=metrics.counter("serving.shed"),
+            tenant_policy=(
+                tenant_policy if tenant_policy is not None
+                else TenantPolicy.from_env()
+            ),
+            clock=clock,
+        )
+        self._program = None  # resolved lazily at first step / warmup
+        self._closed = False
+        self._draining = False
+        self._worker_lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._idle = threading.Event()
+        self._idle.set()
+        #: pokes the worker out of its idle wait the instant a stream
+        #: is submitted (or the endpoint closes) — admission latency is
+        #: event-driven, the poll interval is only the backstop
+        self._wake = threading.Event()
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit(
+        self,
+        prompt,
+        emit: Optional[Callable[[dict], Any]] = None,
+        max_steps: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
+        trace: Optional[Tuple[int, int]] = None,
+    ) -> "DecodeRequest":
+        """Admit one decode stream; returns the request (its ``future``
+        resolves with the stacked token output).  Sheds with the same
+        typed errors as the one-shot plane; ``max_steps`` is clamped to
+        the endpoint cap."""
+        if self._closed or self._draining:
+            raise ServerClosed(
+                f"decode endpoint {self.model_id!r} is "
+                f"{'draining' if self._draining else 'closed'}"
+            )
+        steps = self.max_steps
+        if max_steps is not None:
+            steps = max(1, min(int(max_steps), self.max_steps))
+        deadline = (
+            self._clock() + deadline_ms / 1000.0
+            if deadline_ms is not None else None
+        )
+        req = DecodeRequest(
+            value=np.asarray(prompt, dtype=self._dtype),
+            deadline=deadline,
+            tenant=tenant,
+            enqueued_at=self._clock(),
+            emit=emit,
+            max_steps=steps,
+        )
+        if tracer.enabled:
+            rspan = tracer.start_span(
+                "decode.request", remote=trace, model_id=self.model_id,
+                max_steps=steps,
+            )
+            req.span = rspan
+
+            def _end(future, _span=rspan):
+                exc = future.exception()
+                if exc is not None:
+                    _span.set_attribute("error", type(exc).__name__)
+                _span.end()
+
+            req.future.add_done_callback(_end)
+        metrics.counter("decode.requests").add(1)
+        self._m_requests.add(1)
+        self._ensure_worker()
+        self._idle.clear()
+        self._queue.offer(req)
+        self._wake.set()
+        return req
+
+    def decode(
+        self,
+        prompt,
+        max_steps: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        """Blocking one-shot convenience: the full ``(steps,
+        *token_shape)`` output with no streaming — the replay twin the
+        byte-identity contract compares streams against."""
+        req = self.submit(
+            prompt, max_steps=max_steps, deadline_ms=deadline_ms,
+            tenant=tenant,
+        )
+        return req.future.result(timeout)
+
+    # ------------------------------------------------------------------
+    # warmup
+    # ------------------------------------------------------------------
+    def warmup(self, example_prompt=None) -> Optional[str]:
+        """Resolve the fused step executable for the pool shape ahead of
+        traffic (needs one example prompt to bind the carry shape unless
+        a request already did).  Returns the resolve source
+        (memory/disk/compile) or None for uncompiled endpoints."""
+        if not self._compile:
+            return None
+        if self._pool.carry_shape is None:
+            if example_prompt is None:
+                raise ValueError(
+                    f"decode endpoint {self.model_id!r} has no bound "
+                    "carry shape yet; pass example_prompt"
+                )
+            carry = np.asarray(
+                self._init_fn(np.asarray(example_prompt, self._dtype))
+            )
+            shape = (self._pool.n_slots, *carry.shape)
+            dtype = carry.dtype
+        else:
+            shape = (self._pool.n_slots, *self._pool.carry_shape)
+            dtype = self._pool.carry_dtype
+        import jax
+
+        from sparkdl_tpu.engine import engine
+
+        handle = engine.program(
+            self._step_fn,
+            (jax.ShapeDtypeStruct(shape, dtype),),
+            fingerprint=self._decode_fingerprint(),
+            name=f"decode.{self.model_id}",
+        )
+        self._program = handle.callable
+        return handle.source
+
+    def _decode_fingerprint(self) -> Optional[str]:
+        # one executable per (model, slot-pool shape): the pool size is
+        # part of the identity, the per-request batch size is not
+        if self._fingerprint is None:
+            return None
+        return f"{self._fingerprint}:decode-slots-{self._pool.n_slots}"
+
+    # ------------------------------------------------------------------
+    # worker
+    # ------------------------------------------------------------------
+    def _ensure_worker(self) -> None:
+        with self._worker_lock:
+            if self._closed:
+                return
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._worker_loop,
+                    name=f"sparkdl-decode-{self.model_id}",
+                    daemon=True,
+                )
+                self._worker.start()
+
+    def _worker_loop(self) -> None:
+        try:
+            while not self._closed:
+                self._admit()
+                occupied = self._pool.occupied()
+                if not occupied:
+                    # clear-then-recheck: a submit landing between the
+                    # queue check and the wait sets the event and the
+                    # wait returns immediately — no admission stall
+                    self._wake.clear()
+                    if not len(self._queue):
+                        self._idle.set()
+                        self._wake.wait(_IDLE_POLL_S)
+                    continue
+                self._step_group(occupied)
+        except Exception:  # pragma: no cover - defensive
+            logger.exception(
+                "decode worker for %r died; failing in-flight streams",
+                self.model_id,
+            )
+        finally:
+            for slot in self._pool.release_all():
+                req = slot.request
+                if not req.future.done():
+                    req.future.set_exception(ServerClosed(
+                        f"decode endpoint {self.model_id!r} shut down "
+                        f"mid-stream (step {slot.step})"
+                    ))
+
+    def _admit(self) -> None:
+        """Continuous admission: fill free slots from the queue the
+        moment they free — non-blocking while any slot is decoding (the
+        in-flight streams must not stall on the queue), a short poll
+        only when the whole pool is idle."""
+        free = self._pool.n_free
+        if free == 0 or self._draining:
+            return
+        busy = self._pool.n_occupied > 0
+        reqs = self._queue.take(
+            free, 0.0, poll_s=0.0 if busy else _IDLE_POLL_S
+        )
+        now = self._clock()
+        for req in reqs:
+            if req.cancelled.is_set():
+                self._evict_disconnected(req, step=0)
+                continue
+            if req.expired(now):
+                metrics.counter("serving.expired").add(1)
+                req.future.set_exception(DeadlineExceeded(
+                    f"decode request to {self.model_id!r} expired after "
+                    f"{(now - req.enqueued_at) * 1000:.1f}ms in queue"
+                ))
+                continue
+            try:
+                carry = np.asarray(self._init_fn(req.value))
+            except Exception as exc:
+                req.future.set_exception(exc)
+                continue
+            slot = self._pool.acquire(req, carry, now=now)
+            assert slot is not None  # take() was capped at n_free
+            if req.span is not None:
+                req.span.event("slot_acquired", slot=slot.index)
+
+    def _resolve_program(self, carries: np.ndarray):
+        if self._program is None:
+            import jax
+
+            from sparkdl_tpu.engine import engine
+
+            handle = engine.program(
+                self._step_fn,
+                (jax.ShapeDtypeStruct(carries.shape, carries.dtype),),
+                fingerprint=self._decode_fingerprint(),
+                name=f"decode.{self.model_id}",
+            )
+            self._program = handle.callable
+        return self._program
+
+    def _step_group(self, occupied) -> None:
+        """One fused step over every occupied slot, then per-slot
+        emit/evict bookkeeping — the continuous-batching inner loop."""
+        t0 = self._clock()
+        gspan = None
+        if tracer.enabled:
+            gspan = tracer.start_span(
+                "decode.steps",
+                model_id=self.model_id,
+                n_slots=self._pool.n_slots,
+                n_occupied=len(occupied),
+                member_span_ids=[
+                    s.request.span.span_id for s in occupied
+                    if s.request.span is not None
+                ],
+            )
+        try:
+            try:
+                inject.fire("decode.step")
+                carries = self._pool.carries()
+                if self._compile:
+                    program = self._resolve_program(carries)
+                    new_carries, tokens = program(carries)
+                else:
+                    new_carries, tokens = self._step_fn(carries)
+                # snapshot BEFORE store_carries: an eager step_fn may
+                # return tokens as a view of the pool's carry buffer
+                # (e.g. ``carries[:, 0]``), and storing the new carries
+                # would silently rewrite them post-step — diverging from
+                # the compiled path, which returns fresh arrays
+                tokens = np.array(tokens, copy=True)
+                self._pool.store_carries(np.asarray(new_carries))
+            except Exception as exc:
+                # a failed fused step fails every in-flight stream on
+                # this endpoint, typed — their per-slot state is gone
+                metrics.counter("decode.errors").add(len(occupied))
+                if gspan is not None:
+                    gspan.set_attribute("error", type(exc).__name__)
+                for slot in occupied:
+                    req = slot.request
+                    self._pool.release(slot)
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+                return
+            step_ms = (self._clock() - t0) * 1000.0
+            self._m_step.observe(
+                step_ms,
+                exemplar=gspan.trace_id if gspan is not None else None,
+            )
+            metrics.counter("decode.steps").add(1)
+            now = self._clock()
+            for slot in occupied:
+                req = slot.request
+                token = np.array(tokens[slot.index], copy=True)
+                slot.step += 1
+                if slot.first_token_at is None:
+                    slot.first_token_at = now
+                    self._m_ttft.observe(
+                        (now - req.enqueued_at) * 1000.0,
+                        exemplar=(
+                            req.span.trace_id
+                            if req.span is not None else None
+                        ),
+                    )
+                if req.cancelled.is_set():
+                    self._pool.release(slot)
+                    self._evict_disconnected(req, step=slot.step)
+                    continue
+                req.tokens.append(token)
+                self._m_tokens.add(1)
+                done = (
+                    slot.step >= req.max_steps
+                    or (self._eos_fn is not None
+                        and bool(self._eos_fn(token, slot.step)))
+                )
+                expired = req.expired(now)
+                if not self._emit_frame(req, slot, token, final=False):
+                    self._pool.release(slot)
+                    self._evict_disconnected(req, step=slot.step)
+                    continue
+                if expired and not done:
+                    steps = slot.step
+                    self._pool.release(slot)
+                    metrics.counter("serving.expired").add(1)
+                    req.future.set_exception(DeadlineExceeded(
+                        f"decode stream to {self.model_id!r} hit its "
+                        f"deadline at step {steps}"
+                    ))
+                    continue
+                if done:
+                    self._finish(req, slot)
+        finally:
+            # an eos_fn / future-callback exception must not leak the
+            # fused-step group span
+            if gspan is not None:
+                gspan.end()
+
+    def _emit_frame(self, req: DecodeRequest, slot, token,
+                    final: bool) -> bool:
+        """Deliver one stream frame to the request's emit callback;
+        False means the client is gone (evict)."""
+        if req.emit is None:
+            return True
+        frame = {
+            "result": None if final else token,
+            "stream_seq": slot.stream_seq,
+            "final": final,
+        }
+        slot.stream_seq += 1
+        try:
+            inject.fire("decode.stream")
+            ok = req.emit(frame)
+        except Exception:
+            return False
+        return ok is not False
+
+    def _finish(self, req: DecodeRequest, slot) -> None:
+        steps = slot.step
+        acquired_at = slot.acquired_at
+        self._emit_frame(req, slot, None, final=True)
+        if req.span is not None:
+            req.span.set_attribute("steps", steps)
+        self._pool.release(slot)
+        if not req.future.done():
+            if acquired_at is not None:
+                # same contract as the micro-batcher: the phase
+                # decomposition rides the future so the replica can
+                # forward it on the final stream frame
+                now = self._clock()
+                req.future.sparkdl_phases = {
+                    "replica_queue": round(
+                        (acquired_at - req.enqueued_at) * 1000.0, 3
+                    ),
+                    "decode": round((now - acquired_at) * 1000.0, 3),
+                }
+            req.future.set_result(np.stack(req.tokens))
+
+    def _evict_disconnected(self, req: DecodeRequest, step: int) -> None:
+        metrics.counter("decode.evicted_disconnect").add(1)
+        if not req.future.done():
+            req.future.set_exception(ClientGone(
+                f"client of decode stream to {self.model_id!r} "
+                f"disconnected at step {step}; slot evicted"
+            ))
+
+    # ------------------------------------------------------------------
+    # lifecycle / introspection
+    # ------------------------------------------------------------------
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop admitting new streams but let the in-flight ones run to
+        completion (the rollout-drain contract for long-lived requests).
+        Returns True when the pool emptied within ``timeout_s``."""
+        self._draining = True
+        for req in self._queue.close():
+            req.future.set_exception(ServerClosed(
+                f"decode endpoint {self.model_id!r} is draining"
+            ))
+        deadline = self._clock() + timeout_s
+        while self._pool.n_occupied:
+            if self._clock() > deadline:
+                return False
+            # the worker sets _idle when the pool empties (the queue is
+            # already closed above), so this is a bounded event wait,
+            # not a poll
+            self._idle.wait(0.01)
+        return True
+
+    def close(self) -> None:
+        """Stop the worker; queued and in-flight streams fail with
+        ``ServerClosed``."""
+        self._closed = True
+        self._wake.set()
+        for req in self._queue.close():
+            req.future.set_exception(ServerClosed(
+                f"decode endpoint {self.model_id!r} closed"
+            ))
+        with self._worker_lock:
+            worker = self._worker
+        if worker is not None and worker.is_alive():
+            worker.join(timeout=5.0)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def slots(self) -> SlotPool:
+        return self._pool
+
+    @property
+    def fingerprint(self) -> Optional[str]:
+        return self._fingerprint
+
+    @property
+    def degraded(self) -> bool:
+        """Parity with the one-shot endpoint's breaker flag — the decode
+        plane fails streams typed instead of tripping a breaker (a slot
+        pool has no per-bucket blast radius to isolate), so it never
+        reports degraded."""
+        return False
+
+    @property
+    def worker_alive(self) -> bool:
+        with self._worker_lock:
+            return self._worker is not None and self._worker.is_alive()
+
+    def describe(self) -> dict:
+        return {
+            "model_id": self.model_id,
+            "kind": "decode",
+            "max_steps": self.max_steps,
+            "slots": self._pool.snapshot(),
+            "queue_depth": self.queue_depth,
+            "compiled": self._compile,
+            "fingerprint": self._fingerprint,
+            "draining": self._draining,
+            "closed": self._closed,
+        }
+
+    def __repr__(self):
+        return (
+            f"DecodeEndpoint({self.model_id!r}, "
+            f"slots={self._pool.n_slots}, max_steps={self.max_steps})"
+        )

@@ -197,6 +197,23 @@ class FaultyTransport(Transport):
                 )
         return reply
 
+    def stream(self, msg: Dict[str, Any], on_frame,
+               timeout_s: float) -> Dict[str, Any]:
+        """Decode streams get the same message-level faults: the
+        request site fires before the stream opens, the reply site
+        after its final frame (dropping it surfaces as the slow-backend
+        timeout shape, exactly like a dropped one-shot reply)."""
+        for rle in inject.decide(SITE_REQUEST):
+            self._apply(rle, dropped_ok=False)
+        reply = self._inner.stream(msg, on_frame, timeout_s)
+        for rle in inject.decide(SITE_REPLY):
+            if self._apply(rle, dropped_ok=True):
+                raise socket.timeout(
+                    "faultnet: final stream frame dropped after replica "
+                    "answered"
+                )
+        return reply
+
     def close(self) -> None:
         self._inner.close()
 

@@ -175,6 +175,53 @@ def demo_server_plain():
     return demo_server(compile=False)
 
 
+def demo_server_decode(endpoints: int = 3):
+    """``demo_server_plain`` plus a deterministic decode endpoint
+    (``dec0``): carry ``[acc, step]``, each step emits the pre-step
+    ``acc`` and adds 1 — so a prompt summing to ``s`` streams tokens
+    ``s, s+1, s+2, ...`` and the whole stream is replayable
+    byte-for-byte from the prompt alone.  ``SPARKDL_DEMO_STEP_MS``
+    (default 0) stalls each fused step, giving the mixed one-shot +
+    decode chaos scenarios a knob to keep streams in flight long
+    enough to be worth killing."""
+    from sparkdl_tpu.serving.batcher import ServingConfig
+    from sparkdl_tpu.serving.server import ModelServer
+
+    step_s = float(os.environ.get("SPARKDL_DEMO_STEP_MS", "0")) / 1000.0
+    dim = 64
+    server = ModelServer(config=ServingConfig(
+        max_batch=16, max_wait_ms=1.0, queue_capacity=512,
+    ))
+    for i in range(int(endpoints)):
+        weight = np.linspace(
+            -1.0, 1.0, dim * dim, dtype=np.float32
+        ).reshape(dim, dim) * (i + 1)
+
+        def forward(x, _w=weight):
+            return np.tanh(np.asarray(x) @ _w)
+
+        server.register(f"ep{i}", forward, item_shape=(dim,),
+                        compile=False)
+
+    def step_fn(carries):
+        if step_s > 0.0:
+            time.sleep(step_s)
+        tokens = np.array(carries[:, 0], copy=True)
+        return carries + np.asarray([1.0, 1.0], np.float32), tokens
+
+    def init_fn(prompt):
+        return np.asarray(
+            [float(np.asarray(prompt, np.float64).sum()), 0.0],
+            np.float32,
+        )
+
+    server.register_decode(
+        "dec0", step_fn, init_fn, max_steps=64, n_slots=8,
+        compile=False,
+    )
+    return server
+
+
 def demo_server_metered(endpoints: int = 3):
     """A fingerprinted, deliberately *metered* demo build for the
     result-cache sweeps (ISSUE-16): plain numpy forwards that cost
@@ -335,6 +382,7 @@ class ReplicaService:
                     self.request,
                     outer._handle_one,
                     handle_batch=outer._handle_batch,
+                    handle_stream=outer._handle_stream,
                     allow_shm=outer._allow_shm,
                 )
 
@@ -411,6 +459,98 @@ class ReplicaService:
             except Exception as exc:
                 replies.append(wire.encode_error(exc))
         return replies
+
+    def _handle_stream(self, msg: Dict[str, Any], send_frame) -> None:
+        """One ``decode`` op end to end: admit into the decode plane,
+        forward each token frame through ``send_frame`` the moment the
+        slot worker emits it, then terminate the stream with a final
+        frame carrying ``server_ms``/``phases``/piggybacked spans (or a
+        typed error).  ``send_frame`` raising ``ConnectionError`` marks
+        the client gone — the emit callback's failure evicts the slot,
+        so a disconnected consumer never burns another device step."""
+        span = self._serve_span(msg)
+        t0 = time.monotonic()
+        sent = 0  # token frames actually shipped
+
+        def fail(exc: BaseException) -> None:
+            self._end_span(span, type(exc))
+            err = wire.encode_error(exc)
+            err["final"] = True
+            err["stream_seq"] = sent
+            send_frame(err)
+
+        deadline_ms = msg.get("deadline_ms")
+        if deadline_ms is not None and float(deadline_ms) <= 0.0:
+            self._m_expired_shed.add(1)
+            fail(DeadlineExceeded(
+                f"decode request arrived at replica pid={os.getpid()} "
+                f"already expired ({deadline_ms}ms remaining)"
+            ))
+            return
+        with self._lock:
+            draining = self._draining
+            if not draining:
+                self._inflight += 1
+                self._m_inflight.set(self._inflight)
+        if draining:
+            fail(ReplicaDraining(
+                f"replica pid={os.getpid()} is draining"
+            ))
+            return
+        try:
+            inject.fire("supervisor.replica_serve")
+            self._m_requests.add(1)
+
+            def emit_cb(frame: Dict[str, Any]) -> bool:
+                nonlocal sent
+                if frame.get("final"):
+                    # the terminal frame is enriched and sent below,
+                    # after the future resolves (it alone may carry
+                    # server_ms / phases / spans)
+                    return True
+                send_frame(frame)  # ConnectionError -> slot evicted
+                sent += 1
+                return True
+
+            try:
+                with tracer.use_span(span):
+                    req = self._server.submit_decode(
+                        msg["value"],
+                        model_id=msg.get("model_id"),
+                        emit=emit_cb,
+                        max_steps=msg.get("max_steps"),
+                        deadline_ms=deadline_ms,
+                        tenant=msg.get("tenant"),
+                        trace=(
+                            span.context() if span is not None
+                            else msg.get("trace")
+                        ),
+                    )
+                req.future.result(timeout=self._request_timeout_s)
+            except Exception as exc:
+                if isinstance(exc, (ConnectionError, OSError)):
+                    # the client is gone (its disconnect evicted the
+                    # slot) — there is nobody left to send a frame to
+                    self._end_span(span, type(exc))
+                    raise
+                fail(exc)
+                return
+            final: Dict[str, Any] = {
+                "ok": True,
+                "final": True,
+                "stream_seq": sent,
+                "server_ms": round((time.monotonic() - t0) * 1000.0, 3),
+            }
+            phases = getattr(req.future, "sparkdl_phases", None)
+            if phases:
+                final["phases"] = dict(phases)
+            if span is not None:
+                span.set_attribute("steps", sent)
+                span.end()
+                final["spans"] = self._harvest.take(span.trace_id)
+            send_frame(final)
+        finally:
+            self._done_one()
 
     def _submit(self, msg: Dict[str, Any]):
         """Admit + submit one request; returns ``("reply", dict)`` for

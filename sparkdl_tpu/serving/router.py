@@ -55,6 +55,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
 from sparkdl_tpu.serving import transport as transport_mod
@@ -957,6 +959,189 @@ class Router:
             if span is not None:
                 span.end()
 
+    def route_stream(
+        self,
+        value: Any,
+        model_id: Optional[str] = None,
+        on_frame=None,
+        max_steps: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        timeout_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Place one autoregressive decode and stream its token frames.
+
+        ``on_frame(frame)`` fires for every incremental ``KIND_STREAM``
+        frame as it arrives off the wire; the return value is the final
+        envelope with ``result`` (the full token array, byte-identical
+        to a one-shot replay of the stream), ``steps``, ``server_ms``
+        and the phase breakdown.
+
+        Placement differs from :meth:`route_reply` in two deliberate
+        ways.  **No hedging**: a stream is pinned to the backend that
+        admitted it — racing a second decode would duplicate token
+        emission and double-charge a device slot for work the loser
+        throws away.  **Retry only before first token**: once a frame
+        has been forwarded to the caller the stream cannot be spliced
+        onto another replica mid-flight, so a connection failure after
+        that surfaces as the typed error it is.  The result cache is
+        bypassed entirely (decode output depends on ``max_steps`` and
+        per-step state, not just the prompt)."""
+        base_id, pin = split_versioned(model_id)
+        tm = self._tenant_instruments(tenant)
+        span = (
+            tracer.start_span(
+                "router.stream", model_id=model_id, tenant=tenant,
+            )
+            if tracer.enabled else None
+        )
+        try:
+            t_in = self._clock()
+            self._admit(tm)
+            start = self._clock()
+            admission_ms = (start - t_in) * 1000.0
+            budget = (
+                timeout_s if timeout_s is not None
+                else self._request_timeout_s
+            )
+            deadline = start + budget
+            if deadline_ms is not None:
+                deadline = min(deadline, start + float(deadline_ms) / 1000.0)
+            self._retry_budget.earn()
+            try:
+                inject.fire("router.route")
+                self._m_requests.add(1)
+                if tm is not None:
+                    tm.requests.add(1)
+                tokens: list = []
+
+                def fwd(frame: Dict[str, Any]) -> None:
+                    tokens.append(np.asarray(frame.get("result")))
+                    if on_frame is not None:
+                        on_frame(frame)
+
+                tried: set = set()
+                last_exc: Optional[BaseException] = None
+                retries = 0
+                while True:
+                    if self._clock() >= deadline:
+                        self._m_expired.add(1)
+                        self._m_errors.add(1)
+                        if tm is not None:
+                            tm.errors.add(1)
+                        raise DeadlineExceeded(
+                            f"deadline expired in router after {retries} "
+                            f"stream retr{'y' if retries == 1 else 'ies'}"
+                        ) from last_exc
+                    if retries > 0 and not self._retry_budget.spend():
+                        self._m_errors.add(1)
+                        if tm is not None:
+                            tm.errors.add(1)
+                        assert last_exc is not None
+                        raise last_exc
+                    backend = self._pick(tried, pin=pin)
+                    if backend is None:
+                        self._m_errors.add(1)
+                        if tm is not None:
+                            tm.errors.add(1)
+                        if last_exc is not None:
+                            raise last_exc
+                        raise NoLiveReplicas(
+                            "no live replica to place the stream on "
+                            f"(version {pin or 'any'}; "
+                            f"tried {sorted(tried) or 'none'})"
+                        )
+                    vm = self._version_instruments(backend.version)
+                    vm.requests.add(1)
+                    self._m_attempts.add(1)
+                    attempt_start = self._clock()
+                    msg: Dict[str, Any] = {
+                        "op": "decode",
+                        "model_id": base_id,
+                        "value": value,
+                        "max_steps": max_steps,
+                        "deadline_ms": (
+                            max(1.0, (deadline - attempt_start) * 1000.0)
+                            if deadline_ms is not None else None
+                        ),
+                        "tenant": tenant,
+                    }
+                    if span is not None:
+                        msg["trace"] = span.context()
+                    try:
+                        try:
+                            final = backend.transport.stream(
+                                msg, fwd, max(0.05, deadline - attempt_start),
+                            )
+                        except Exception:
+                            vm.errors.add(1)
+                            raise
+                        finally:
+                            self._unpick(backend)
+                    except Exception as exc:
+                        tried.add(backend.name)
+                        if not tokens and self._classify(exc) == "retry":
+                            # nothing forwarded yet: the stream never
+                            # really started, so re-place it whole
+                            last_exc = exc
+                            retries += 1
+                            self._m_retries.add(1)
+                            if span is not None:
+                                span.set_attribute("retries", retries)
+                            continue
+                        self._m_errors.add(1)
+                        if tm is not None:
+                            tm.errors.add(1)
+                        raise
+                    break
+                now = self._clock()
+                # per-version latency charges the whole stream; the
+                # hedge sample window does NOT see it — decode walls
+                # are token-count-shaped and would inflate the one-shot
+                # hedge trigger
+                attempt_ms = (now - attempt_start) * 1000.0
+                exemplar = span.trace_id if span is not None else None
+                vm.latency.observe(attempt_ms, exemplar=exemplar)
+                e2e_ms = (now - start) * 1000.0
+                self._m_latency.observe(e2e_ms, exemplar=exemplar)
+                if tm is not None:
+                    tm.latency.observe(e2e_ms, exemplar=exemplar)
+                reply = dict(final)
+                shipped = reply.pop("spans", None)
+                if span is not None:
+                    span.set_attribute("replica", backend.name)
+                    span.set_attribute("version", backend.version)
+                    span.set_attribute("steps", len(tokens))
+                    for remote_span in shipped or ():
+                        tracer.ingest(remote_span)
+                reply["result"] = (
+                    np.stack(tokens) if tokens
+                    else np.empty((0,), dtype=np.float32)
+                )
+                reply["steps"] = len(tokens)
+                self._decompose(
+                    reply,
+                    admission_ms=admission_ms,
+                    queue_ms=(attempt_start - start) * 1000.0,
+                    attempt_ms=attempt_ms,
+                    exemplar=exemplar,
+                )
+                if span is not None:
+                    span.set_attribute(
+                        "phases", dict(reply.get("phases") or {})
+                    )
+                    span.set_attribute("e2e_ms", e2e_ms)
+                return reply
+            finally:
+                self._release()
+        except BaseException as exc:
+            if span is not None:
+                span.set_attribute("error", type(exc).__name__)
+            raise
+        finally:
+            if span is not None:
+                span.end()
+
     def _decompose(self, reply: Dict[str, Any], admission_ms: float,
                    queue_ms: float, attempt_ms: float,
                    cache_ms: Optional[float] = None,
@@ -1009,6 +1194,81 @@ class Router:
             return reply
         raise wire.decode_error(reply)
 
+    def _front_stream(self, sock, msg: Dict[str, Any]) -> bool:
+        """One front-door decode: stream the replica's token frames to
+        the client as they arrive, then a final envelope (or a typed
+        error frame with ``final: True``).  Returns False when the
+        CLIENT connection died — the handler loop must stop; replica-
+        side failures come back as typed error frames instead."""
+        seq = msg.get("seq")
+
+        def send(frame: Dict[str, Any]) -> None:
+            out = dict(frame)
+            if seq is not None:
+                out["seq"] = seq
+            wire.send_stream(sock, out)
+
+        sent = 0
+        try:
+            t_route = self._clock()
+
+            def fwd(frame: Dict[str, Any]) -> None:
+                nonlocal sent
+                send(frame)
+                sent += 1
+
+            inner = self.route_stream(
+                msg["value"],
+                model_id=msg.get("model_id"),
+                on_frame=fwd,
+                max_steps=msg.get("max_steps"),
+                deadline_ms=msg.get("deadline_ms"),
+                tenant=msg.get("tenant"),
+            )
+            route_ms = (self._clock() - t_route) * 1000.0
+            final: Dict[str, Any] = {
+                "ok": True,
+                "final": True,
+                "stream_seq": sent,
+                "server_ms": inner.get("server_ms"),
+            }
+            phases = inner.get("phases")
+            if isinstance(phases, dict):
+                phases = dict(phases)
+                accounted = sum(
+                    v for v in phases.values()
+                    if isinstance(v, (int, float))
+                )
+                phases["frontdoor"] = max(0.0, route_ms - accounted)
+                phases["t_route"] = t_route
+                phases["t_send"] = self._clock()
+                final["phases"] = phases
+            send(final)
+        except (ConnectionError, OSError) as exc:
+            from sparkdl_tpu.resilience.errors import is_transient
+
+            if not is_transient(exc):
+                # a raw (untyped) connection error here is the CLIENT
+                # socket dying under send(); typed transients fall
+                # through to the error frame below
+                return False
+            err = wire.encode_error(exc)
+            err["final"] = True
+            err["stream_seq"] = sent
+            try:
+                send(err)
+            except (ConnectionError, OSError):
+                return False
+        except Exception as exc:
+            err = wire.encode_error(exc)
+            err["final"] = True
+            err["stream_seq"] = sent
+            try:
+                send(err)
+            except (ConnectionError, OSError):
+                return False
+        return True
+
     # ------------------------------------------------------------------
     # front door (what the load generators connect to)
     # ------------------------------------------------------------------
@@ -1034,6 +1294,14 @@ class Router:
                         reply: Dict[str, Any] = {
                             "ok": True, "replicas": outer.names(),
                         }
+                    elif msg.get("op") == "decode":
+                        # streaming front door: forward each replica
+                        # token frame to the client the moment it
+                        # lands, restamped with the CLIENT's seq (the
+                        # replica-leg seq belongs to that hop alone)
+                        if not outer._front_stream(self.request, msg):
+                            return
+                        continue
                     else:
                         try:
                             t_route = outer._clock()

@@ -27,6 +27,7 @@ import numpy as np
 
 from sparkdl_tpu.serving.batcher import MicroBatcher, ServingConfig
 from sparkdl_tpu.serving.cache import ProgramCache
+from sparkdl_tpu.serving.decode import DecodeEndpoint, DecodeRequest
 from sparkdl_tpu.utils.metrics import metrics
 
 
@@ -75,6 +76,46 @@ class ModelServer:
             self.config,
             self._cache,
             item_shape=item_shape,
+            dtype=dtype,
+            compile=compile,
+            fingerprint=fingerprint,
+        )
+        if self._default is None:
+            self._default = model_id
+        return self
+
+    def register_decode(
+        self,
+        model_id: str,
+        step_fn: Callable[[Any], Tuple[Any, Any]],
+        init_fn: Callable[[Any], Any],
+        max_steps: int,
+        eos_fn: Optional[Callable] = None,
+        n_slots: int = 8,
+        dtype: Any = np.float32,
+        compile: bool = True,
+        fingerprint: Optional[str] = None,
+    ) -> "ModelServer":
+        """Register an autoregressive decode endpoint (ISSUE-18).
+
+        ``step_fn(carries) -> (new_carries, tokens)`` runs fused over
+        the endpoint's fixed ``(n_slots, *carry_shape)`` pool every
+        step — one compiled executable per slot-pool shape, resolved
+        through the engine cache exactly like the one-shot buckets.
+        ``init_fn(prompt) -> carry`` seeds a slot; ``eos_fn(token,
+        step) -> bool`` ends a stream early; ``max_steps`` caps every
+        stream (requests may ask for fewer).  Serve with
+        :meth:`decode` / :meth:`submit_decode`."""
+        if model_id in self._endpoints:
+            raise ValueError(f"endpoint {model_id!r} already registered")
+        self._endpoints[model_id] = DecodeEndpoint(
+            model_id,
+            step_fn,
+            init_fn,
+            max_steps,
+            eos_fn=eos_fn,
+            n_slots=n_slots,
+            queue_capacity=self.config.queue_capacity,
             dtype=dtype,
             compile=compile,
             fingerprint=fingerprint,
@@ -222,6 +263,56 @@ class ModelServer:
             value, timeout=timeout, deadline_ms=deadline_ms, tenant=tenant
         )
 
+    def _decode_endpoint(self, model_id: Optional[str]) -> DecodeEndpoint:
+        ep = self._endpoint(model_id)
+        if not isinstance(ep, DecodeEndpoint):
+            raise TypeError(
+                f"endpoint {ep.model_id!r} is a one-shot endpoint; "
+                "decode ops need register_decode"
+            )
+        return ep
+
+    def submit_decode(
+        self,
+        prompt,
+        model_id: Optional[str] = None,
+        emit: Optional[Callable[[dict], Any]] = None,
+        max_steps: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
+        trace: Optional[Tuple[int, int]] = None,
+    ) -> DecodeRequest:
+        """Admit one decode stream; ``emit`` receives incremental
+        stream-frame dicts as tokens land (None for collect-all).  The
+        returned request's ``future`` resolves with the stacked token
+        output — byte-identical to the streamed sequence."""
+        return self._decode_endpoint(model_id).submit(
+            prompt,
+            emit=emit,
+            max_steps=max_steps,
+            deadline_ms=deadline_ms,
+            tenant=tenant,
+            trace=trace,
+        )
+
+    def decode(
+        self,
+        prompt,
+        model_id: Optional[str] = None,
+        max_steps: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        tenant: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        """Blocking decode: the full ``(steps, *token_shape)`` output."""
+        return self._decode_endpoint(model_id).decode(
+            prompt,
+            max_steps=max_steps,
+            deadline_ms=deadline_ms,
+            tenant=tenant,
+            timeout=timeout,
+        )
+
     # ------------------------------------------------------------------
     # warmup / observability / lifecycle
     # ------------------------------------------------------------------
@@ -236,7 +327,19 @@ class ModelServer:
             [self._endpoint(model_id)] if model_id is not None
             else list(self._endpoints.values())
         )
-        return {ep.model_id: ep.warmup(buckets=buckets) for ep in targets}
+        out: Dict[str, Tuple] = {}
+        for ep in targets:
+            if isinstance(ep, DecodeEndpoint):
+                # decode endpoints have exactly one program (the pool
+                # shape); warmable only once a request/example bound it
+                try:
+                    src = ep.warmup()
+                    out[ep.model_id] = (src,) if src else ()
+                except ValueError:
+                    out[ep.model_id] = ()
+            else:
+                out[ep.model_id] = ep.warmup(buckets=buckets)
+        return out
 
     def status(self, probe_device: bool = False,
                probe_timeout_s: int = 60) -> Dict[str, Any]:

@@ -173,6 +173,23 @@ class Transport(abc.ABC):
     def request(self, msg: Dict[str, Any], timeout_s: float) -> Dict[str, Any]:
         """Send one envelope, return the reply envelope."""
 
+    def stream(
+        self,
+        msg: Dict[str, Any],
+        on_frame: Callable[[Dict[str, Any]], Any],
+        timeout_s: float,
+    ) -> Dict[str, Any]:
+        """One decode stream: send ``msg``, forward each partial
+        :data:`~sparkdl_tpu.serving.wire.KIND_STREAM` frame to
+        ``on_frame`` as it arrives, return the ``final: True`` envelope.
+        The stream is pinned to this backend for its whole life — a
+        failure mid-stream raises (``ConnectionError`` / typed) and the
+        channel is dropped, never reused.  Lanes without an
+        implementation are stream-incapable."""
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot carry decode streams"
+        )
+
     @abc.abstractmethod
     def close(self) -> None:
         """Release sockets/segments; in-flight requests fail fast."""
@@ -240,6 +257,43 @@ def _stamp_seq(msg: Dict[str, Any]) -> Tuple[Dict[str, Any], int]:
     stamped = dict(msg)
     stamped["seq"] = seq
     return stamped, seq
+
+
+def _consume_stream(
+    next_frame: Callable[[], Tuple[int, Any]],
+    on_frame: Callable[[Dict[str, Any]], Any],
+    seq: int,
+    wire_ms: float,
+) -> Dict[str, Any]:
+    """Drive one decode stream off ``next_frame()`` until its terminal
+    frame — the client half of the streaming contract, shared by the
+    tcp and shm lanes.  Every frame must be ``KIND_STREAM``, echo our
+    ``seq``, and carry a gap-free 0-based ``stream_seq``; a typed error
+    frame raises the decoded error, a protocol violation raises
+    ``ConnectionError`` (the caller drops the channel).  Partial frames
+    are handed to ``on_frame`` in arrival order; the ``final: True``
+    envelope is returned with the wire phase stamped."""
+    expect = 0
+    while True:
+        kind, frame = next_frame()
+        if kind != wire.KIND_STREAM or not isinstance(frame, dict):
+            raise ConnectionError(
+                "non-stream frame on a decode stream channel"
+            )
+        if not frame.get("ok", True) or frame.get("error_class"):
+            # the replica ended the stream with a typed error frame —
+            # surface the error itself, not a protocol complaint
+            raise wire.decode_error(frame)
+        _check_seq(frame, seq)
+        if frame.get("stream_seq") != expect:
+            raise ConnectionError(
+                f"stream desync: expected stream_seq {expect}, frame "
+                f"carries {frame.get('stream_seq')!r}"
+            )
+        expect += 1
+        if frame.get("final"):
+            return _stamp_wire(frame, wire_ms)
+        on_frame(frame)
 
 
 def _check_seq(reply: Any, seq: int) -> Any:
@@ -506,6 +560,48 @@ class TcpTransport(Transport):
         self._checkin(sock)
         return _stamp_wire(reply, wire_ms)
 
+    def stream(
+        self,
+        msg: Dict[str, Any],
+        on_frame: Callable[[Dict[str, Any]], Any],
+        timeout_s: float,
+    ) -> Dict[str, Any]:
+        """One decode stream over a DEDICATED pooled socket.  The
+        coalescer is strictly request/reply, so streams always bypass
+        it; the socket returns to the pool only after a clean final
+        frame (a torn stream closes it — half-consumed frames must
+        never leak into the next request)."""
+        sock = self._checkout()
+        msg, seq = _stamp_seq(msg)
+        deadline = time.monotonic() + timeout_s
+        try:
+            sock.settimeout(timeout_s)
+            t0 = time.perf_counter()
+            wire.sendall_parts(sock, wire.encode_parts(msg, wire.KIND_MSG))
+            wire_ms = (time.perf_counter() - t0) * 1000.0
+
+            def next_frame() -> Tuple[int, Any]:
+                sock.settimeout(min(
+                    self._io_timeout_s,
+                    max(0.05, deadline - time.monotonic()),
+                ))
+                got = wire.recv_any(sock)
+                if got is None:
+                    raise ConnectionError(
+                        "replica closed connection mid-stream"
+                    )
+                return got
+
+            reply = _consume_stream(next_frame, on_frame, seq, wire_ms)
+        except BaseException:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        self._checkin(sock)
+        return reply
+
     def _checkout(self) -> socket.socket:
         """A pooled socket proven idle-healthy, or a fresh dial.  Aged
         and stale entries are discarded here (probe outside the lock —
@@ -767,7 +863,39 @@ class _ShmClientChannel:
         t0 = time.perf_counter()
         msg, seq = _stamp_seq(msg)
         parts = wire.encode_parts(msg, wire.KIND_MSG)
-        total = wire.parts_len(parts)
+        self._write_request(parts, wire.parts_len(parts), deadline)
+        wire_ms = (time.perf_counter() - t0) * 1000.0
+        kind, obj = self._next_frame(deadline)
+        if kind != wire.KIND_MSG:
+            raise ConnectionError("unexpected batch frame on shm ring")
+        return _stamp_wire(_check_seq(obj, seq), wire_ms)
+
+    def stream(
+        self,
+        msg: Dict[str, Any],
+        on_frame: Callable[[Dict[str, Any]], Any],
+        timeout_s: float,
+    ) -> Dict[str, Any]:
+        """One decode stream over this shm channel: the request rides
+        the tx ring (or spills), and each ``KIND_STREAM`` frame comes
+        back as its own ring record — same doorbell wake, same CRC and
+        seq-echo discipline as request/reply, just 0+N frames instead
+        of exactly one."""
+        inject.fire("wire.shm")
+        deadline = time.monotonic() + timeout_s
+        t0 = time.perf_counter()
+        msg, seq = _stamp_seq(msg)
+        parts = wire.encode_parts(msg, wire.KIND_MSG)
+        self._write_request(parts, wire.parts_len(parts), deadline)
+        wire_ms = (time.perf_counter() - t0) * 1000.0
+        return _consume_stream(
+            lambda: self._next_frame(deadline), on_frame, seq, wire_ms
+        )
+
+    def _write_request(self, parts, total: int, deadline: float) -> None:
+        """Publish one encoded request: onto the tx ring when it fits
+        (doorbell if the replica advertised a wait), spilled whole onto
+        the TCP side-channel when it doesn't."""
         assert self._tx is not None and self._rx is not None
         if self._tx.fits(total):
             while not self._tx.try_write(parts, total):
@@ -788,15 +916,17 @@ class _ShmClientChannel:
             # frame itself wakes the replica — no doorbell needed)
             wire.sendall_parts(self._sock, parts)
             metrics.counter("wire.shm.spill").add(1)
-        wire_ms = (time.perf_counter() - t0) * 1000.0
+
+    def _next_frame(self, deadline: float) -> Tuple[int, Any]:
+        """The next reply frame as ``(kind, obj)`` — from the rx ring,
+        or whole off the side-channel when the replica spilled an
+        oversized frame."""
+        assert self._rx is not None
         spins = 0
         while True:
             record = self._rx.try_read()
             if record is not None:
-                kind, obj = wire.decode_frame(record)
-                if kind != wire.KIND_MSG:
-                    raise ConnectionError("unexpected batch frame on shm ring")
-                return _stamp_wire(_check_seq(obj, seq), wire_ms)
+                return wire.decode_frame(record)
             if spins < _POLL_SPIN:
                 # pure ring polls — no syscalls until we decide to block
                 spins += 1
@@ -815,12 +945,7 @@ class _ShmClientChannel:
                         min(_CLIENT_WAIT_S, max(deadline - now, 0.001)),
                     )
                     if got is not None:  # oversized reply spilled to tcp
-                        kind, obj = got
-                        if kind != wire.KIND_MSG:
-                            raise ConnectionError(
-                                "unexpected batch frame on shm side-channel"
-                            )
-                        return _stamp_wire(_check_seq(obj, seq), wire_ms)
+                        return got
             finally:
                 self._rx.set_waiter(False)
 
@@ -892,6 +1017,29 @@ class ShmTransport(Transport):
             reply = chan.request(msg, timeout_s)
         except BaseException:
             chan.close()  # failed channel: segment unlinked right here
+            raise
+        self._checkin(chan)
+        return reply
+
+    def stream(
+        self,
+        msg: Dict[str, Any],
+        on_frame: Callable[[Dict[str, Any]], Any],
+        timeout_s: float,
+    ) -> Dict[str, Any]:
+        fallback = self._fallback
+        chan = None
+        if fallback is None:
+            try:
+                chan = self._checkout()
+            except _ShmUnavailable as exc:
+                fallback = self._fall_back(str(exc))
+        if fallback is not None:
+            return fallback.stream(msg, on_frame, timeout_s)
+        try:
+            reply = chan.stream(msg, on_frame, timeout_s)
+        except BaseException:
+            chan.close()  # torn stream: segment unlinked right here
             raise
         self._checkin(chan)
         return reply
@@ -1091,12 +1239,22 @@ def serve_connection(
     handle_batch: Optional[
         Callable[[List[Dict[str, Any]]], List[Dict[str, Any]]]
     ] = None,
+    handle_stream: Optional[
+        Callable[[Dict[str, Any], Callable[[Dict[str, Any]], None]], None]
+    ] = None,
     allow_shm: Optional[bool] = None,
 ) -> None:
     """Serve one client connection until EOF: the replica's request
     loop, shared by the real replica process and the in-process test
     services.  Handler exceptions become typed error replies; transport
-    errors end the connection (the client retries elsewhere)."""
+    errors end the connection (the client retries elsewhere).
+
+    ``handle_stream(msg, send_frame)`` — when given — owns ``decode``
+    ops: it must push 0+ partial frames plus exactly one ``final: True``
+    frame through ``send_frame`` (each goes out as ``KIND_STREAM`` with
+    the request ``seq`` echoed, on whichever lane the connection runs).
+    The stream occupies this connection until its final frame — which is
+    why the router pins streams to a dedicated channel."""
     chan = ServerChannel(sock, allow_shm=allow_shm)
     try:
         while True:
@@ -1107,6 +1265,31 @@ def serve_connection(
             if got is None:
                 return
             kind, msg = got
+            if (handle_stream is not None and kind == wire.KIND_MSG
+                    and isinstance(msg, dict)
+                    and msg.get("op") == "decode"):
+
+                def send_frame(frame: Dict[str, Any], _msg=msg) -> None:
+                    chan.send(
+                        _echo_seq(_msg, frame), kind=wire.KIND_STREAM
+                    )
+
+                try:
+                    handle_stream(msg, send_frame)
+                except (ConnectionError, OSError):
+                    return
+                except Exception as exc:
+                    # a handler that died without terminating its own
+                    # stream: end it with a typed error frame (the
+                    # client surfaces the error; a gap-free consumer
+                    # treats a bad stream_seq as a dropped channel)
+                    err = wire.encode_error(exc)
+                    err["final"] = True
+                    try:
+                        send_frame(err)
+                    except (ConnectionError, OSError):
+                        return
+                continue
             try:
                 if kind == wire.KIND_BATCH:
                     if not isinstance(msg, list):

@@ -67,6 +67,12 @@ import numpy as np
 MAGIC = b"SDW2"
 KIND_MSG = 1
 KIND_BATCH = 2
+#: one *incremental* reply frame in a decode stream: same framing, CRC
+#: trailer, and seq-echo as any reply, but the channel stays open — a
+#: request is answered by 0+ KIND_STREAM frames followed by exactly one
+#: whose envelope carries ``final: True``.  Ordering within the stream
+#: is ``stream_seq`` (0-based, gap-free: a hole means a torn stream).
+KIND_STREAM = 3
 
 _PREFIX = struct.Struct(">4sBBIQ")  # magic, kind, flags, meta_len, body_len
 
@@ -122,8 +128,11 @@ _TENSOR_MARK = "\x00sdw-tensor\x00"
 #: without a codec roundtrip proving it survives both lanes.
 ENVELOPE_FIELDS = frozenset({
     # requests ("seq" is the per-channel request sequence number the
-    # reply must echo — the duplicate/desynced-reply detector)
+    # reply must echo — the duplicate/desynced-reply detector;
+    # "max_steps" is the decode op's per-request step cap, clamped to
+    # the endpoint's registered maximum)
     "op", "model_id", "value", "deadline_ms", "tenant", "trace", "seq",
+    "max_steps",
     # shm lane upgrade handshake
     "shm", "ring_bytes",
     # replies ("cache" marks how the result was produced — "hit" from
@@ -131,6 +140,14 @@ ENVELOPE_FIELDS = frozenset({
     # reply out, "negative" when a poison-input error replayed)
     "ok", "result", "server_ms", "phases", "spans",
     "pid", "draining", "replicas", "cache",
+    # streaming replies (KIND_STREAM): "stream_seq" is the 0-based
+    # gap-free position of this frame in its stream, "final" marks the
+    # stream's terminal frame (the only one allowed to carry phases /
+    # spans / server_ms; every frame echoes "seq" like any reply);
+    # "steps" is the stitched reply's generated-token count — the
+    # router stamps it on the reassembled stream result and the front
+    # door forwards it in the terminal frame
+    "stream_seq", "final", "steps",
     # typed errors
     "error", "error_class",
 })
@@ -261,7 +278,7 @@ def _parse_prefix(head: bytes) -> Tuple[int, int, int, int]:
         raise ConnectionError(
             f"bad frame magic {magic!r} — torn or foreign stream"
         )
-    if kind not in (KIND_MSG, KIND_BATCH):
+    if kind not in (KIND_MSG, KIND_BATCH, KIND_STREAM):
         raise ConnectionError(f"unknown frame kind {kind}")
     if meta_len > MAX_META_BYTES or meta_len + body_len > MAX_FRAME_BYTES:
         raise ConnectionError(
@@ -424,6 +441,15 @@ def send_batch(sock: socket.socket, msgs: Sequence[Any]) -> None:
     """N envelopes in one KIND_BATCH frame sharing a single body — the
     TCP lane's coalescer amortizes prefix + syscall across them."""
     sendall_parts(sock, encode_parts(list(msgs), KIND_BATCH))
+
+
+def send_stream(sock: socket.socket, obj: Any) -> None:
+    """One incremental :data:`KIND_STREAM` frame — a partial decode
+    reply on a channel that stays open until a frame with
+    ``final: True``.  CRC stamping and seq-echo apply exactly as for
+    :func:`send_msg`; only the kind differs, so receivers can tell a
+    stream fragment from a one-shot reply without peeking envelopes."""
+    sendall_parts(sock, encode_parts(obj, KIND_STREAM))
 
 
 def recv_msg(sock: socket.socket) -> Optional[Any]:

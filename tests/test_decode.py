@@ -1,0 +1,762 @@
+"""Continuous-batching decode plane tests (ISSUE-18).
+
+Covers the slot pool (carry zeroing, mid-flight admission), the decode
+endpoint (byte-identity between streamed and one-shot output, eos/
+deadline/disconnect eviction, the ``decode.step`` / ``decode.stream``
+fault sites), the wire streaming path on both lanes (gap-free
+``KIND_STREAM`` frames, client-disconnect eviction), the router's
+stream placement (backend pinning, retry only before the first token,
+stitched ``decode.*`` spans), and the process-level kill matrix: a
+``FaultPlan`` SIGKILL at ``decode.step`` mid-stream must surface as a
+typed/connection-shaped error, never corrupt a completed stream, and
+leave no slot state behind.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkdl_tpu.engine.slots import SlotPool
+from sparkdl_tpu.obs.export import JsonlTraceSink
+from sparkdl_tpu.obs.trace import tracer
+from sparkdl_tpu.resilience import inject
+from sparkdl_tpu.resilience.errors import TransientError, is_transient
+from sparkdl_tpu.serving import ModelServer, wire
+from sparkdl_tpu.serving.decode import ClientGone, DecodeEndpoint
+from sparkdl_tpu.serving.errors import (
+    DeadlineExceeded,
+    ServerClosed,
+)
+from sparkdl_tpu.serving.replica import ReplicaService
+from sparkdl_tpu.serving.router import Router
+from sparkdl_tpu.serving.transport import ShmTransport, TcpTransport
+from sparkdl_tpu.utils.metrics import metrics
+
+
+@pytest.fixture(autouse=True)
+def clean_slate():
+    tracer.disable()
+    metrics.reset()
+    yield
+    tracer.disable()
+    metrics.reset()
+
+
+def counting_step(step_s: float = 0.0):
+    """carry [acc, step] -> emit pre-step acc, add 1 to both — prompt
+    summing to s streams s, s+1, s+2, ... (deterministically
+    replayable, the byte-identity reference)."""
+
+    def step_fn(carries):
+        if step_s > 0.0:
+            time.sleep(step_s)
+        tokens = np.array(carries[:, 0], copy=True)
+        return carries + np.asarray([1.0, 1.0], np.float32), tokens
+
+    return step_fn
+
+
+def sum_init(prompt):
+    return np.asarray(
+        [float(np.asarray(prompt, np.float64).sum()), 0.0], np.float32
+    )
+
+
+def make_endpoint(**kw):
+    defaults = dict(
+        max_steps=16, n_slots=4, compile=False, step_s=0.0,
+    )
+    defaults.update(kw)
+    step_s = defaults.pop("step_s")
+    return DecodeEndpoint(
+        "dec", counting_step(step_s), sum_init, **defaults
+    )
+
+
+def expected_tokens(prompt_sum: float, steps: int):
+    return [float(prompt_sum + i) for i in range(steps)]
+
+
+# ----------------------------------------------------------------------
+# slot pool
+# ----------------------------------------------------------------------
+class TestSlotPool:
+    def test_acquire_binds_shape_release_zeroes(self):
+        pool = SlotPool(3)
+        s0 = pool.acquire("r0", np.asarray([5.0, 1.0], np.float32))
+        assert s0 is not None and s0.index == 0
+        assert pool.carry_shape == (2,)
+        assert pool.n_free == 2 and pool.n_occupied == 1
+        np.testing.assert_array_equal(
+            pool.carries()[0], [5.0, 1.0]
+        )
+        pool.release(s0)
+        # no state carryover: the freed row is zeroed, not stale
+        np.testing.assert_array_equal(pool.carries()[0], [0.0, 0.0])
+        assert pool.n_free == 3
+
+    def test_mismatched_carry_shape_rejected(self):
+        pool = SlotPool(2)
+        pool.acquire("r0", np.zeros(2, np.float32))
+        with pytest.raises(ValueError, match="one pool serves one"):
+            pool.acquire("r1", np.zeros(3, np.float32))
+
+    def test_release_all_returns_occupants(self):
+        pool = SlotPool(2)
+        pool.acquire("a", np.zeros(2, np.float32))
+        pool.acquire("b", np.zeros(2, np.float32))
+        evicted = pool.release_all()
+        assert [s.request for s in evicted] == ["a", "b"]
+        assert pool.n_occupied == 0
+        np.testing.assert_array_equal(
+            pool.carries(), np.zeros((2, 2), np.float32)
+        )
+
+    def test_freed_slot_is_reused_mid_flight(self):
+        pool = SlotPool(2)
+        a = pool.acquire("a", np.ones(2, np.float32))
+        pool.acquire("b", np.ones(2, np.float32))
+        assert pool.acquire("c", np.ones(2, np.float32)) is None
+        pool.release(a)
+        c = pool.acquire("c", np.full(2, 7.0, np.float32))
+        assert c is not None and c.index == a.index
+        np.testing.assert_array_equal(pool.carries()[c.index], 7.0)
+
+
+# ----------------------------------------------------------------------
+# endpoint: streaming semantics
+# ----------------------------------------------------------------------
+class TestDecodeEndpoint:
+    def test_stream_and_result_byte_identical(self):
+        ep = make_endpoint()
+        try:
+            frames = []
+            req = ep.submit([2.0, 1.0], emit=frames.append, max_steps=6)
+            result = req.future.result(timeout=10)
+            streamed = [f for f in frames if not f["final"]]
+            final = [f for f in frames if f["final"]]
+            # gap-free 0-based stream_seq, exactly one final frame
+            assert [f["stream_seq"] for f in streamed] == list(range(6))
+            assert len(final) == 1 and final[0]["stream_seq"] == 6
+            np.testing.assert_array_equal(
+                np.stack([f["result"] for f in streamed]), result
+            )
+            # the one-shot replay of the same prompt is byte-identical
+            np.testing.assert_array_equal(
+                ep.decode([2.0, 1.0], max_steps=6, timeout=10), result
+            )
+            assert result.tolist() == expected_tokens(3.0, 6)
+        finally:
+            ep.close()
+        assert ep.slots.n_occupied == 0
+
+    def test_eos_stops_stream_early(self):
+        ep = DecodeEndpoint(
+            "dec", counting_step(), sum_init, max_steps=50,
+            eos_fn=lambda tok, step: float(tok) >= 4.0,
+            n_slots=2, compile=False,
+        )
+        try:
+            out = ep.decode([2.0], timeout=10)
+            assert out.tolist() == [2.0, 3.0, 4.0]
+        finally:
+            ep.close()
+
+    def test_max_steps_clamped_to_endpoint_cap(self):
+        ep = make_endpoint(max_steps=4)
+        try:
+            out = ep.decode([1.0], max_steps=99, timeout=10)
+            assert out.tolist() == expected_tokens(1.0, 4)
+        finally:
+            ep.close()
+
+    def test_continuous_admission_short_not_stuck_behind_long(self):
+        """THE acceptance property: with a long decode occupying one
+        slot, a short request admitted later completes while the long
+        one is still mid-flight — no barrier on the slowest sequence."""
+        ep = make_endpoint(n_slots=2, max_steps=400, step_s=0.005)
+        try:
+            long_req = ep.submit([0.0], max_steps=400)
+            short = ep.decode([1.0], max_steps=3, timeout=30)
+            assert short.tolist() == expected_tokens(1.0, 3)
+            assert not long_req.future.done(), (
+                "short stream should finish while the long decode is "
+                "still running"
+            )
+            long_req.cancelled.set()  # don't burn 400 steps of teardown
+        finally:
+            ep.close()
+
+    def test_admission_into_freed_slot_mid_flight(self):
+        """More queued streams than slots: the (n_slots+1)-th stream is
+        admitted into a freed slot while others still decode."""
+        ep = make_endpoint(n_slots=2, max_steps=64, step_s=0.002)
+        try:
+            reqs = [
+                ep.submit([float(i)], max_steps=4 + 4 * i)
+                for i in range(5)
+            ]
+            outs = [r.future.result(timeout=30) for r in reqs]
+            for i, out in enumerate(outs):
+                assert out.tolist() == expected_tokens(float(i), 4 + 4 * i)
+        finally:
+            ep.close()
+        assert ep.slots.n_occupied == 0
+
+    def test_deadline_expiry_mid_stream_evicts_typed(self):
+        ep = make_endpoint(n_slots=1, max_steps=10_000, step_s=0.01)
+        try:
+            req = ep.submit([1.0], deadline_ms=60.0)
+            with pytest.raises(DeadlineExceeded):
+                req.future.result(timeout=30)
+            deadline = time.monotonic() + 5
+            while ep.slots.n_occupied and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ep.slots.n_occupied == 0, "expired stream leaked its slot"
+            # the endpoint still serves after the eviction
+            assert ep.decode([2.0], max_steps=2, timeout=10).tolist() == [
+                2.0, 3.0,
+            ]
+        finally:
+            ep.close()
+
+    def test_client_disconnect_evicts_slot(self):
+        """emit returning False = client gone: the stream fails with
+        ``ClientGone``, the slot frees immediately (no more device
+        steps burned), and the pool keeps serving others."""
+        ep = make_endpoint(n_slots=1, max_steps=1000, step_s=0.002)
+        try:
+            seen = []
+
+            def flaky_emit(frame):
+                seen.append(frame)
+                return len(seen) < 3  # hang up after 3 frames
+
+            req = ep.submit([5.0], emit=flaky_emit)
+            with pytest.raises(ClientGone):
+                req.future.result(timeout=30)
+            assert metrics.counter("decode.evicted_disconnect").value == 1
+            deadline = time.monotonic() + 5
+            while ep.slots.n_occupied and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ep.slots.n_occupied == 0
+            assert metrics.gauge("decode.slots_occupied").value == 0
+            # the freed slot serves the next stream with clean state
+            assert ep.decode([9.0], max_steps=2, timeout=10).tolist() == [
+                9.0, 10.0,
+            ]
+        finally:
+            ep.close()
+
+    def test_emit_raising_is_disconnect_too(self):
+        ep = make_endpoint(n_slots=1, max_steps=100)
+        try:
+            def dead_emit(frame):
+                raise ConnectionError("peer reset")
+
+            req = ep.submit([1.0], emit=dead_emit)
+            with pytest.raises(ClientGone):
+                req.future.result(timeout=30)
+        finally:
+            ep.close()
+
+    def test_cancel_before_admission_never_burns_a_slot(self):
+        ep = make_endpoint(n_slots=1, max_steps=500, step_s=0.005)
+        try:
+            blocker = ep.submit([0.0], max_steps=500)
+            victim = ep.submit([1.0], max_steps=500)
+            victim.cancelled.set()  # client gone while still queued
+            with pytest.raises(ClientGone):
+                victim.future.result(timeout=30)
+            blocker.cancelled.set()
+        finally:
+            ep.close()
+
+    def test_failed_fused_step_fails_all_streams_typed(self):
+        calls = {"n": 0}
+
+        def exploding_step(carries):
+            calls["n"] += 1
+            if calls["n"] >= 3:
+                raise TransientError("device poked")
+            tokens = np.array(carries[:, 0], copy=True)
+            return carries + 1.0, tokens
+
+        ep = DecodeEndpoint(
+            "dec", exploding_step, sum_init, max_steps=50,
+            n_slots=4, compile=False,
+        )
+        try:
+            reqs = [ep.submit([float(i)], max_steps=50) for i in range(3)]
+            for req in reqs:
+                with pytest.raises(TransientError):
+                    req.future.result(timeout=30)
+            assert ep.slots.n_occupied == 0
+            assert metrics.counter("decode.errors").value == 3
+        finally:
+            ep.close()
+
+    def test_drain_finishes_inflight_rejects_new(self):
+        ep = make_endpoint(n_slots=2, max_steps=200, step_s=0.002)
+        try:
+            got_token = threading.Event()
+
+            def emit(frame):
+                got_token.set()
+                return True
+
+            req = ep.submit([1.0], max_steps=20, emit=emit)
+            assert got_token.wait(timeout=10), "stream never admitted"
+            assert ep.drain(timeout_s=30)
+            assert req.future.result(timeout=1).tolist() == (
+                expected_tokens(1.0, 20)
+            )
+            with pytest.raises(ServerClosed):
+                ep.submit([2.0])
+        finally:
+            ep.close()
+
+    def test_close_fails_queued_and_inflight(self):
+        ep = make_endpoint(n_slots=1, max_steps=10_000, step_s=0.01)
+        inflight = ep.submit([0.0])
+        queued = ep.submit([1.0])
+        ep.close()
+        for req in (inflight, queued):
+            with pytest.raises(ServerClosed):
+                req.future.result(timeout=10)
+        assert ep.slots.n_occupied == 0
+
+
+# ----------------------------------------------------------------------
+# fault sites (fault-site-coverage: decode.step / decode.stream)
+# ----------------------------------------------------------------------
+class TestDecodeFaultSites:
+    def test_decode_step_fault_fails_stream_typed(self):
+        plan = inject.FaultPlan().add(
+            "decode.step", error="transient", at=2,
+        )
+        ep = make_endpoint(n_slots=2, max_steps=50)
+        try:
+            with inject.active_plan(plan):
+                req = ep.submit([1.0], max_steps=50)
+                with pytest.raises(TransientError):
+                    req.future.result(timeout=30)
+            assert plan.count("decode.step") >= 2
+            assert ep.slots.n_occupied == 0
+            # typed-transient by taxonomy: the router may re-place it
+            exc = req.future.exception()
+            assert is_transient(exc)
+        finally:
+            ep.close()
+
+    def test_decode_stream_fault_evicts_as_disconnect(self):
+        plan = inject.FaultPlan().add(
+            "decode.stream", error="transient", at=3,
+        )
+        ep = make_endpoint(n_slots=1, max_steps=50)
+        try:
+            frames = []
+            with inject.active_plan(plan):
+                req = ep.submit(
+                    [1.0], emit=frames.append, max_steps=50,
+                )
+                with pytest.raises(ClientGone):
+                    req.future.result(timeout=30)
+            # the frames delivered before the fault are intact
+            assert [float(f["result"]) for f in frames] == [1.0, 2.0]
+            assert ep.slots.n_occupied == 0
+        finally:
+            ep.close()
+
+
+# ----------------------------------------------------------------------
+# wire: KIND_STREAM over both lanes
+# ----------------------------------------------------------------------
+def decode_replica(n_slots=4, step_s=0.0):
+    server = ModelServer()
+    server.register_decode(
+        "dec", counting_step(step_s), sum_init, max_steps=64,
+        n_slots=n_slots, compile=False,
+    )
+    service = ReplicaService(server).start()
+    return server, service
+
+
+class TestDecodeWire:
+    @pytest.mark.parametrize("transport_cls", [TcpTransport, ShmTransport])
+    def test_stream_over_wire_matches_oneshot(self, transport_cls):
+        server, service = decode_replica()
+        t = transport_cls("127.0.0.1", service.port)
+        try:
+            frames = []
+            final = t.stream(
+                {"op": "decode", "model_id": "dec", "value": [2.0, 2.0],
+                 "max_steps": 5},
+                frames.append, timeout_s=30.0,
+            )
+            toks = [float(f["result"]) for f in frames]
+            assert toks == expected_tokens(4.0, 5)
+            assert [f["stream_seq"] for f in frames] == list(range(5))
+            assert final["ok"] and final["final"]
+            assert final["stream_seq"] == 5
+            assert {"replica_queue", "decode"} <= set(final["phases"])
+            # byte-identity against the in-process replay
+            replay = server.decode([2.0, 2.0], max_steps=5)
+            np.testing.assert_array_equal(np.asarray(toks), replay)
+        finally:
+            t.close()
+            service.close()
+            server.close()
+
+    def test_typed_error_ends_stream_and_channel_survives(self):
+        server, service = decode_replica()
+        t = TcpTransport("127.0.0.1", service.port)
+        try:
+            with pytest.raises(Exception, match="no endpoint"):
+                t.stream(
+                    {"op": "decode", "model_id": "nope", "value": [1.0],
+                     "max_steps": 2},
+                    lambda f: None, timeout_s=30.0,
+                )
+            # the connection is still usable for the next stream
+            final = t.stream(
+                {"op": "decode", "model_id": "dec", "value": [1.0],
+                 "max_steps": 2},
+                lambda f: None, timeout_s=30.0,
+            )
+            assert final["ok"]
+        finally:
+            t.close()
+            service.close()
+            server.close()
+
+    def test_expired_deadline_shed_before_decode(self):
+        server, service = decode_replica()
+        t = TcpTransport("127.0.0.1", service.port)
+        try:
+            with pytest.raises(DeadlineExceeded):
+                t.stream(
+                    {"op": "decode", "model_id": "dec", "value": [1.0],
+                     "max_steps": 2, "deadline_ms": 0},
+                    lambda f: None, timeout_s=30.0,
+                )
+            assert metrics.counter("replica.expired_shed").value == 1
+        finally:
+            t.close()
+            service.close()
+            server.close()
+
+    def test_client_disconnect_over_wire_evicts_slot(self):
+        """A raw client that hangs up mid-stream: the replica's next
+        frame send fails, the slot evicts, and the pool serves the next
+        stream — a gone client never wedges a device slot."""
+        server, service = decode_replica(n_slots=1, step_s=0.005)
+        try:
+            sock = socket.create_connection(
+                ("127.0.0.1", service.port), timeout=10,
+            )
+            wire.send_msg(sock, {
+                "op": "decode", "model_id": "dec", "value": [1.0],
+                "max_steps": 1000, "seq": 1,
+            })
+            kind, frame = wire.recv_any(sock)
+            assert kind == wire.KIND_STREAM and not frame.get("final")
+            sock.close()  # hang up mid-stream
+
+            deadline = time.monotonic() + 15
+            while (metrics.counter("decode.evicted_disconnect").value < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert metrics.counter("decode.evicted_disconnect").value == 1
+
+            # the single slot is free again: a fresh stream completes
+            t = TcpTransport("127.0.0.1", service.port)
+            try:
+                final = t.stream(
+                    {"op": "decode", "model_id": "dec", "value": [3.0],
+                     "max_steps": 3},
+                    lambda f: None, timeout_s=30.0,
+                )
+                assert final["ok"] and final["stream_seq"] == 3
+            finally:
+                t.close()
+            assert metrics.gauge("decode.slots_occupied").value == 0
+        finally:
+            service.close()
+            server.close()
+
+
+# ----------------------------------------------------------------------
+# router: stream placement + stitched spans
+# ----------------------------------------------------------------------
+class TestDecodeRouter:
+    def test_route_stream_end_to_end_with_stitched_spans(self):
+        sink = JsonlTraceSink(capacity=4096)
+        tracer.enable(sink)
+        server, service = decode_replica()
+        router = Router()
+        router.add("r0", "127.0.0.1", service.port, lanes=("tcp",))
+        try:
+            frames = []
+            reply = router.route_stream(
+                [3.0], model_id="dec", on_frame=frames.append,
+                max_steps=4,
+            )
+            assert reply["result"].tolist() == expected_tokens(3.0, 4)
+            assert reply["steps"] == 4
+            assert [float(f["result"]) for f in frames] == (
+                expected_tokens(3.0, 4)
+            )
+            # one stitched trace: router.stream -> replica.serve ->
+            # decode.request, with decode.steps groups alongside
+            roots = sink.find("router.stream")
+            assert len(roots) == 1
+            trace_id = roots[0]["trace_id"]
+            req_spans = []
+            for name in ("replica.serve", "decode.request"):
+                spans = [
+                    s for s in sink.find(name)
+                    if s["trace_id"] == trace_id
+                ]
+                assert spans, f"span {name} missing from stitched trace"
+                req_spans.extend(spans)
+            # the fused-step group spans live on the worker thread and
+            # link back to the per-request spans via member_span_ids
+            req_ids = {s["span_id"] for s in req_spans}
+            linked = [
+                s for s in sink.find("decode.steps")
+                if req_ids & set(
+                    s["attributes"].get("member_span_ids") or ()
+                )
+            ]
+            assert linked, "no decode.steps group references this request"
+        finally:
+            router.close()
+            service.close()
+            server.close()
+
+    def test_stream_retries_only_before_first_token(self):
+        """A dead backend costs a retry, not a failure — but only
+        because no frame was forwarded yet.  All streams land whole."""
+        server, service = decode_replica()
+        router = Router()
+        router.add("dead", "127.0.0.1", 1, lanes=("tcp",))
+        router.add("live", "127.0.0.1", service.port, lanes=("tcp",))
+        try:
+            for i in range(6):
+                reply = router.route_stream(
+                    [float(i)], model_id="dec", max_steps=3,
+                )
+                assert reply["result"].tolist() == (
+                    expected_tokens(float(i), 3)
+                )
+        finally:
+            router.close()
+            service.close()
+            server.close()
+
+    def test_mid_stream_death_is_typed_never_spliced(self):
+        """After the first forwarded frame, a dying backend must NOT be
+        retried elsewhere (two half-streams can't be stitched): the
+        caller gets the connection-shaped error itself."""
+
+        class DiesAfterTwo:
+            lane = "faulty"
+
+            def stream(self, msg, on_frame, timeout_s):
+                on_frame({"result": np.float32(1.0), "stream_seq": 0,
+                          "final": False})
+                on_frame({"result": np.float32(2.0), "stream_seq": 1,
+                          "final": False})
+                raise ConnectionError("replica died mid-stream")
+
+            def request(self, msg, timeout_s):
+                raise ConnectionError("one-shot not wired here")
+
+            def close(self):
+                pass
+
+        router = Router()
+        router.add("dying", "127.0.0.1", 1, transport=DiesAfterTwo())
+        try:
+            got = []
+            with pytest.raises(ConnectionError, match="mid-stream"):
+                router.route_stream(
+                    [1.0], model_id="dec", on_frame=got.append,
+                    max_steps=5,
+                )
+            assert len(got) == 2
+            assert metrics.counter("router.retries").value == 0
+        finally:
+            router.close()
+
+    def test_frontdoor_stream_restamps_client_seq(self):
+        server, service = decode_replica()
+        router = Router()
+        router.add("r0", "127.0.0.1", service.port, lanes=("tcp",))
+        port = router.serve()
+        try:
+            sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+            wire.send_msg(sock, {
+                "op": "decode", "model_id": "dec", "value": [2.0],
+                "max_steps": 3, "seq": 42,
+            })
+            toks, final = [], None
+            while final is None:
+                kind, frame = wire.recv_any(sock)
+                assert kind == wire.KIND_STREAM
+                assert frame["seq"] == 42
+                if frame.get("final"):
+                    final = frame
+                else:
+                    toks.append(float(frame["result"]))
+            assert toks == expected_tokens(2.0, 3)
+            assert final["ok"] and final["stream_seq"] == 3
+            assert "frontdoor" in final["phases"]
+            # one-shot ops still work on the same client connection
+            wire.send_msg(sock, {"op": "ping"})
+            assert wire.recv_msg(sock)["ok"]
+            sock.close()
+        finally:
+            router.close()
+            service.close()
+            server.close()
+
+
+# ----------------------------------------------------------------------
+# kill matrix: SIGKILL mid-decode under mixed traffic
+# ----------------------------------------------------------------------
+DECODE_FACTORY = "sparkdl_tpu.serving.replica:demo_server_decode"
+
+
+class TestDecodeKillMatrix:
+    @pytest.mark.parametrize("lane", ["tcp", "shm"])
+    def test_kill_mid_decode_typed_failure_no_corruption(
+        self, lane, monkeypatch
+    ):
+        """``FaultPlan`` kill at ``decode.step`` takes slot 0 out in
+        the middle of its fused step, with one-shot and streaming
+        traffic interleaved.  Contract under fire:
+
+        - one-shot traffic loses nothing (stranded requests fail over);
+        - every stream that *returned* is byte-correct — tokens are
+          exactly ``s, s+1, ...`` from its prompt sum, never a splice
+          of two replicas;
+        - interrupted streams fail TYPED (connection-shaped/transient),
+          never silently truncated;
+        - the supervisor restarts the slot and a burst of sequential
+          post-recovery streams proves no slot leaked.
+        """
+        from sparkdl_tpu.serving.replica import ReplicaSpec
+        from test_supervisor import fast_supervisor, wait_until
+
+        monkeypatch.setenv("SPARKDL_WIRE_TRANSPORT", lane)
+        monkeypatch.setenv("SPARKDL_DEMO_STEP_MS", "4")
+        sup = fast_supervisor(
+            replicas=2,
+            spec=ReplicaSpec(factory=DECODE_FACTORY),
+            fault_plans={0: [{
+                "site": "decode.step", "kill": True, "at": 60,
+            }]},
+        )
+        oneshot, streams = [], []  # (err, payload)
+        stop = threading.Event()
+        with sup:
+            assert sup.wait_live(2, 120), sup.status()
+            start = time.monotonic()
+
+            def gen_oneshot():
+                x = np.ones(64, np.float32)
+                while not stop.is_set():
+                    err = None
+                    try:
+                        sup.router.route(x, model_id="ep0",
+                                         timeout_s=15.0)
+                    except Exception as exc:  # noqa: BLE001
+                        err = exc
+                    oneshot.append(err)
+
+            def gen_streams():
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    s = float(i % 7)
+                    err = reply = None
+                    try:
+                        reply = sup.router.route_stream(
+                            [s], model_id="dec0", max_steps=10,
+                            timeout_s=20.0,
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        err = exc
+                    streams.append((s, err, reply))
+
+            threads = [
+                threading.Thread(target=gen_oneshot, daemon=True),
+                threading.Thread(target=gen_streams, daemon=True),
+                threading.Thread(target=gen_streams, daemon=True),
+            ]
+            for t in threads:
+                t.start()
+
+            # watch for the planned kill, then the restart
+            saw_kill = wait_until(
+                lambda: sup.status()["live"] < 2, timeout_s=90,
+            )
+            recovered = wait_until(
+                lambda: (
+                    sup.status()["live"] == 2
+                    and next(
+                        r for r in sup.status()["replicas"]
+                        if r["slot"] == 0
+                    )["generation"] >= 2
+                ),
+                timeout_s=90,
+            )
+            time.sleep(1.0)  # traffic on the recovered fleet
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert saw_kill, "planned decode.step kill never happened"
+            assert recovered, f"slot 0 not restarted: {sup.status()}"
+
+            # post-recovery sequential streams: a leaked slot in the
+            # restarted pool (n_slots=8) would wedge this burst
+            for i in range(16):
+                reply = sup.router.route_stream(
+                    [float(i)], model_id="dec0", max_steps=6,
+                    timeout_s=30.0,
+                )
+                assert reply["result"].tolist() == (
+                    expected_tokens(float(i), 6)
+                )
+
+        # one-shot plane: zero accepted loss (retry on the survivor)
+        one_failures = [e for e in oneshot if e is not None]
+        assert not one_failures, (
+            f"one-shot requests lost: "
+            f"{[type(e).__name__ for e in one_failures[:5]]}"
+        )
+
+        # stream plane: completed == byte-correct, failed == typed
+        assert len(streams) > 20, "not enough stream traffic"
+        completed = [(s, r) for s, e, r in streams if e is None]
+        failed = [e for _, e, _ in streams if e is not None]
+        assert completed, "no stream ever completed"
+        for s, reply in completed:
+            assert reply["result"].tolist() == expected_tokens(s, 10), (
+                f"accepted stream corrupted for prompt sum {s}"
+            )
+        for exc in failed:
+            assert (
+                isinstance(exc, (ConnectionError, OSError, socket.timeout))
+                or is_transient(exc)
+            ), f"mid-kill stream failed untyped: {type(exc).__name__}: {exc}"
+
+        # shm hygiene: a SIGKILLed replica must not leak segments
+        from sparkdl_tpu.serving import transport as transport_mod
+
+        assert transport_mod.active_segments() == []

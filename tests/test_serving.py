@@ -491,6 +491,50 @@ class TestConstructors:
 # must wake with a TYPED error when the server closes or the breaker
 # opens underneath it — never hang on a queue nobody will drain again
 # ----------------------------------------------------------------------
+class TestIdleDeviceFlush:
+    """ISSUE-18 regression: the coalesce linger must not hold a batch
+    while the device sits idle.  Both tests run on an injectable FROZEN
+    clock, so the linger's remaining-time computation never counts down
+    — without the early flush they would hang, not just run slow."""
+
+    def test_take_flush_early_cuts_linger_on_frozen_clock(self):
+        from sparkdl_tpu.serving.admission import AdmissionQueue, Request
+
+        q = AdmissionQueue(8, clock=lambda: 1000.0)
+        q.offer(Request(value=np.zeros(4, np.float32),
+                        enqueued_at=1000.0))
+        t0 = time.monotonic()
+        batch = q.take(
+            max_n=8, max_wait_s=3600.0, flush_early=lambda: True,
+        )
+        assert len(batch) == 1
+        assert time.monotonic() - t0 < 5.0
+        assert metrics.counter("batcher.flush_early").value == 1
+
+    def test_lone_request_resolves_without_serving_full_linger(self):
+        """A single submission against an idle endpoint must dispatch
+        immediately even with an (effectively infinite) coalesce
+        window — the dispatch window is free, so waiting buys nothing."""
+        from sparkdl_tpu.serving.batcher import MicroBatcher
+        from sparkdl_tpu.serving.cache import ProgramCache
+
+        batcher = MicroBatcher(
+            "flush",
+            lambda x: x * 2.0,
+            ServingConfig(max_batch=16, max_wait_ms=3_600_000.0),
+            ProgramCache(4),
+            item_shape=(4,),
+            compile=False,
+            clock=lambda: 1000.0,
+        )
+        try:
+            fut = batcher.submit(np.full((4,), 3.0, np.float32))
+            np.testing.assert_allclose(fut.result(timeout=10.0), 6.0)
+            assert metrics.counter("batcher.flush_early").value >= 1
+        finally:
+            batcher.close()
+
+
 class TestOfferWaitRaces:
     def _full_queue(self, capacity=1):
         from sparkdl_tpu.serving.admission import AdmissionQueue, Request

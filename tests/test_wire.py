@@ -138,6 +138,14 @@ class TestEnvelopeSchema:
             "deadline_ms": 12.5, "tenant": "team-a",
             "trace": (12345, 67890), "seq": 7,
         },
+        "decode_request": {
+            "op": "decode", "model_id": "dec0", "value": None,
+            "max_steps": 16, "seq": 9,
+        },
+        "stream": {
+            "ok": True, "result": None, "stream_seq": 3,
+            "final": False, "seq": 9, "steps": 4,
+        },
         "shm_handshake": {
             "op": "shm_attach", "shm": "psm_fixture",
             "ring_bytes": 1 << 20,
@@ -181,6 +189,77 @@ class TestEnvelopeSchema:
             "ENVELOPE_FIELDS and the roundtrip fixtures disagree: "
             f"unfixtured={sorted(set(wire.ENVELOPE_FIELDS) - covered)}, "
             f"undeclared={sorted(covered - set(wire.ENVELOPE_FIELDS))}"
+        )
+
+
+# ----------------------------------------------------------------------
+# KIND_STREAM frames (ISSUE-18): incremental decode replies ride the
+# same framing — CRC trailer, seq echo, torn-frame typing — with a
+# gap-free stream_seq and exactly one final frame per stream
+# ----------------------------------------------------------------------
+class TestStreamFrames:
+    def test_stream_roundtrip_over_socket(self):
+        a, b = socket.socketpair()
+        try:
+            for i in range(3):
+                wire.send_stream(a, {
+                    "ok": True, "stream_seq": i, "final": False,
+                    "result": np.full(4, i, np.float32), "seq": 7,
+                })
+            wire.send_stream(
+                a, {"ok": True, "stream_seq": 3, "final": True, "seq": 7}
+            )
+            for i in range(3):
+                kind, got = wire.recv_any(b)
+                assert kind == wire.KIND_STREAM
+                assert got["stream_seq"] == i and got["final"] is False
+                assert got["seq"] == 7
+                np.testing.assert_array_equal(
+                    got["result"], np.full(4, i, np.float32)
+                )
+            kind, got = wire.recv_any(b)
+            assert kind == wire.KIND_STREAM
+            assert got["final"] is True and got["stream_seq"] == 3
+        finally:
+            a.close()
+            b.close()
+
+    def test_stream_frame_on_message_channel_is_refused(self):
+        # recv_msg is the one-shot API; a stream fragment there means
+        # the caller lost track of a stream — refuse, don't misfile
+        a, b = socket.socketpair()
+        try:
+            wire.send_stream(a, {"ok": True, "stream_seq": 0,
+                                 "final": True})
+            with pytest.raises(ConnectionError):
+                wire.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_stream_frames_carry_and_verify_crc(self):
+        raw = frame_bytes(
+            {"ok": True, "stream_seq": 1, "final": False,
+             "result": np.arange(32, dtype=np.float32)},
+            kind=wire.KIND_STREAM,
+        )
+        _, flags, _, _ = wire._parse_prefix(bytes(raw[:PREFIX.size]))
+        assert flags & wire.FLAG_CRC
+        raw[len(raw) - wire._CRC.size - 5] ^= 0x20
+        before = metrics.counter("wire.crc_fail").value
+        with pytest.raises(wire.FrameCorrupt):
+            wire.decode_frame(raw)
+        assert metrics.counter("wire.crc_fail").value == before + 1
+
+    def test_stream_kind_decodes_from_memory(self):
+        kind, got = wire.decode_frame(frame_bytes(
+            {"ok": True, "stream_seq": 0, "final": True,
+             "result": np.ones(4, np.float32)},
+            kind=wire.KIND_STREAM,
+        ))
+        assert kind == wire.KIND_STREAM
+        np.testing.assert_array_equal(
+            got["result"], np.ones(4, np.float32)
         )
 
 
