@@ -37,6 +37,7 @@ FEATURES = 64
 HIDDEN = 256
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
 #: the crash-recovery trial runs in a subprocess (the fault plan kills
 #: with os._exit); it commits a few epochs, then dies at a commit marker
@@ -110,6 +111,221 @@ def _measure_recovery(max_batch: int) -> dict:
     }
 
 
+#: continuous-SQL recovery worker: a standing windowed query over a
+#: file-tailed stream; under a fault plan it dies at the
+#: streaming.window_commit site (between window-results payload and
+#: marker), and a restart must replay — never re-aggregate
+_CSQL_WORKER = """
+import json, os, sys
+os.environ.setdefault("KERAS_BACKEND", "jax")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from sparkdl_tpu.sql import TPUSession
+from sparkdl_tpu.streaming import FileTailSource, JsonlSink, StreamConfig
+workdir = {workdir!r}
+session = TPUSession.builder.getOrCreate()
+session.readStream("scores", FileTailSource(
+    os.path.join(workdir, "in.jsonl"), event_time_field="ts"))
+query = session.sqlStream(
+    "SELECT endpoint, p95(latency) AS p95_ms, count(*) AS n "
+    "FROM scores GROUP BY WINDOW(ts, '2s'), endpoint",
+    JsonlSink(os.path.join(workdir, "out.jsonl")),
+    os.path.join(workdir, "log"),
+    config=StreamConfig(max_batch={max_batch}, max_wait_ms=5.0,
+                        poll_batch={max_batch}, poll_interval_ms=2.0),
+)
+summary = query.run(idle_timeout_s=1.0)
+print("SUMMARY " + json.dumps(summary))
+"""
+
+
+def _sql_emitted_windows(workdir: str) -> list:
+    """The committed window set, epoch numbering stripped (epochs
+    differ across a restart; window content may not)."""
+    out = []
+    path = os.path.join(workdir, "out.jsonl")
+    if os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                if not line.endswith("\n"):
+                    continue
+                row = json.loads(line)
+                row.pop("epoch", None)
+                out.append(row)
+    out.sort(key=lambda r: (r["window_start"], r["endpoint"]))
+    return out
+
+
+def _measure_sql_recovery(max_batch: int) -> dict:
+    """Kill a continuous query between its window-results payload and
+    the commit marker, restart, and check the emitted-window set is
+    byte-identical to an uninterrupted reference run."""
+
+    def write_source(workdir: str) -> None:
+        os.makedirs(workdir, exist_ok=True)
+        with open(os.path.join(workdir, "in.jsonl"), "w") as fh:
+            for i in range(16 * max_batch):
+                fh.write(json.dumps({
+                    "endpoint": "a" if i % 2 else "b",
+                    "latency": float(i % 97),
+                    "ts": i * 25.0,
+                }) + "\n")
+
+    def run(workdir: str, fault_plan=None) -> "subprocess.CompletedProcess":
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("SPARKDL_FAULT_PLAN", None)
+        if fault_plan is not None:
+            env["SPARKDL_FAULT_PLAN"] = json.dumps(fault_plan)
+        return subprocess.run(
+            [sys.executable, "-c",
+             _CSQL_WORKER.format(repo=_REPO, workdir=workdir,
+                                 max_batch=max_batch)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=180,
+        )
+
+    refdir = tempfile.mkdtemp(prefix="bench-csql-ref-")
+    write_source(refdir)
+    ref = run(refdir)
+    workdir = tempfile.mkdtemp(prefix="bench-csql-kill-")
+    write_source(workdir)
+    killed = run(workdir, fault_plan=[
+        {"site": "streaming.window_commit", "kill": True, "at": 4}
+    ])
+    t0 = time.perf_counter()
+    restarted = run(workdir)
+    recovery_s = time.perf_counter() - t0
+    reference = _sql_emitted_windows(refdir)
+    recovered = _sql_emitted_windows(workdir)
+    return {
+        "crash_rc": killed.returncode,
+        "restart_rc": restarted.returncode,
+        "reference_rc": ref.returncode,
+        "windows_emitted": len(recovered),
+        "byte_identical": bool(
+            reference
+            and json.dumps(recovered, sort_keys=True)
+            == json.dumps(reference, sort_keys=True)
+        ),
+        "restart_wall_s": round(recovery_s, 3),
+    }
+
+
+def _run_sql(args) -> dict:
+    """The --sql mode: a standing windowed query (tumbling 500ms,
+    p95+count per endpoint) over a fixed-rate generator, measuring the
+    sustained committed-row rate and the watermark-close-to-emit
+    latency — then a kill/restart byte-identity trial."""
+    from sparkdl_tpu.sql import TPUSession
+    from sparkdl_tpu.streaming import JsonlSink, QueueSource, StreamConfig
+    from sparkdl_tpu.utils.metrics import metrics
+
+    metrics.reset()
+    session = TPUSession.builder.getOrCreate()
+    source = QueueSource()
+    session.readStream("bench_scores", source)
+    outdir = tempfile.mkdtemp(prefix="bench-csql-")
+    sink = JsonlSink(os.path.join(outdir, "out.jsonl"))
+    late_sink = JsonlSink(os.path.join(outdir, "late.jsonl"))
+    query = session.sqlStream(
+        "SELECT endpoint, p95(latency) AS p95_ms, count(*) AS n "
+        "FROM bench_scores GROUP BY WINDOW(ts, '500ms'), endpoint",
+        sink, os.path.join(outdir, "log"), late_sink=late_sink,
+        config=StreamConfig(
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            poll_batch=args.max_batch, poll_interval_ms=1.0,
+        ),
+    )
+
+    rng = np.random.RandomState(0)
+    stop = threading.Event()
+    produced = [0]
+
+    def generate():
+        # event time advances with the offered rate so windows close
+        # continuously during the run (1000/rate ms per record)
+        t0 = time.perf_counter()
+        while not stop.is_set():
+            target = int((time.perf_counter() - t0) * args.rate)
+            while produced[0] < target:
+                i = produced[0]
+                source.put({
+                    "endpoint": "a" if i % 2 else "b",
+                    "latency": float(rng.randint(0, 250)),
+                    "ts": i * (1000.0 / args.rate),
+                })
+                produced[0] += 1
+            stop.wait(0.002)
+        source.end()
+
+    gen = threading.Thread(target=generate, name="bench-csql-generator")
+    gen.start()
+    timer = threading.Timer(args.seconds, stop.set)
+    timer.start()
+    t0 = time.perf_counter()
+    summary = query.run()  # returns when the generator ends the source
+    elapsed = time.perf_counter() - t0
+    gen.join()
+    timer.cancel()
+    query.close()
+
+    snap = metrics.snapshot(prefix="csql.")
+    emitted = sink.read_all()
+    # exactly-once invariant of the in-process run: every closed window
+    # emitted exactly once (no (window, key) pair twice)
+    seen = [(r["window_start"], r["window_end"], r["endpoint"])
+            for r in emitted]
+    if len(seen) != len(set(seen)):
+        print("SQL SMOKE FAILED: duplicate emitted window", file=sys.stderr)
+        raise SystemExit(1)
+    if len(seen) != summary["windows_emitted"]:
+        print("SQL SMOKE FAILED: sink rows != windows_emitted",
+              file=sys.stderr)
+        raise SystemExit(1)
+
+    recovery = None if args.skip_recovery else _measure_sql_recovery(
+        args.max_batch
+    )
+    if recovery is not None and not recovery["byte_identical"]:
+        print("SQL SMOKE FAILED: killed-and-restarted run's emitted "
+              "windows diverged from the uninterrupted reference",
+              file=sys.stderr)
+        raise SystemExit(1)
+
+    rows_committed = int(summary["committed_offset"] or 0)
+    return {
+        "benchmark": "bench_streaming",
+        "sql": True,
+        "scenario": "continuous_sql",
+        "metric": "continuous-SQL sustained commit rate "
+        f"(offered {args.rate:.0f} rec/s)",
+        "value": round(rows_committed / elapsed, 1),
+        "rows_per_s": round(rows_committed / elapsed, 1),
+        "unit": "records/sec",
+        "rows_committed": rows_committed,
+        "rows_offered": produced[0],
+        "epochs": summary["epochs"],
+        "windows_emitted": summary["windows_emitted"],
+        "open_windows": summary["open_windows"],
+        "late_rows": summary["late_rows"],
+        "p50_emit_latency_ms": round(
+            snap.get("csql.emit_latency_ms.p50", 0.0), 3
+        ),
+        "p99_emit_latency_ms": round(
+            snap.get("csql.emit_latency_ms.p99", 0.0), 3
+        ),
+        "recovery": recovery,
+        "seconds": args.seconds,
+        "duration_s": args.seconds,
+        "target_rps": args.rate,
+        "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms,
+        "vs_baseline": None,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=3.0,
@@ -120,10 +336,26 @@ def main():
     ap.add_argument("--max-wait-ms", type=float, default=5.0)
     ap.add_argument("--skip-recovery", action="store_true",
                     help="skip the subprocess crash-recovery trial")
+    ap.add_argument("--sql", action="store_true",
+                    help="benchmark a continuous SQL query (windowed "
+                    "p95/count per endpoint) instead of the raw "
+                    "StreamRunner path; asserts exactly-once invariants "
+                    "and exits non-zero on violation")
+    ap.add_argument("--out", default=None, metavar="REPORT.json",
+                    help="also write the JSON report to this path "
+                    "(what ci.perf_gate --fresh gates)")
     ap.add_argument("--trace-out", default=None, metavar="PATH",
                     help="append a JSONL span trace of the measured run "
                     "to PATH (obs subsystem)")
     args = ap.parse_args()
+
+    if args.sql:
+        report = _run_sql(args)
+        print(json.dumps(report))
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(report, fh)
+        return
 
     import jax
     import jax.numpy as jnp
@@ -216,34 +448,34 @@ def main():
     recovery = None if args.skip_recovery else _measure_recovery(
         args.max_batch
     )
-    print(
-        json.dumps(
-            {
-                "metric": "streaming sustained commit rate "
-                f"(offered {args.rate:.0f} rec/s)",
-                "value": round(committed[0] / elapsed, 1),
-                "unit": "records/sec",
-                "records_committed": committed[0],
-                "records_offered": produced[0],
-                "epochs": summary["epochs"],
-                "p50_record_latency_ms": round(
-                    snap.get("streaming.record_latency_ms.p50", 0.0), 3
-                ),
-                "p99_record_latency_ms": round(
-                    snap.get("streaming.record_latency_ms.p99", 0.0), 3
-                ),
-                "final_watermark_lag_ms": round(
-                    snap.get("streaming.watermark_lag_ms", 0.0), 1
-                ),
-                "lag_over_time": lag_samples,
-                "recovery": recovery,
-                "seconds": args.seconds,
-                "max_batch": args.max_batch,
-                "max_wait_ms": args.max_wait_ms,
-                "vs_baseline": None,
-            }
-        )
-    )
+    report = {
+        "metric": "streaming sustained commit rate "
+        f"(offered {args.rate:.0f} rec/s)",
+        "value": round(committed[0] / elapsed, 1),
+        "unit": "records/sec",
+        "records_committed": committed[0],
+        "records_offered": produced[0],
+        "epochs": summary["epochs"],
+        "p50_record_latency_ms": round(
+            snap.get("streaming.record_latency_ms.p50", 0.0), 3
+        ),
+        "p99_record_latency_ms": round(
+            snap.get("streaming.record_latency_ms.p99", 0.0), 3
+        ),
+        "final_watermark_lag_ms": round(
+            snap.get("streaming.watermark_lag_ms", 0.0), 1
+        ),
+        "lag_over_time": lag_samples,
+        "recovery": recovery,
+        "seconds": args.seconds,
+        "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms,
+        "vs_baseline": None,
+    }
+    print(json.dumps(report))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(report, fh)
 
 
 if __name__ == "__main__":

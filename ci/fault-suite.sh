@@ -24,9 +24,12 @@ export SPARKDL_BLACKBOX_DIR="$BLACKBOX_DIR"
 
 # test_streaming.py is the streaming fault scenario: FaultPlan kills at
 # streaming.poll / streaming.sink / streaming.commit, restart, and the
-# sink record set must equal the source record set (exactly-once)
+# sink record set must equal the source record set (exactly-once);
+# test_continuous_sql.py is the windowed-query analog: kills at
+# streaming.window_commit / csql.plan, restart, and the emitted-window
+# set must be byte-identical to an uninterrupted reference run
 if ! python -m pytest tests/test_resilience.py tests/test_fault_injection.py \
-  tests/test_streaming.py \
+  tests/test_streaming.py tests/test_continuous_sql.py \
   -q -m 'not slow' -p no:cacheprovider; then
   echo "--- captured span trace (last 50 spans, $TRACE_OUT) ---" >&2
   tail -n 50 "$TRACE_OUT" >&2 || true
@@ -132,6 +135,32 @@ if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
   echo "barrier on the slowest sequence, a missing stitched decode" >&2
   echo "trace, or >60s wall — see above" >&2
   print_fleet_snapshot
+  exit 1
+fi
+
+# continuous-query smoke (<60 s, ISSUE-19): a standing windowed SQL
+# query (p95+count per endpoint, tumbling event-time windows) over a
+# fixed-rate stream, with the kill-matrix trial inside: a subprocess
+# run is SIGKILLed at the streaming.window_commit site (between the
+# window-results payload and its commit marker), restarted, and the
+# harness asserts the emitted-window set is byte-identical to an
+# uninterrupted reference run — no duplicated, lost, or re-aggregated
+# window.  The run exits non-zero on any violated invariant; its
+# report is then gated against the committed BENCH_STREAM_*.json
+# baseline (rows/s + window emit latency).
+CSQL_OUT="$(mktemp -t fault-suite-csql.XXXXXX.json)"
+trap 'rm -rf "$TRACE_OUT" "$BLACKBOX_DIR" "$SMOKE_LOG" "$CSQL_OUT"' EXIT
+if ! timeout -k 10 60 python benchmarks/bench_streaming.py --sql \
+    --seconds 2 --rate 3000 --out "$CSQL_OUT" 2>&1 | tee "$SMOKE_LOG"; then
+  echo "continuous-query smoke FAILED: duplicate/lost window, a" >&2
+  echo "killed-and-restarted run diverged from the uninterrupted" >&2
+  echo "reference, or >60s wall — see above" >&2
+  exit 1
+fi
+if ! python -m ci.perf_gate --fresh "$CSQL_OUT"; then
+  echo "perf gate FAILED on the continuous-query smoke: rows/s or" >&2
+  echo "window emit latency regressed past the committed" >&2
+  echo "BENCH_STREAM baseline" >&2
   exit 1
 fi
 

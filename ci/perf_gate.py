@@ -69,9 +69,18 @@ TOLERANCES: Tuple[Tuple[str, str, float, float], ...] = (
     ("latency_ms.p99", "max", 0.75, 4.0),
     ("router_overhead_ms.p50", "max", 1.00, 2.0),
     ("faultnet.retry_amplification", "max", 0.00, 0.5),
+    # continuous-SQL streaming reports (bench_streaming --sql,
+    # BENCH_STREAM_*.json) — absent from bench_load reports, so these
+    # rows never cross-gate the load trajectory
+    ("rows_per_s", "min", 0.25, 0.0),
+    ("p50_emit_latency_ms", "max", 0.75, 2.0),
+    ("p99_emit_latency_ms", "max", 1.00, 5.0),
 )
 
-BENCH_GLOB = "BENCH_LOAD_*.json"
+#: one trajectory per committed-report family: the replica-fleet load
+#: smokes and the streaming/continuous-SQL rate reports
+BENCH_GLOBS = ("BENCH_LOAD_*.json", "BENCH_STREAM_*.json")
+BENCH_GLOB = BENCH_GLOBS[0]  # kept for older callers/docs
 DEFAULT_WAIVERS = os.path.join("ci", "perf_waivers.json")
 
 
@@ -85,11 +94,15 @@ def _get_path(obj: Any, dotted: str) -> Optional[float]:
 
 
 def _is_report(obj: Any) -> bool:
-    return (
-        isinstance(obj, dict)
-        and obj.get("benchmark") == "bench_load"
-        and isinstance(obj.get("latency_ms"), dict)
-    )
+    if not isinstance(obj, dict):
+        return False
+    if obj.get("benchmark") == "bench_load":
+        return isinstance(obj.get("latency_ms"), dict)
+    # bench_streaming --sql reports (BENCH_STREAM_*.json): gated on
+    # sustained committed-row rate and window emit latency
+    if obj.get("benchmark") == "bench_streaming":
+        return isinstance(obj.get("rows_per_s"), (int, float))
+    return False
 
 
 def shape_key(report: Dict[str, Any]) -> Tuple:
@@ -104,13 +117,17 @@ def shape_key(report: Dict[str, Any]) -> Tuple:
     live ``BENCH_LOAD_r*.json`` numbers in either direction.  A
     ``--decode-mix`` run (``"decode": true``) interleaves streaming
     decodes with the one-shot load — its walls are token-count-shaped,
-    so it only ever gates other decode-mix runs."""
+    so it only ever gates other decode-mix runs.  A continuous-SQL run
+    (``"sql": true`` — bench_streaming's standing windowed query)
+    measures the window-close-and-commit path, not raw runner
+    throughput, so it only gates other sql runs."""
     return tuple(report.get(f) for f in SHAPE_FIELDS) + (
         bool(report.get("obs") or report.get("trace")),
         bool(report.get("result_cache")),
         report.get("zipf_s"),
         bool(report.get("sim")),
         bool(report.get("decode")),
+        bool(report.get("sql")),
     )
 
 
@@ -141,9 +158,15 @@ def committed_reports(
     repo_root: str,
 ) -> List[Tuple[str, Dict[str, Any]]]:
     rows: List[Tuple[str, Dict[str, Any]]] = []
-    for path in sorted(
-        glob.glob(os.path.join(repo_root, BENCH_GLOB)), key=_order,
-    ):
+    paths = sorted(
+        {
+            p
+            for pattern in BENCH_GLOBS
+            for p in glob.glob(os.path.join(repo_root, pattern))
+        },
+        key=_order,
+    )
+    for path in paths:
         try:
             with open(path) as fh:
                 payload = json.load(fh)

@@ -41,11 +41,14 @@ from ci.sparkdl_check.core import FileContext, Rule, rule
 #: counters of the continuous-batching decode plane) and "batcher"
 #: (one-shot coalescing internals: pad fraction, early-flush count)
 #: joined with the ISSUE-18 token-streaming decode plane.
+#: "csql" (open windows, rows/s, late-row counter, watermark-to-emit
+#: latency with exemplars) joined with the ISSUE-19 continuous-SQL
+#: plane.
 ALLOWED_PREFIXES = (
     "sparkdl", "data", "serving", "resilience", "estimator", "engine",
     "streaming", "slo", "ts", "supervisor", "router", "wire",
     "rollout", "tenant", "fleet", "replica", "faultnet", "diag",
-    "profile", "cache", "decode", "batcher",
+    "profile", "cache", "decode", "batcher", "csql",
 )
 
 METRIC_FACTORIES = {"counter", "timer", "gauge", "histogram"}

@@ -52,7 +52,12 @@ KNOWN_SITES = {
     "data": ("data.map", "data.source"),
     "serving": ("serving.forward",),
     "streaming": (
+        # streaming.window_commit fires in a continuous query between
+        # the window-results sink write and the commit marker — a kill
+        # there is the window-state exactly-once case (replay must
+        # re-emit the closed windows from the payload, not re-aggregate)
         "streaming.poll", "streaming.sink", "streaming.commit",
+        "streaming.window_commit",
     ),
     "estimator": (
         "estimator.step", "estimator.epoch", "estimator.checkpoint_saved",
@@ -95,6 +100,12 @@ KNOWN_SITES = {
     # each emitted stream frame (exercises a stream torn between
     # tokens).
     "decode": ("decode.step", "decode.stream"),
+    # continuous SQL (ISSUE-19): ``csql.plan`` fires as a standing
+    # query's text is parsed into its ContinuousPlan — a kill there
+    # proves a query that dies at plan time leaves no partial state
+    # (no catalog claim, no checkpoint files), and an error rule
+    # exercises the construct-time failure path.
+    "csql": ("csql.plan",),
 }
 
 

@@ -13,5 +13,26 @@ from sparkdl_tpu.sql.types import Row
 from sparkdl_tpu.sql.dataframe import DataFrame
 from sparkdl_tpu.sql.session import TPUSession
 from sparkdl_tpu.sql.functions import col, lit, udf
+from sparkdl_tpu.sql.continuous import (
+    ContinuousPlan,
+    ContinuousQuery,
+    ContinuousQueryError,
+    StreamTable,
+    StreamTableError,
+)
+from sparkdl_tpu.sql.window_state import WindowStateStore
 
-__all__ = ["Row", "DataFrame", "TPUSession", "col", "lit", "udf"]
+__all__ = [
+    "Row",
+    "DataFrame",
+    "TPUSession",
+    "col",
+    "lit",
+    "udf",
+    "ContinuousPlan",
+    "ContinuousQuery",
+    "ContinuousQueryError",
+    "StreamTable",
+    "StreamTableError",
+    "WindowStateStore",
+]

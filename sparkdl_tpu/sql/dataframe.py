@@ -10,6 +10,7 @@ exchange with the native bridge.
 
 from __future__ import annotations
 
+import math
 import random as _random
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
@@ -1762,6 +1763,37 @@ _AGG_SPECS["last_value"] = _AGG_SPECS["last"]
 _AGG_SPECS["mean"] = _AGG_SPECS["avg"]
 
 
+def _make_percentile_spec(p: float) -> _AggSpec:
+    """Exact linear-interpolation percentile (numpy's default method)
+    over the group's non-null values — the bounded-plane twin of
+    ``sql.window_state.WINDOW_AGG_SPECS`` p50/p90/p95/p99, pinned
+    against it by tests/test_continuous_sql.py."""
+
+    def final(acc):
+        if not acc:
+            return None
+        vals = sorted(acc)
+        rank = (len(vals) - 1) * (p / 100.0)
+        lo = math.floor(rank)
+        hi = math.ceil(rank)
+        if lo == hi:
+            return float(vals[int(rank)])
+        return float(vals[lo] + (vals[hi] - vals[lo]) * (rank - lo))
+
+    return _AggSpec(
+        lambda: [],
+        lambda a, v: (a.append(float(v)), a)[1],
+        lambda a, b: a + b,
+        final,
+    )
+
+
+_AGG_SPECS["p50"] = _make_percentile_spec(50.0)
+_AGG_SPECS["p90"] = _make_percentile_spec(90.0)
+_AGG_SPECS["p95"] = _make_percentile_spec(95.0)
+_AGG_SPECS["p99"] = _make_percentile_spec(99.0)
+
+
 def _agg_result_type(fn_key: str, src: "Optional[DataType]") -> DataType:
     """Declared output type of aggregate ``fn_key`` over a column of
     declared type ``src`` (None for ``COUNT(*)``) — ONE mapping shared
@@ -1779,7 +1811,8 @@ def _agg_result_type(fn_key: str, src: "Optional[DataType]") -> DataType:
     if fn_key in ("count", "count_distinct"):
         return LongType()
     if fn_key in ("avg", "mean", "stddev", "stddev_samp", "stddev_pop",
-                  "variance", "var_samp", "var_pop"):
+                  "variance", "var_samp", "var_pop",
+                  "p50", "p90", "p95", "p99"):
         return DoubleType()
     if fn_key == "sum":
         # Spark widens: integral sums to long, fractional to double

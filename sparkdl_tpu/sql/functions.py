@@ -496,6 +496,24 @@ def var_pop(col_or_name) -> Column:
     return _agg_column("var_pop", col_or_name)
 
 
+def p50(col_or_name) -> Column:
+    """Exact interpolated median — the latency-SLO shape shared with
+    continuous windowed queries (``sql.window_state``)."""
+    return _agg_column("p50", col_or_name)
+
+
+def p90(col_or_name) -> Column:
+    return _agg_column("p90", col_or_name)
+
+
+def p95(col_or_name) -> Column:
+    return _agg_column("p95", col_or_name)
+
+
+def p99(col_or_name) -> Column:
+    return _agg_column("p99", col_or_name)
+
+
 def collect_list(col_or_name) -> Column:
     return _agg_column("collect_list", col_or_name)
 

@@ -9,7 +9,16 @@ registration surface — SURVEY.md §2 "TensorFrames UDF maker" /
 from __future__ import annotations
 
 import re
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+)
 
 import numpy as np
 
@@ -20,15 +29,94 @@ from sparkdl_tpu.sql.types import Row, StructType, infer_type
 DEFAULT_PARTITIONS = 4
 
 
+class CatalogTable(NamedTuple):
+    """One ``listTables`` entry: ``tableType`` is ``"TEMPORARY"`` for a
+    bounded temp view, ``"STREAM"`` for a registered stream table (the
+    PySpark ``Catalog.listTables`` shape, minus the database levels)."""
+
+    name: str
+    tableType: str
+
+
 class Catalog:
     def __init__(self):
         self._views: Dict[str, DataFrame] = {}
+        #: name -> sql.continuous.StreamTable (unbounded; not a view)
+        self._streams: Dict[str, Any] = {}
 
     def listTables(self):
-        return sorted(self._views)
+        return sorted(
+            [CatalogTable(n, "TEMPORARY") for n in self._views]
+            + [CatalogTable(n, "STREAM") for n in self._streams]
+        )
 
     def dropTempView(self, name: str):
+        """Drop a bounded temp view.  A *stream* table is not a temp
+        view — dropping one here raises typed errors instead of
+        silently unregistering an unbounded source (use
+        :meth:`dropStreamTable`)."""
+        if name in self._streams:
+            from sparkdl_tpu.sql.continuous import StreamTableError
+
+            raise StreamTableError(
+                f"{name!r} is a stream table, not a temp view; use "
+                "dropStreamTable()"
+            )
         self._views.pop(name, None)
+
+    # -- stream tables (sql.continuous) --------------------------------
+    def registerStreamTable(self, name: str, source) -> Any:
+        """Register ``source`` (a :class:`StreamSource`) as stream table
+        ``name``.  The name must not collide with a temp view — a query
+        binding it must never be ambiguous about boundedness."""
+        from sparkdl_tpu.sql.continuous import StreamTable, StreamTableError
+
+        if name in self._views:
+            raise StreamTableError(
+                f"{name!r} is already a bounded temp view; a stream "
+                "table cannot shadow it"
+            )
+        existing = self._streams.get(name)
+        if existing is not None and existing.active_query is not None:
+            raise StreamTableError(
+                f"stream table {name!r} is being read by running query "
+                f"{existing.active_query!r}; stop it before re-registering"
+            )
+        table = StreamTable(name, source)
+        self._streams[name] = table
+        return table
+
+    def streamTable(self, name: str):
+        """The registered :class:`StreamTable`, with typed errors that
+        name what the caller actually hit (temp view vs nothing)."""
+        from sparkdl_tpu.sql.continuous import StreamTableError
+
+        table = self._streams.get(name)
+        if table is None:
+            if name in self._views:
+                raise StreamTableError(
+                    f"{name!r} is a bounded temp view, not a stream "
+                    "table; continuous queries need "
+                    "session.readStream(...)"
+                )
+            raise StreamTableError(f"Stream table not found: {name!r}")
+        return table
+
+    def dropStreamTable(self, name: str):
+        """Unregister a stream table; refuses while a continuous query
+        is reading it (the error names the running query)."""
+        from sparkdl_tpu.sql.continuous import StreamTableError
+
+        table = self._streams.get(name)
+        if table is None:
+            return
+        if table.active_query is not None:
+            raise StreamTableError(
+                f"cannot drop stream table {name!r}: continuous query "
+                f"{table.active_query!r} is reading it; close the query "
+                "first"
+            )
+        del self._streams[name]
 
 
 class UDFRegistry:
@@ -229,7 +317,48 @@ class TPUSession:
         try:
             return self.catalog._views[name]
         except KeyError:
+            if name in self.catalog._streams:
+                from sparkdl_tpu.sql.continuous import StreamTableError
+
+                raise StreamTableError(
+                    f"{name!r} is a stream table; it has no bounded "
+                    "DataFrame — run a continuous query over it with "
+                    "session.sqlStream(...)"
+                ) from None
             raise KeyError(f"Table or view not found: {name!r}") from None
+
+    # ------------------------------------------------------------------
+    # continuous queries (sql.continuous)
+    # ------------------------------------------------------------------
+    def readStream(self, name: str, source):
+        """Register ``source`` (a
+        :class:`~sparkdl_tpu.streaming.sources.StreamSource`) as stream
+        table ``name`` so continuous queries can bind it by name.
+        Returns the catalog's :class:`StreamTable` entry."""
+        return self.catalog.registerStreamTable(name, source)
+
+    def sqlStream(
+        self,
+        query: str,
+        sink,
+        checkpoint_dir: str,
+        late_sink=None,
+        server=None,
+        config=None,
+        name: Optional[str] = None,
+    ):
+        """A standing windowed query over a registered stream table —
+        ``SELECT key, p95(latency) FROM scores GROUP BY
+        WINDOW(event_time_ms, '10s'), key`` — as a
+        :class:`~sparkdl_tpu.sql.continuous.ContinuousQuery` (call
+        ``.run(...)`` to drive it; exactly-once emission into ``sink``
+        via ``checkpoint_dir``'s commit log)."""
+        from sparkdl_tpu.sql.continuous import ContinuousQuery
+
+        return ContinuousQuery(
+            self, query, sink, checkpoint_dir,
+            late_sink=late_sink, server=server, config=config, name=name,
+        )
 
     def range(self, start: int, end: Optional[int] = None, step: int = 1):
         if end is None:
@@ -298,6 +427,7 @@ class TPUSession:
         r"count|sum|avg|mean|min|max|stddev_samp|stddev_pop|stddev"
         r"|var_samp|var_pop|variance|collect_list|collect_set"
         r"|first_value|first|last_value|last"
+        r"|p50|p90|p95|p99"
     )
     _AGG_RE = re.compile(
         rf"^(?P<fn>{_AGG_FN_ALT})\s*\(\s*"

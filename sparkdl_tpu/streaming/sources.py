@@ -24,6 +24,15 @@ import os
 import threading
 from typing import Any, List, NamedTuple, Optional
 
+from sparkdl_tpu.resilience.errors import PermanentError
+
+
+class EventTimeError(PermanentError):
+    """A source configured with ``event_time_field`` met a row where
+    that field is absent or non-numeric.  Permanent by nature (the bytes
+    on disk do not heal on retry), and typed so a continuous query's
+    operator can distinguish "bad event time" from "corrupt line"."""
+
 
 class Record(NamedTuple):
     """One streamed row: the decoded ``value``, the source's resume
@@ -153,7 +162,11 @@ class FileTailSource(StreamSource):
     of raising (the tail-before-first-write race).
 
     ``parse="json"`` decodes each line to its JSON value and reads the
-    event time from ``event_time_field`` (epoch ms) when configured;
+    event time from ``event_time_field`` (epoch ms) when configured —
+    a row where that field is absent or non-numeric raises
+    :class:`EventTimeError` (a :class:`PermanentError`): configuring an
+    event-time field declares the stream watermarked, and a silently
+    ``None`` event time would make windows close around ghost rows.
     ``parse="raw"`` yields the undecoded line (no trailing newline).
     A line that fails to decode raises
     :class:`~sparkdl_tpu.resilience.errors.PermanentError` — corrupt
@@ -190,10 +203,26 @@ class FileTailSource(StreamSource):
                 f"{offset}: {e}"
             ) from e
         event_time = None
-        if self._event_time_field and isinstance(value, dict):
-            raw = value.get(self._event_time_field)
-            if raw is not None:
+        if self._event_time_field:
+            raw = (
+                value.get(self._event_time_field)
+                if isinstance(value, dict) else None
+            )
+            if raw is None:
+                raise EventTimeError(
+                    f"configured event_time_field "
+                    f"{self._event_time_field!r} is absent from the row "
+                    f"in {self.path!r} ending at byte {offset} — a "
+                    "watermarked stream cannot carry un-timestamped rows"
+                )
+            try:
                 event_time = float(raw)
+            except (TypeError, ValueError):
+                raise EventTimeError(
+                    f"event_time_field {self._event_time_field!r} in "
+                    f"{self.path!r} at byte {offset} is non-numeric: "
+                    f"{raw!r} (epoch milliseconds expected)"
+                ) from None
         return Record(value, offset, event_time)
 
     def poll(self, max_records: int) -> List[Record]:

@@ -1,0 +1,823 @@
+"""Continuous SQL: windowed standing queries, exactly-once emission,
+late-row side output, in-query model scoring, kill-matrix recovery.
+
+The acceptance core is byte-identity: a continuous windowed query
+SIGKILLed at ``streaming.window_commit`` (between the window-results
+payload and its commit marker) and restarted must emit *exactly* the
+window set an uninterrupted reference run emits — same windows, same
+aggregate values, no duplicate, no loss, no re-scored window — with
+every late row accounted for in the side-output sink."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from sparkdl_tpu.resilience import FaultPlan, active_plan
+from sparkdl_tpu.sql import TPUSession
+from sparkdl_tpu.sql.continuous import (
+    ContinuousPlan,
+    ContinuousQuery,
+    ContinuousQueryError,
+    StreamTableError,
+)
+from sparkdl_tpu.sql.window_state import (
+    WINDOW_AGG_SPECS,
+    WindowStateStore,
+    assign_windows,
+    parse_duration_ms,
+)
+from sparkdl_tpu.streaming import (
+    FileTailSource,
+    JsonlSink,
+    QueueSource,
+    StreamConfig,
+)
+from sparkdl_tpu.streaming.sources import EventTimeError
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def session():
+    s = TPUSession.builder.getOrCreate()
+    yield s
+    # drop anything a test registered so sessions don't leak across tests
+    for table in list(s.catalog._streams.values()):
+        table.active_query = None
+    s.catalog._streams.clear()
+
+
+def fast_config(**overrides):
+    kw = dict(max_batch=4, max_wait_ms=5.0, poll_batch=4,
+              poll_interval_ms=2.0)
+    kw.update(overrides)
+    return StreamConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# window_state unit layer
+# ---------------------------------------------------------------------------
+
+
+class TestDurations:
+    @pytest.mark.parametrize("text,ms", [
+        ("10s", 10_000.0), ("500ms", 500.0), ("2m", 120_000.0),
+        ("1h", 3_600_000.0), ("250", 250.0), (" 1.5s ", 1500.0),
+    ])
+    def test_parse(self, text, ms):
+        assert parse_duration_ms(text) == ms
+
+    @pytest.mark.parametrize("bad", ["", "10x", "abc", "-5s", "0s", "0"])
+    def test_garbage_raises(self, bad):
+        with pytest.raises(ValueError):
+            parse_duration_ms(bad)
+
+
+class TestAssignWindows:
+    def test_tumbling_is_single_window(self):
+        assert assign_windows(12_345.0, 10_000.0, 10_000.0) == [
+            (10_000.0, 20_000.0)
+        ]
+        assert assign_windows(0.0, 10_000.0, 10_000.0) == [(0.0, 10_000.0)]
+
+    def test_sliding_overlap(self):
+        # size 10s, slide 5s: every instant belongs to two windows
+        assert assign_windows(12_000.0, 10_000.0, 5_000.0) == [
+            (5_000.0, 15_000.0), (10_000.0, 20_000.0),
+        ]
+
+    def test_boundary_belongs_to_next_window(self):
+        # [start, end): an event AT a boundary opens the next window
+        assert assign_windows(10_000.0, 10_000.0, 10_000.0) == [
+            (10_000.0, 20_000.0)
+        ]
+
+
+class TestWindowStateStore:
+    def _store(self):
+        return WindowStateStore([("n", "count"), ("p95_v", "p95")])
+
+    def test_update_close_in_deterministic_order(self):
+        st = self._store()
+        w = (0.0, 1000.0)
+        for i, key in enumerate(["b", "a", "b"]):
+            st.update(w, (key,), [True, float(i)])
+        st.update((1000.0, 2000.0), ("a",), [True, 9.0])
+        assert st.open_windows == 3
+        closed = st.close_upto(1000.0)
+        # only the first window closed, keys sorted deterministically
+        assert [(c["keys"][0], c["rows"]) for c in closed] == [
+            ("a", 1), ("b", 2)
+        ]
+        assert st.open_windows == 1
+        # closing again emits nothing (state was removed)
+        assert st.close_upto(1000.0) == []
+
+    def test_none_watermark_closes_nothing(self):
+        st = self._store()
+        st.update((0.0, 1000.0), ("k",), [True, 1.0])
+        assert st.close_upto(None) == []
+
+    def test_null_values_skipped_but_row_counted(self):
+        st = WindowStateStore([("n", "count"), ("s", "sum")])
+        w = (0.0, 1000.0)
+        st.update(w, (), [True, 2.0])
+        st.update(w, (), [True, None])  # null cell: sum skips, count=arg true
+        closed = st.close_upto(1000.0)
+        assert closed[0]["rows"] == 2
+        assert closed[0]["aggs"] == [2, 2.0]
+
+    def test_snapshot_restore_round_trip(self):
+        st = self._store()
+        st.update((0.0, 1000.0), ("a",), [True, 1.0])
+        st.update((0.0, 1000.0), ("a",), [True, 5.0])
+        snap = st.snapshot()
+        st2 = self._store()
+        st2.restore(snap)
+        assert st2.snapshot() == snap
+        assert st.close_upto(1000.0) == st2.close_upto(1000.0)
+
+    def test_restore_from_different_query_fails_loudly(self):
+        st = self._store()
+        st.update((0.0, 1000.0), (), [True, 1.0])
+        other = WindowStateStore([("total", "sum")])
+        with pytest.raises(ValueError, match="different"):
+            other.restore(st.snapshot())
+
+    def test_unhashable_group_key_rejected(self):
+        st = self._store()
+        with pytest.raises(TypeError, match="group key"):
+            st.update((0.0, 1.0), ({"a": 1},), [True, 1.0])
+
+    def test_percentile_interpolates_like_numpy(self):
+        np = pytest.importorskip("numpy")
+        vals = [3.0, 1.0, 4.0, 1.0, 5.0, 9.0, 2.0, 6.0]
+        spec = WINDOW_AGG_SPECS["p95"]
+        acc = spec.init()
+        for v in vals:
+            acc = spec.update(acc, v)
+        assert spec.final(acc) == pytest.approx(
+            float(np.percentile(vals, 95.0))
+        )
+        assert WINDOW_AGG_SPECS["p50"].final(sorted(vals)) == pytest.approx(
+            float(np.percentile(vals, 50.0))
+        )
+
+
+# ---------------------------------------------------------------------------
+# the WINDOW() grammar extension
+# ---------------------------------------------------------------------------
+
+
+class TestContinuousPlan:
+    def test_parses_window_keys_and_aggs(self, session):
+        p = ContinuousPlan.parse(
+            session,
+            "SELECT endpoint, window_start, p95(latency) AS p95_ms, "
+            "count(*) AS n FROM scores "
+            "GROUP BY WINDOW(event_time_ms, '10s'), endpoint",
+        )
+        assert p.table == "scores"
+        assert p.time_col == "event_time_ms"
+        assert (p.size_ms, p.slide_ms) == (10_000.0, 10_000.0)
+        assert not p.sliding
+        assert p.keys == ["endpoint"]
+        assert [(a.label, a.fn_key, a.arg) for a in p.aggs] == [
+            ("p95_ms", "p95", "latency"), ("n", "count", "*"),
+        ]
+
+    def test_sliding_window(self, session):
+        p = ContinuousPlan.parse(
+            session,
+            "SELECT avg(v) FROM s GROUP BY WINDOW(t, '10s', '5s')",
+        )
+        assert p.sliding and p.slide_ms == 5_000.0
+
+    def test_mean_aliases_avg(self, session):
+        p = ContinuousPlan.parse(
+            session, "SELECT mean(v) AS m FROM s GROUP BY WINDOW(t, '1s')"
+        )
+        assert p.aggs[0].fn_key == "avg"
+
+    def test_where_clause_is_captured(self, session):
+        p = ContinuousPlan.parse(
+            session,
+            "SELECT count(*) AS n FROM s WHERE v > 3 "
+            "GROUP BY WINDOW(t, '1s')",
+        )
+        assert p.where == "v > 3"
+
+    @pytest.mark.parametrize("query,match", [
+        ("SELECT count(*) FROM s GROUP BY WINDOW(t, '5s', '10s')",
+         "slide"),
+        ("SELECT count(*) FROM s GROUP BY WINDOW(t, '1s') ORDER BY n",
+         "ORDER BY"),
+        ("SELECT count(*) FROM s GROUP BY WINDOW(t, '1s') LIMIT 5",
+         "LIMIT"),
+        ("SELECT count(*) FROM s GROUP BY WINDOW(t, '1s') HAVING n > 2",
+         "HAVING"),
+        ("SELECT count(*) FROM a JOIN b ON a.k = b.k "
+         "GROUP BY WINDOW(t, '1s')", "JOIN"),
+        ("SELECT count(*) FROM s GROUP BY k", "WINDOW"),
+        ("SELECT count(*) FROM s", "GROUP BY"),
+        ("SELECT v FROM s GROUP BY WINDOW(t, '1s')", "neither"),
+        ("SELECT stddev(v) FROM s GROUP BY WINDOW(t, '1s')",
+         "not a window aggregate"),
+        ("SELECT avg(*) FROM s GROUP BY WINDOW(t, '1s')", "avg"),
+        ("SELECT score(v) FROM s GROUP BY WINDOW(t, '1s')",
+         "not a window aggregate"),
+        ("SELECT p95(nosuch(v)) FROM s GROUP BY WINDOW(t, '1s')",
+         "not a registered UDF"),
+        ("SELECT count(*) AS n, sum(v) AS n FROM s "
+         "GROUP BY WINDOW(t, '1s')", "duplicate"),
+        ("SELECT count(*) FROM s "
+         "GROUP BY WINDOW(t, '1s'), WINDOW(t, '2s')", "more than one"),
+        ("SELECT count(*) FROM s GROUP BY WINDOW(t, 'xyz')", "duration"),
+    ])
+    def test_dialect_violations_are_typed(self, session, query, match):
+        with pytest.raises(ContinuousQueryError, match=match):
+            ContinuousPlan.parse(session, query)
+
+    def test_plan_fault_site_fires(self, session):
+        from sparkdl_tpu.resilience.errors import TransientError
+
+        plan = FaultPlan().add("csql.plan", error="transient", at=1)
+        with active_plan(plan):
+            with pytest.raises(TransientError):
+                ContinuousPlan.parse(
+                    session,
+                    "SELECT count(*) FROM s GROUP BY WINDOW(t, '1s')",
+                )
+        assert plan.count("csql.plan") == 1
+
+
+# ---------------------------------------------------------------------------
+# catalog: stream tables vs temp views
+# ---------------------------------------------------------------------------
+
+
+class TestCatalogStreamTables:
+    def test_list_tables_distinguishes_types(self, session):
+        df = session.createDataFrame([(1,)], ["x"])
+        df.createOrReplaceTempView("bounded_v")
+        session.readStream("stream_t", QueueSource())
+        try:
+            tables = {t.name: t.tableType for t in
+                      session.catalog.listTables()}
+            assert tables["bounded_v"] == "TEMPORARY"
+            assert tables["stream_t"] == "STREAM"
+        finally:
+            session.catalog.dropTempView("bounded_v")
+
+    def test_drop_temp_view_refuses_stream_table(self, session):
+        session.readStream("st", QueueSource())
+        with pytest.raises(StreamTableError, match="dropStreamTable"):
+            session.catalog.dropTempView("st")
+        session.catalog.dropStreamTable("st")
+        assert not any(
+            t.name == "st" for t in session.catalog.listTables()
+        )
+
+    def test_drop_active_stream_table_names_the_query(
+        self, session, tmp_path
+    ):
+        src = QueueSource()
+        session.readStream("live", src)
+        q = ContinuousQuery(
+            session,
+            "SELECT count(*) AS n FROM live GROUP BY WINDOW(t, '1s')",
+            JsonlSink(str(tmp_path / "out.jsonl")),
+            str(tmp_path / "log"),
+            name="q_live",
+        )
+        try:
+            with pytest.raises(StreamTableError, match="q_live"):
+                session.catalog.dropStreamTable("live")
+            # a second query on the same table is refused too (the
+            # stream's read position is single-consumer)
+            with pytest.raises(StreamTableError, match="q_live"):
+                ContinuousQuery(
+                    session,
+                    "SELECT count(*) AS n FROM live "
+                    "GROUP BY WINDOW(t, '1s')",
+                    JsonlSink(str(tmp_path / "out2.jsonl")),
+                    str(tmp_path / "log2"),
+                    name="q_other",
+                )
+        finally:
+            q.close()
+        session.catalog.dropStreamTable("live")  # released by close()
+
+    def test_stream_table_shadowing_temp_view_rejected(self, session):
+        df = session.createDataFrame([(1,)], ["x"])
+        df.createOrReplaceTempView("shadow_me")
+        try:
+            with pytest.raises(StreamTableError, match="temp view"):
+                session.readStream("shadow_me", QueueSource())
+        finally:
+            session.catalog.dropTempView("shadow_me")
+
+    def test_table_and_stream_table_cross_errors(self, session):
+        session.readStream("only_stream", QueueSource())
+        with pytest.raises(StreamTableError, match="sqlStream"):
+            session.table("only_stream")
+        df = session.createDataFrame([(1,)], ["x"])
+        df.createOrReplaceTempView("only_view")
+        try:
+            with pytest.raises(StreamTableError, match="readStream"):
+                session.catalog.streamTable("only_view")
+        finally:
+            session.catalog.dropTempView("only_view")
+        with pytest.raises(StreamTableError, match="not found"):
+            session.catalog.streamTable("nowhere")
+
+
+# ---------------------------------------------------------------------------
+# bounded-plane percentiles (shared fn keys, pinned vs window specs)
+# ---------------------------------------------------------------------------
+
+
+class TestBoundedPercentiles:
+    def test_sql_group_by_p95(self, session):
+        np = pytest.importorskip("numpy")
+        rows = [("a", float(i)) for i in range(20)]
+        df = session.createDataFrame(rows, ["k", "v"])
+        df.createOrReplaceTempView("pvals")
+        try:
+            out = session.sql(
+                "SELECT k, p95(v) AS p FROM pvals GROUP BY k"
+            ).collect()
+        finally:
+            session.catalog.dropTempView("pvals")
+        assert out[0]["p"] == pytest.approx(
+            float(np.percentile([r[1] for r in rows], 95.0))
+        )
+
+    def test_functions_factory_matches_window_spec(self, session):
+        import sparkdl_tpu.sql.functions as F
+
+        vals = [5.0, 1.0, 9.0, 3.0, 7.0]
+        df = session.createDataFrame([(v,) for v in vals], ["v"])
+        got = df.groupBy().agg(F.p50("v").alias("m")).collect()[0]["m"]
+        spec = WINDOW_AGG_SPECS["p50"]
+        acc = spec.init()
+        for v in vals:
+            acc = spec.update(acc, v)
+        assert got == pytest.approx(spec.final(acc))
+
+
+# ---------------------------------------------------------------------------
+# in-process continuous queries
+# ---------------------------------------------------------------------------
+
+
+def _feed(src, n=40, late_at=()):
+    """n in-order rows, 500ms apart, two endpoints; indices in late_at
+    instead carry an event time far behind the stream (out-of-order)."""
+    for i in range(n):
+        ts = 100.0 if i in late_at else i * 500.0
+        src.put({
+            "endpoint": "a" if i % 2 else "b",
+            "latency": float(i),
+            "ts": ts,
+        })
+    src.end()
+
+
+class TestContinuousQuery:
+    QUERY = (
+        "SELECT endpoint, p95(latency) AS p95_ms, count(*) AS n "
+        "FROM scores GROUP BY WINDOW(ts, '5s'), endpoint"
+    )
+
+    def _run(self, session, tmp_path, src, query=None, **cq_kw):
+        session.readStream("scores", src)
+        sink = JsonlSink(str(tmp_path / "out.jsonl"))
+        late = JsonlSink(str(tmp_path / "late.jsonl"))
+        q = ContinuousQuery(
+            session, query or self.QUERY, sink, str(tmp_path / "log"),
+            late_sink=late, config=cq_kw.pop("config", fast_config()),
+            **cq_kw,
+        )
+        try:
+            summary = q.run(idle_timeout_s=2.0)
+        finally:
+            q.close()
+        return summary, sink.read_all(), late.read_all()
+
+    def test_windows_close_and_emit(self, session, tmp_path):
+        src = QueueSource()
+        _feed(src, n=40)  # ts up to 19500: windows 0-5s .. 10-15s close
+        summary, rows, late = self._run(session, tmp_path, src)
+        assert summary["stop_reason"] == "source_finished"
+        assert late == []
+        windows = sorted({(r["window_start"], r["window_end"])
+                          for r in rows})
+        assert windows == [
+            (0.0, 5000.0), (5000.0, 10000.0), (10000.0, 15000.0),
+        ]
+        # 5s windows, rows 500ms apart alternating endpoints: 5 each
+        assert all(r["n"] == 5 for r in rows)
+        # the open 15-20s window is state, not output
+        assert summary["open_windows"] == 2
+        first_a = [r for r in rows
+                   if r["window_start"] == 0.0 and r["endpoint"] == "a"]
+        assert len(first_a) == 1
+        # endpoint a holds odd latencies [1, 3, 5, 7, 9] in the first
+        # window: rank 3.8 interpolates 7 + 0.8 * (9 - 7)
+        assert first_a[0]["p95_ms"] == pytest.approx(8.6)
+
+    def test_late_rows_routed_to_side_output(self, session, tmp_path):
+        src = QueueSource()
+        # rows 20 and 31 arrive out-of-order far behind the watermark
+        _feed(src, n=40, late_at=(20, 31))
+        summary, rows, late = self._run(session, tmp_path, src)
+        assert summary["late_rows"] == 2
+        assert sorted(r["input"]["latency"] for r in late) == [20.0, 31.0]
+        assert all(r["event_time_ms"] == 100.0 for r in late)
+        # late rows joined NO window: the 0-5s windows count them absent
+        w0 = {r["endpoint"]: r["n"] for r in rows
+              if r["window_start"] == 0.0}
+        assert w0 == {"a": 5, "b": 5}
+
+    def test_allowed_lateness_keeps_rows_in_window(self, session, tmp_path):
+        src = QueueSource()
+        src.put({"endpoint": "a", "latency": 1.0, "ts": 1000.0})
+        src.put({"endpoint": "a", "latency": 2.0, "ts": 9000.0})
+        # 500ms behind max: within a 60s allowance, contributes normally
+        src.put({"endpoint": "a", "latency": 3.0, "ts": 8500.0})
+        src.put({"endpoint": "a", "latency": 4.0, "ts": 120_000.0})
+        src.end()
+        summary, rows, late = self._run(
+            session, tmp_path, src,
+            config=fast_config(allowed_lateness_ms=60_000.0),
+        )
+        assert late == []
+        assert summary["late_rows"] == 0
+        # watermark trails max event time by 60s, so the 8500ms row is
+        # NOT late and contributes to its (5000, 10000) window normally
+        assert {(r["window_start"], r["n"]) for r in rows} == {
+            (0.0, 1), (5000.0, 2),
+        }
+
+    def test_where_filters_rows(self, session, tmp_path):
+        src = QueueSource()
+        _feed(src, n=40)
+        query = (
+            "SELECT count(*) AS n FROM scores "
+            "WHERE endpoint = 'a' AND latency < 100 "
+            "GROUP BY WINDOW(ts, '5s')"
+        )
+        _, rows, _ = self._run(session, tmp_path, src, query=query)
+        assert rows and all(r["n"] == 5 for r in rows)
+
+    def test_plain_udf_scores_in_query(self, session, tmp_path):
+        session.udf.register("double_it", lambda v: v * 2.0)
+        src = QueueSource()
+        _feed(src, n=20)
+        query = (
+            "SELECT endpoint, max(double_it(latency)) AS m FROM scores "
+            "GROUP BY WINDOW(ts, '5s'), endpoint"
+        )
+        _, rows, _ = self._run(session, tmp_path, src, query=query)
+        w0 = {r["endpoint"]: r["m"] for r in rows
+              if r["window_start"] == 0.0}
+        assert w0 == {"a": 18.0, "b": 16.0}
+
+    def test_serving_udf_scores_through_admission_queue(
+        self, session, tmp_path
+    ):
+        np = pytest.importorskip("numpy")
+        from sparkdl_tpu.serving import ModelServer, ServingConfig
+        from sparkdl_tpu.sql.functions import UserDefinedFunction
+
+        udf = UserDefinedFunction(lambda v: v, name="score3")
+        udf._serving_endpoint = {
+            "model_id": "score3",
+            "forward": lambda b: b * 3.0,
+            "item_shape": (),
+            "dtype": np.float32,
+            "fingerprint": None,
+        }
+        registered = session.udf.register("score3", udf)
+        registered._serving_endpoint = udf._serving_endpoint
+        src = QueueSource()
+        _feed(src, n=20)
+        query = (
+            "SELECT endpoint, max(score3(latency)) AS m FROM scores "
+            "GROUP BY WINDOW(ts, '5s'), endpoint"
+        )
+        with ModelServer(config=ServingConfig()) as server:
+            _, rows, _ = self._run(
+                session, tmp_path, src, query=query, server=server,
+            )
+        w0 = {r["endpoint"]: r["m"] for r in rows
+              if r["window_start"] == 0.0}
+        assert w0 == {"a": pytest.approx(27.0), "b": pytest.approx(24.0)}
+
+    def test_row_without_event_time_is_typed_error(self, session, tmp_path):
+        src = QueueSource()
+        src.put({"endpoint": "a", "latency": 1.0})  # no ts column
+        src.end()
+        session.readStream("scores", src)
+        q = ContinuousQuery(
+            session, self.QUERY, JsonlSink(str(tmp_path / "out.jsonl")),
+            str(tmp_path / "log"), config=fast_config(),
+        )
+        try:
+            with pytest.raises(ContinuousQueryError, match="event time"):
+                q.run(idle_timeout_s=2.0)
+        finally:
+            q.close()
+
+    def test_source_event_time_binds_pseudo_column(self, session, tmp_path):
+        # rows carry no "event_time_ms" column; the SOURCE extracts it
+        # (satellite: Record.event_time_ms binds WINDOW() directly)
+        path = tmp_path / "in.jsonl"
+        with open(path, "w") as fh:
+            for i in range(10):
+                fh.write(json.dumps({"v": float(i), "ts": i * 1000.0})
+                         + "\n")
+        src = FileTailSource(str(path), event_time_field="ts")
+        query = (
+            "SELECT count(*) AS n FROM scores "
+            "GROUP BY WINDOW(event_time_ms, '5s')"
+        )
+        session.readStream("scores", src)
+        sink = JsonlSink(str(tmp_path / "out.jsonl"))
+        q = ContinuousQuery(
+            session, query, sink, str(tmp_path / "log"),
+            config=fast_config(),
+        )
+        try:
+            q.run(max_epochs=10, idle_timeout_s=1.0)
+        finally:
+            q.close()
+        rows = sink.read_all()
+        assert [(r["window_start"], r["n"]) for r in rows] == [(0.0, 5)]
+
+    def test_preemption_flushes_then_resumes_exactly_once(
+        self, session, tmp_path
+    ):
+        from sparkdl_tpu.resilience import preempt
+
+        session.udf.register(
+            "slow_id", lambda v: (time.sleep(0.005), v)[1]
+        )
+        query = (
+            "SELECT count(*) AS n, max(slow_id(latency)) AS m "
+            "FROM scores GROUP BY WINDOW(ts, '5s')"
+        )
+        src = QueueSource()
+        _feed(src, n=40)
+        session.readStream("scores", src)
+        sink = JsonlSink(str(tmp_path / "out.jsonl"))
+        q = ContinuousQuery(
+            session, query, sink, str(tmp_path / "log"),
+            config=fast_config(),
+        )
+        timer = threading.Timer(
+            0.05, preempt.request_preemption, args=("test preemption",)
+        )
+        timer.start()
+        try:
+            summary = q.run(idle_timeout_s=2.0)
+        finally:
+            timer.cancel()
+            q.close()
+        # resume with a fresh query object over the same checkpoint
+        q2 = ContinuousQuery(
+            session, query, JsonlSink(str(tmp_path / "out.jsonl")),
+            str(tmp_path / "log"), config=fast_config(),
+        )
+        try:
+            summary2 = q2.run(idle_timeout_s=2.0)
+        finally:
+            q2.close()
+        # whether the first run flushed everything on SIGTERM or the
+        # resumed run finished the tail, the union is exactly-once:
+        # every closed window emitted once, none twice
+        assert summary2["stop_reason"] in (
+            "source_finished", "idle_timeout"
+        )
+        rows = JsonlSink(str(tmp_path / "out.jsonl")).read_all()
+        got = [(r["window_start"], r["n"]) for r in rows]
+        assert sorted(got) == [(0.0, 10), (5000.0, 10), (10000.0, 10)]
+
+    def test_metrics_and_spans(self, session, tmp_path):
+        from sparkdl_tpu.obs import tracer
+        from sparkdl_tpu.obs.export import prometheus_text
+        from sparkdl_tpu.utils.metrics import metrics
+
+        spans = []
+        tracer.enable(sink=spans.append)
+        try:
+            src = QueueSource()
+            _feed(src, n=40)
+            self._run(session, tmp_path, src)
+        finally:
+            tracer.disable()
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        (run,) = by_name["csql.query"]
+        closes = by_name["csql.window_close"]
+        assert len(closes) == 6  # 3 closed windows x 2 endpoints
+        assert all(s["trace_id"] == run["trace_id"] for s in closes)
+        assert by_name["csql.recover"][0]["parent_id"] == run["span_id"]
+        text = prometheus_text(metrics)
+        assert "csql_rows_in" in text
+        assert "csql_windows_closed" in text
+        assert "csql_open_windows" in text
+        assert "csql_emit_latency_ms" in text
+        assert metrics.counter("csql.rows_in").value >= 40
+
+
+# ---------------------------------------------------------------------------
+# kill matrix: SIGKILL at streaming.window_commit / csql.plan →
+# restart → emitted windows byte-identical to an uninterrupted reference
+# ---------------------------------------------------------------------------
+
+N_ROWS = 36
+
+CSQL_WORKER = """
+import json, os, sys
+os.environ.setdefault("KERAS_BACKEND", "jax")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from sparkdl_tpu.sql import TPUSession
+from sparkdl_tpu.streaming import FileTailSource, JsonlSink, StreamConfig
+workdir = {workdir!r}
+session = TPUSession.builder.getOrCreate()
+source = FileTailSource(os.path.join(workdir, "in.jsonl"),
+                        event_time_field="ts")
+session.readStream("scores", source)
+sink = JsonlSink(os.path.join(workdir, "out.jsonl"))
+late = JsonlSink(os.path.join(workdir, "late.jsonl"))
+query = session.sqlStream(
+    "SELECT endpoint, p95(latency) AS p95_ms, count(*) AS n "
+    "FROM scores GROUP BY WINDOW(ts, '2s'), endpoint",
+    sink, os.path.join(workdir, "log"), late_sink=late,
+    config=StreamConfig(max_batch=4, max_wait_ms=5.0, poll_batch=4,
+                        poll_interval_ms=2.0),
+)
+summary = query.run(idle_timeout_s=1.0)
+print("SUMMARY " + json.dumps(summary))
+print("WORKER_FINISHED")
+"""
+
+
+def _write_source(workdir, n=N_ROWS, late_at=()):
+    os.makedirs(workdir, exist_ok=True)
+    with open(os.path.join(workdir, "in.jsonl"), "w") as fh:
+        for i in range(n):
+            ts = 50.0 if i in late_at else i * 250.0
+            fh.write(json.dumps({
+                "endpoint": "a" if i % 2 else "b",
+                "latency": float(i),
+                "ts": ts,
+            }) + "\n")
+
+
+def _run_worker(workdir, fault_plan=None, timeout=90):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("SPARKDL_FAULT_PLAN", None)
+    if fault_plan is not None:
+        env["SPARKDL_FAULT_PLAN"] = json.dumps(fault_plan)
+    return subprocess.run(
+        [sys.executable, "-c",
+         CSQL_WORKER.format(repo=_REPO, workdir=workdir)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def _emitted_windows(workdir):
+    """The committed window-result set, epoch numbering stripped (epochs
+    legitimately differ across a restart; window CONTENT may not)."""
+    out = []
+    path = os.path.join(workdir, "out.jsonl")
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for line in fh:
+            if not line.endswith("\n"):
+                continue
+            row = json.loads(line)
+            row.pop("epoch", None)
+            out.append(row)
+    out.sort(key=lambda r: (r["window_start"], r["endpoint"]))
+    return out
+
+
+def _reference_run(tmp_path, late_at=()):
+    refdir = str(tmp_path / "ref")
+    _write_source(refdir, late_at=late_at)
+    ref = _run_worker(refdir)
+    assert ref.returncode == 0, ref.stdout
+    windows = _emitted_windows(refdir)
+    assert windows, "reference run emitted nothing"
+    return windows
+
+
+def test_kill_at_window_commit_then_restart_is_byte_identical(tmp_path):
+    reference = _reference_run(tmp_path)
+    workdir = str(tmp_path / "killed")
+    _write_source(workdir)
+    killed = _run_worker(
+        workdir,
+        fault_plan=[
+            {"site": "streaming.window_commit", "kill": True, "at": 3}
+        ],
+    )
+    assert killed.returncode == 9, killed.stdout
+    assert "WORKER_FINISHED" not in killed.stdout
+
+    from sparkdl_tpu.streaming import CommitLog
+
+    log = CommitLog(os.path.join(workdir, "log"))
+    pending_before = log.pending()
+    assert pending_before, "the kill must leave a payload without marker"
+
+    restarted = _run_worker(workdir)
+    assert restarted.returncode == 0, restarted.stdout
+    assert log.pending() == []
+    got, want = _emitted_windows(workdir), reference
+    assert json.dumps(got, sort_keys=True) == json.dumps(
+        want, sort_keys=True
+    ), f"emitted windows diverged:\n{got}\nvs reference\n{want}"
+
+
+def test_kill_at_window_commit_late_rows_survive_in_side_output(tmp_path):
+    late_at = (12, 25)
+    reference = _reference_run(tmp_path, late_at=late_at)
+    workdir = str(tmp_path / "killed")
+    _write_source(workdir, late_at=late_at)
+    killed = _run_worker(
+        workdir,
+        fault_plan=[
+            {"site": "streaming.window_commit", "kill": True, "at": 4}
+        ],
+    )
+    assert killed.returncode == 9, killed.stdout
+    restarted = _run_worker(workdir)
+    assert restarted.returncode == 0, restarted.stdout
+    assert json.dumps(_emitted_windows(workdir), sort_keys=True) == \
+        json.dumps(reference, sort_keys=True)
+    with open(os.path.join(workdir, "late.jsonl")) as fh:
+        late = [json.loads(line) for line in fh if line.endswith("\n")]
+    assert sorted(r["input"]["latency"] for r in late) == [12.0, 25.0]
+
+
+def test_kill_at_plan_leaves_no_partial_state(tmp_path):
+    workdir = str(tmp_path / "planned")
+    _write_source(workdir)
+    killed = _run_worker(
+        workdir, fault_plan=[{"site": "csql.plan", "kill": True, "at": 1}]
+    )
+    assert killed.returncode == 9, killed.stdout
+    assert "SUMMARY" not in killed.stdout
+    # the query died at plan time: no checkpoint dir, no sink bytes
+    assert not os.path.exists(os.path.join(workdir, "log"))
+    assert not os.path.exists(os.path.join(workdir, "out.jsonl"))
+    # a clean restart (no plan) processes the whole stream
+    restarted = _run_worker(workdir)
+    assert restarted.returncode == 0, restarted.stdout
+    assert _emitted_windows(workdir)
+
+
+# ---------------------------------------------------------------------------
+# event-time satellite: typed errors, no silent None
+# ---------------------------------------------------------------------------
+
+
+class TestEventTimeField:
+    def test_absent_field_raises_typed(self, tmp_path):
+        path = tmp_path / "in.jsonl"
+        path.write_text('{"x": 1}\n')
+        src = FileTailSource(str(path), event_time_field="ts")
+        with pytest.raises(EventTimeError, match="absent"):
+            src.poll(10)
+
+    def test_non_numeric_field_raises_typed(self, tmp_path):
+        path = tmp_path / "in.jsonl"
+        path.write_text('{"x": 1, "ts": "yesterday"}\n')
+        src = FileTailSource(str(path), event_time_field="ts")
+        with pytest.raises(EventTimeError, match="non-numeric"):
+            src.poll(10)
+
+    def test_event_time_error_is_permanent(self):
+        from sparkdl_tpu.resilience.errors import PermanentError
+
+        assert issubclass(EventTimeError, PermanentError)

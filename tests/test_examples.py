@@ -101,6 +101,15 @@ def test_streaming_scoring_example():
 
 
 @pytest.mark.slow
+def test_continuous_query_example():
+    out = _run_example("continuous_query.py")
+    assert "continuous query OK" in out
+    assert "stop_reason=preempted" in out
+    assert "closed 20 windows exactly once across a SIGTERM" in out
+    assert "2 late rows preserved in the side output" in out
+
+
+@pytest.mark.slow
 def test_telemetry_example():
     out = _run_example("telemetry.py")
     assert "telemetry plane up at http://127.0.0.1:" in out
