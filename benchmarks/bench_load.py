@@ -542,6 +542,10 @@ def run(args):
         os.environ["SPARKDL_RESULT_CACHE"] = "1"
     else:
         os.environ.pop("SPARKDL_RESULT_CACHE", None)
+    ragged_on = getattr(args, "ragged", "on") != "off"
+    # inherited by replica children: flips every micro-batcher between
+    # ragged slot-block dispatch and the padded bucket ladder
+    os.environ["SPARKDL_RAGGED"] = "1" if ragged_on else "0"
 
     from sparkdl_tpu.serving.replica import ReplicaSpec
     from sparkdl_tpu.serving.supervisor import ReplicaSupervisor
@@ -657,6 +661,7 @@ def run(args):
         "hedge": args.hedge if args.scenario == "faultnet" else None,
         "seed": args.seed,
         "obs": obs_on,
+        "ragged": ragged_on,
     }
     if decode_mix:
         # perf_gate's shape key reads bool(report["decode"]) — the full
@@ -1150,6 +1155,30 @@ def run(args):
                 "stitched_traces": stitched,
                 "admission_probe": admission_probe,
             }
+        # slot-dispatch pad accounting (ISSUE-20): federated batcher
+        # counters — rows that carried real requests vs rows the device
+        # computed.  The ragged plain lane computes exactly k rows per
+        # dispatch; the padded ladder rounds k up to its bucket, and
+        # the gap is this fraction.
+        pad = None
+        fleet = supervisor.fleet_collector
+        if fleet is not None:
+            fleet.scrape_once()  # final counters, not 0.5s stale
+            snap = fleet.snapshot()
+            rows_real = rows_computed = 0.0
+            for row in snap["targets"].values():
+                m = row.get("metrics") or {}
+                rows_real += m.get("batcher.rows_real", 0.0)
+                rows_computed += m.get("batcher.rows_computed", 0.0)
+            if rows_computed:
+                pad = {
+                    "rows_real": int(rows_real),
+                    "rows_computed": int(rows_computed),
+                    "fraction": round(
+                        1.0 - rows_real / rows_computed, 4
+                    ),
+                }
+        report["pad"] = pad
         if obs_on:
             fleet = supervisor.fleet_collector
             fleet_snap = None
@@ -1327,6 +1356,68 @@ def _diag_problems(report):
     return problems
 
 
+def _ragged_byte_identity(seed: int) -> bool:
+    """The cross-lane determinism probe: the same inputs through one
+    plain and one compiled-fingerprinted endpoint, ragged on then
+    ragged off, compared with ``tobytes()``.  The masked slot block and
+    the fused prologue are row-independent by contract, so dispatch
+    shape must never leak into results — this proves it on the exact
+    build under benchmark, in-process (no fleet round trip to blur
+    attribution).  The forward is deliberately accumulation-free
+    (elementwise affine + tanh): BLAS/XLA matmul kernels are not
+    bitwise-stable across batch shapes (M=1 vs M=8 pick different
+    tilings), and that rounding noise predates ragged dispatch — the
+    old bucket ladder already ran the same request at different M
+    depending on coalescing.  An elementwise forward isolates the
+    dispatcher: any byte difference here IS a dispatch bug."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sparkdl_tpu.serving.batcher import ServingConfig
+    from sparkdl_tpu.serving.server import ModelServer
+
+    rng = np.random.default_rng(seed)
+    xs = [rng.standard_normal(16).astype(np.float32) for _ in range(24)]
+    scale = np.linspace(0.5, 1.5, 16, dtype=np.float32)
+    shift = np.linspace(-0.3, 0.3, 16, dtype=np.float32)
+    outs = {}
+    prev = os.environ.get("SPARKDL_RAGGED")
+    try:
+        for mode in ("1", "0"):
+            os.environ["SPARKDL_RAGGED"] = mode
+            server = ModelServer(config=ServingConfig(
+                max_batch=8, max_wait_ms=2.0, queue_capacity=64,
+            ))
+            server.register(
+                "plain",
+                lambda x, _s=scale, _b=shift:
+                    np.tanh(np.asarray(x) * _s + _b),
+                item_shape=(16,), compile=False,
+            )
+            server.register(
+                "jit",
+                lambda x, _s=scale, _b=shift: jnp.tanh(x * _s + _b),
+                item_shape=(16,), compile=True,
+                fingerprint="bench:ragged-byteid:v1",
+            )
+            try:
+                lanes = []
+                for ep in ("plain", "jit"):
+                    futs = [server.submit(x, model_id=ep) for x in xs]
+                    lanes.append(np.stack([
+                        np.asarray(f.result(timeout=60.0)) for f in futs
+                    ]))
+                outs[mode] = [lane.tobytes() for lane in lanes]
+            finally:
+                server.close()
+    finally:
+        if prev is None:
+            os.environ.pop("SPARKDL_RAGGED", None)
+        else:
+            os.environ["SPARKDL_RAGGED"] = prev
+    return outs["1"] == outs["0"]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--scenario", default="kill",
@@ -1441,6 +1532,16 @@ def main():
     ap.add_argument("--hedge", default="on", choices=["on", "off"],
                     help="faultnet scenario: hedged requests on/off for "
                     "THIS pass (full runs do both automatically)")
+    ap.add_argument("--ragged", default="on",
+                    choices=["on", "off", "ab"],
+                    help="slot-block ragged dispatch for one-shot "
+                    "endpoints (sets SPARKDL_RAGGED for the fleet): "
+                    "'off' forces the padded bucket ladder; 'ab' runs "
+                    "the ISSUE-20 proof — a CI-smoke-shaped ragged "
+                    "baseline pass plus saturated metered kill passes "
+                    "ragged on/off on both wire lanes, asserting pad "
+                    "fraction <= 0.10, goodput >= +15%, p99 no worse, "
+                    "byte-identical outputs, zero accepted loss")
     ap.add_argument("--slo-p99-ms", type=float, default=250.0)
     ap.add_argument("--spawn-timeout-s", type=float, default=120.0)
     ap.add_argument("--seed", type=int, default=0)
@@ -1610,6 +1711,121 @@ def main():
             )
             + f", multiplier(s=1.1 vs s=0)={multiplier}x, "
             f"miss p99 {miss_p99_base} -> {miss_p99_mid} ms, 0 lost",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.ragged == "ab":
+        # the ragged A/B proof (ISSUE-20): same seed, same kill
+        # scenario — only SPARKDL_RAGGED flips.  Pass 1 reproduces the
+        # exact CI smoke shape (plain fleet, auto lane, ragged on) so
+        # the fault-suite perf gate has a same-shape ragged baseline to
+        # bite against.  The four ab_* passes run a METERED fleet with
+        # offered load far above capacity (2 replicas x 6 ms/row ~= 333
+        # rows/s on one endpoint; 20 closed-loop workers keep ~10
+        # requests queued per replica, which the padded ladder rounds
+        # up to bucket 16 every dispatch) — so the pad rows the bucket
+        # ladder computes show up as lost goodput, not just a gauge.
+        args.scenario = "kill"
+        args.compile = False
+        args.replicas = 2
+        args.duration = 12.0
+        args.kill_at_requests = 100
+        args.obs = "on"
+        passes = {}
+        args.metered = False
+        args.rate, args.workers, args.endpoints = 60.0, 2, 3
+        args.transport = None
+        os.environ.pop("SPARKDL_WIRE_TRANSPORT", None)
+        args.ragged = "on"
+        passes["smoke_ragged"] = run(args)
+        args.metered = True
+        args.forward_cost_ms = 6.0
+        args.rate, args.workers, args.endpoints = 960.0, 20, 1
+        for lane in ("shm", "tcp"):
+            args.transport = lane
+            for mode in ("on", "off"):
+                args.ragged = mode
+                passes[f"ab_{lane}_{mode}"] = run(args)
+        byte_identity = _ragged_byte_identity(args.seed)
+
+        def _pad_frac(p):
+            return (p.get("pad") or {}).get("fraction")
+
+        def _p99(p):
+            return (p.get("latency_ms") or {}).get("p99")
+
+        summary = {
+            "pad_fraction": {k: _pad_frac(p) for k, p in passes.items()},
+            "goodput_rps": {
+                k: p["goodput_rps"] for k, p in passes.items()
+            },
+            "p99_ms": {k: _p99(p) for k, p in passes.items()},
+            "lost_accepted": {
+                k: p["lost_accepted"] for k, p in passes.items()
+            },
+            "goodput_gain": {},
+            "byte_identity": byte_identity,
+        }
+        problems = []
+        for lane in ("shm", "tcp"):
+            on, off = passes[f"ab_{lane}_on"], passes[f"ab_{lane}_off"]
+            pad_on, pad_off = _pad_frac(on), _pad_frac(off)
+            if pad_on is None or pad_on > 0.10:
+                problems.append(
+                    f"{lane}: ragged pad fraction {pad_on} "
+                    f"(want <= 0.10; padded baseline {pad_off})"
+                )
+            gain = (
+                round(on["goodput_rps"] / off["goodput_rps"], 3)
+                if off["goodput_rps"] else None
+            )
+            summary["goodput_gain"][lane] = gain
+            if gain is None or gain < 1.15:
+                problems.append(
+                    f"{lane}: goodput gain {gain}x ragged vs padded "
+                    "(want >= 1.15x)"
+                )
+            p99_on, p99_off = _p99(on), _p99(off)
+            if p99_on is not None and p99_off is not None \
+                    and p99_on > 1.05 * p99_off:
+                problems.append(
+                    f"{lane}: ragged p99 {p99_on}ms worse than padded "
+                    f"{p99_off}ms (want no worse)"
+                )
+        for key, p in passes.items():
+            if p["lost_accepted"] != 0:
+                problems.append(
+                    f"{key}: lost {p['lost_accepted']} accepted "
+                    f"requests through the kill ({p['lost_detail']})"
+                )
+        if byte_identity is not True:
+            problems.append(
+                "ragged and padded outputs were not byte-identical"
+            )
+        report = dict(
+            {"benchmark_suite": "bench_load_ragged_ab",
+             "seed": args.seed, "summary": summary},
+            **passes,
+        )
+        print(json.dumps(report, indent=2, default=str))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=2, default=str)
+            print(f"wrote {args.out}", file=sys.stderr)
+        if problems:
+            print("RAGGED AB FAIL: " + "; ".join(problems),
+                  file=sys.stderr)
+            return 1
+        print(
+            "RAGGED AB PASS: "
+            + ", ".join(
+                f"{lane} pad {_pad_frac(passes[f'ab_{lane}_off'])}"
+                f"->{_pad_frac(passes[f'ab_{lane}_on'])}"
+                f" goodput x{summary['goodput_gain'][lane]}"
+                for lane in ("shm", "tcp")
+            )
+            + f", byte_identity={byte_identity}, 0 lost",
             file=sys.stderr,
         )
         return 0

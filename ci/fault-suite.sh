@@ -73,13 +73,28 @@ print_fleet_snapshot() {
 # every backend on tcp even though shm was requested.
 for lane in tcp shm; do
   if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
-      --transport "$lane" --assert-lane "$lane" 2>&1 | tee "$SMOKE_LOG"; then
+      --ragged on --transport "$lane" --assert-lane "$lane" \
+      2>&1 | tee "$SMOKE_LOG"; then
     echo "replica-kill smoke FAILED on the $lane lane (accepted-request" >&2
     echo "loss, no recovery, wrong lane, or >60s wall — see above)" >&2
     print_fleet_snapshot
     exit 1
   fi
 done
+
+# padded-ladder fallback smoke (<60 s, ISSUE-20): the SPARKDL_RAGGED=0
+# kill switch must leave the fleet on the bucket-pad ladder with the
+# same zero-accepted-loss guarantee through a replica kill — the
+# escape hatch has to actually hold before anyone reaches for it.
+if ! timeout -k 10 60 python benchmarks/bench_load.py --smoke \
+    --ragged off --transport shm --assert-lane shm \
+    2>&1 | tee "$SMOKE_LOG"; then
+  echo "padded-fallback smoke FAILED: with ragged dispatch killed" >&2
+  echo "(SPARKDL_RAGGED=0) the bucket ladder must still survive a" >&2
+  echo "replica kill with zero accepted-request loss" >&2
+  print_fleet_snapshot
+  exit 1
+fi
 if ! timeout -k 10 60 env SPARKDL_WIRE_SHM_DISABLE=1 \
     python benchmarks/bench_load.py --smoke \
     --transport shm --assert-lane tcp 2>&1 | tee "$SMOKE_LOG"; then

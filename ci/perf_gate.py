@@ -120,7 +120,11 @@ def shape_key(report: Dict[str, Any]) -> Tuple:
     so it only ever gates other decode-mix runs.  A continuous-SQL run
     (``"sql": true`` — bench_streaming's standing windowed query)
     measures the window-close-and-commit path, not raw runner
-    throughput, so it only gates other sql runs."""
+    throughput, so it only gates other sql runs.  Ragged slot-block
+    dispatch (``"ragged": true``) changes what a "batch" is — no
+    bucket pad, admission at any occupancy — so ragged runs only gate
+    other ragged runs and padded-ladder baselines stay comparable
+    among themselves."""
     return tuple(report.get(f) for f in SHAPE_FIELDS) + (
         bool(report.get("obs") or report.get("trace")),
         bool(report.get("result_cache")),
@@ -128,6 +132,7 @@ def shape_key(report: Dict[str, Any]) -> Tuple:
         bool(report.get("sim")),
         bool(report.get("decode")),
         bool(report.get("sql")),
+        bool(report.get("ragged")),
     )
 
 

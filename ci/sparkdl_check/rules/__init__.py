@@ -2,6 +2,7 @@
 registry (each module uses the ``@rule`` decorator at import time)."""
 
 from ci.sparkdl_check.rules import (  # noqa: F401
+    bucket_pad,
     contextvar_leak,
     donation_safety,
     error_taxonomy,

@@ -1,0 +1,56 @@
+"""``bucket-pad`` — no bucket-padding in the serving hot path.
+
+Since ISSUE 20 the micro-batcher's default one-shot path is ragged
+slot-block dispatch: occupancy rides a bool mask through one compiled
+``(n_slots, *item)`` executable, and no request ever computes pad rows.
+A new ``pad_to_batch`` call under ``serving/`` quietly reintroduces the
+bucket-ladder waste that path exists to kill (0.38 pad fraction at the
+r19 baseline) — and it is exactly the kind of regression a reviewer
+skims past, because padding *looks* like the established idiom.
+
+Scope: ``serving/`` only.  The transformers' offline batch path
+(``transformers/utils.py``) legitimately pads — Spark partitions are
+not latency-sensitive — and stays out of scope.
+
+Sanctioned escape: the batcher's padded *fallback* lane (the
+``SPARKDL_RAGGED=0`` kill switch, and compiled endpoints without a
+durable fingerprint) marks its one pad site with
+``# sparkdl: disable=bucket-pad``.  Anything else should either ride
+the slot block or make the case for a new sanctioned site in review.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ci.sparkdl_check.core import FileContext, Rule, rule
+from ci.sparkdl_check.rules._util import dotted_name
+
+
+@rule
+class BucketPadRule(Rule):
+    id = "bucket-pad"
+    severity = "error"
+    doc = ("serving hot paths must not bucket-pad batches — ragged "
+           "slot-block dispatch exists so pad rows are never computed")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("serving/")
+
+    def check(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            spelling = dotted_name(node.func)
+            if spelling is None:
+                continue
+            if spelling == "pad_to_batch" or spelling.endswith(
+                    ".pad_to_batch"):
+                yield self.finding(
+                    ctx, node,
+                    "pad_to_batch in the serving hot path — pad rows "
+                    "burn device time the ragged slot block avoids; "
+                    "dispatch through the slot block, or mark a "
+                    "sanctioned fallback with "
+                    "'# sparkdl: disable=bucket-pad'",
+                )

@@ -27,7 +27,7 @@ from sparkdl_tpu.engine.executor import (
     FetchFailure,
     dispatch_depth,
 )
-from sparkdl_tpu.engine.slots import Slot, SlotPool
+from sparkdl_tpu.engine.slots import Slot, SlotPool, slot_block_fingerprint
 
 #: the process-wide engine used by transformers, UDFs, and estimators
 #: (serving's ProgramCache builds its own so cache_size eviction is real)
@@ -42,6 +42,7 @@ __all__ = [
     "ProgramHandle",
     "Slot",
     "SlotPool",
+    "slot_block_fingerprint",
     "cache_key",
     "default_cache_dir",
     "dispatch_depth",

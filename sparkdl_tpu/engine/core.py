@@ -95,6 +95,34 @@ class ProgramHandle:
         )
 
 
+def _donation_safe_loaded(compiled) -> Callable:
+    """Guard a disk-loaded executable that donates its inputs.
+
+    XLA will take a host numpy buffer zero-copy, and donation then
+    executes IN PLACE in memory the caller still owns — asynchronously,
+    so the caller can read pre-execution bytes through a result view,
+    watch its input array be rewritten underneath it, or hand the same
+    (now-consumed) buffer to the next dispatch.  Freshly-compiled
+    executables copy host inputs at device_put; loaded ones must get
+    the same treatment: re-home every numpy leaf into a jax-owned
+    buffer before the call so donation consumes memory jax controls."""
+
+    def call(*args):
+        import jax
+        import jax.numpy as jnp
+
+        safe = jax.tree_util.tree_map(
+            lambda leaf: (
+                jnp.array(leaf, copy=True)
+                if isinstance(leaf, np.ndarray) else leaf
+            ),
+            args,
+        )
+        return compiled(*safe)
+
+    return call
+
+
 def _leaf_spec(leaf) -> Tuple[Tuple[int, ...], Any, Any]:
     """(shape, dtype, sharding) of one argument leaf.  jax arrays carry
     their committed sharding into the compiled program's calling
@@ -225,6 +253,8 @@ class ExecutionEngine:
                     name="engine_cache_load",
                 )
             if compiled is not None:
+                if donate:
+                    compiled = _donation_safe_loaded(compiled)
                 elapsed = time.perf_counter() - start
                 metrics.counter("engine.cache_hit").add(1)
                 self._record_event("engine.cache_hit", key, name, elapsed)

@@ -67,12 +67,24 @@ def _start_host_copy(result: Any) -> None:
 def _fetch_host(result: Any) -> Any:
     """Blocking device→host materialization of a dispatched result
     (numpy leaves).  Single arrays come back as one ndarray; pytrees
-    keep their structure."""
+    keep their structure.
+
+    Fetched leaves must be process-OWNED, never views into device
+    buffers: on CPU ``device_get`` is zero-copy, and an executable —
+    disk-loaded ones in particular — may hand later calls the same
+    output buffer, silently rewriting any view a caller still holds
+    (request futures read their rows long after the next batch ran).
+    A view (``base`` set) is therefore copied; a genuine transfer
+    (owned array, the device path) is returned as-is."""
     import jax
 
-    return jax.tree_util.tree_map(
-        lambda leaf: np.asarray(jax.device_get(leaf)), result
-    )
+    def leaf_to_host(leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.base is not None or not arr.flags.owndata:
+            arr = np.array(arr)
+        return arr
+
+    return jax.tree_util.tree_map(leaf_to_host, result)
 
 
 class FetchFailure:
@@ -120,6 +132,24 @@ class DispatchWindow:
 
     def __len__(self) -> int:
         return len(self._inflight)
+
+    @property
+    def has_room(self) -> bool:
+        """True while another ``submit`` would not force a blocking
+        fetch — the "device could take this batch NOW" signal that both
+        the coalesce linger (`flush_early`) and the ragged slot loop
+        key off."""
+        return len(self._inflight) <= self.depth
+
+    def pop_ready(self) -> List[Tuple[Any, Any]]:
+        """Fetch-and-return only what exceeds the window depth (what
+        ``submit`` would have returned, without submitting anything) —
+        the ragged loop's way to free slots held by overflowing batches
+        before admitting more work."""
+        out = []
+        while len(self._inflight) > self.depth:
+            out.append(self._pop())
+        return out
 
     def _pop(self) -> Tuple[Any, Any]:
         result, meta = self._inflight.popleft()

@@ -33,6 +33,23 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 
+def slot_block_fingerprint(
+    fingerprint: Optional[str], kind: str, n_slots: int
+) -> Optional[str]:
+    """Durable identity of a slot-block executable: one program per
+    (model, kind, pool size).  The pool size is part of the identity —
+    the per-dispatch occupancy is not — so the engine's persistent
+    cache can rehydrate the executable across restarts.  ``kind``
+    separates the decode step program from the one-shot ragged forward
+    of the same model (different computations over the same pool
+    shape).  None stays None: unfingerprinted models are uncacheable
+    and (for one-shot serving) fall back to the padded bucket ladder.
+    """
+    if fingerprint is None:
+        return None
+    return f"{fingerprint}:{kind}-slots-{int(n_slots)}"
+
+
 class Slot:
     """One device slot: index into the pool's carry stack, the occupying
     request (opaque to the engine layer), and per-stream counters."""
@@ -174,6 +191,16 @@ class SlotPool:
     def occupied(self) -> List[Slot]:
         """The occupied slots in index order — the fused step's rows."""
         return [s for s in self._slots if s.occupied]
+
+    def mask(self) -> np.ndarray:
+        """``(n_slots,)`` bool occupancy — the masked fused forward's
+        second operand (True rows are computed-and-read; False rows are
+        zeroed so a vacant row can never leak a stale answer)."""
+        m = np.zeros(self.n_slots, dtype=bool)
+        for s in self._slots:
+            if s.occupied:
+                m[s.index] = True
+        return m
 
     def carries(self) -> np.ndarray:
         """The full ``(N, *carry_shape)`` stack (vacant rows are zeros).

@@ -92,6 +92,20 @@ class KerasApplicationModel:
 
         return bucket_ladder(max_batch)
 
+    def serving_prologue(self):
+        """The fused on-device input prologue for an online endpoint of
+        this model: cast/bilinear-resize to the model's input size +
+        Keras-parity :func:`preprocess_input`, as one jnp-traceable
+        callable for ``ModelServer.register(prologue=...)`` — the
+        decode-output → model-input pipeline compiles *into* the
+        endpoint executable instead of round-tripping through the
+        host-side ``device_resize`` shape groups."""
+        from sparkdl_tpu.transformers.utils import make_input_prologue
+
+        return make_input_prologue(
+            size=self.input_size, preprocess=self.preprocess
+        )
+
     # -- model construction ------------------------------------------
     def make_module(self, dtype: Optional[Any] = None, include_top: bool = True):
         return self.flax_cls(

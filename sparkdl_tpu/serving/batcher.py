@@ -1,24 +1,43 @@
-"""Dynamic micro-batcher: coalesce single-item requests into padded,
-shape-bucketed forward calls.
+"""Dynamic micro-batcher: ragged slot-block dispatch, with the padded
+bucket ladder as kill switch and fallback.
 
-This is the online analog of ``run_batched`` (transformers/utils.py) and
-shares its batching core: every device call's leading dim is one of the
-:func:`~sparkdl_tpu.transformers.utils.bucket_ladder` buckets, padded up
-with :func:`~sparkdl_tpu.transformers.utils.pad_to_batch`, so XLA
-compiles a bounded program set and steady state never recompiles (tf.data
-pipelining logic — PAPERS.md — applied to a request stream instead of an
-input pipeline).
+**Ragged path (default).** Each endpoint owns a fixed
+``(n_slots, *item)`` slot block (:class:`~sparkdl_tpu.engine.SlotPool`,
+``n_slots = max_batch`` — the one-shot twin of the ISSUE-18 decode
+pool).  A request is admitted into any free slot the moment it arrives:
+no bucket pad, no coalesce-window linger while the device idles.
+Compiled endpoints run ONE executable — a masked fused forward over the
+whole block, occupancy riding a bool mask instead of the shape — and
+results scatter back by slot index; plain (``compile=False``) endpoints
+gather exactly the occupied rows, so the device computes zero pad rows.
+Slots stay occupied while their block is in flight in the dispatch
+window and free at completion, so traffic keeps admitting into the
+remaining slots mid-flight.
 
-One worker thread per endpoint: requests for one model coalesce, the
-batch pads to its bucket, the warm :class:`ProgramCache` program runs it,
-and per-request futures resolve.  A forward that raises fails only that
-batch's futures — the worker survives and keeps serving (the crash case
-is fault-injection-tested).
+**Padded fallback.** ``SPARKDL_RAGGED=0`` (read at dispatch time — the
+kill switch is live) or a compiled endpoint with no durable fingerprint
+(an anonymous slot-block executable could never persist) falls back to
+the original discipline, the online analog of ``run_batched``
+(transformers/utils.py): coalesce, pad to a
+:func:`~sparkdl_tpu.transformers.utils.bucket_ladder` bucket with
+:func:`~sparkdl_tpu.transformers.utils.pad_to_batch`, one warm program
+per bucket (tf.data pipelining logic — PAPERS.md — applied to a request
+stream instead of an input pipeline).
+
+Either way: one worker thread per endpoint; the warm
+:class:`ProgramCache` program runs the batch and per-request futures
+resolve.  A forward that raises fails only that batch's futures — the
+worker survives and keeps serving (the crash case is
+fault-injection-tested).  ``batcher.rows_real`` / ``rows_computed``
+counters and the ``batcher.pad_fraction`` gauge account for every row
+the device computed vs every row a caller asked for — the measured
+padding waste, federated per-version into ``/debug/fleet``.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from concurrent.futures import Future
@@ -26,7 +45,7 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
-from sparkdl_tpu.engine import DispatchWindow, FetchFailure
+from sparkdl_tpu.engine import DispatchWindow, FetchFailure, SlotPool
 from sparkdl_tpu.obs.slo import sanitize_name
 from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
@@ -47,6 +66,18 @@ from sparkdl_tpu.transformers.utils import (
 from sparkdl_tpu.utils.metrics import metrics
 
 logger = logging.getLogger(__name__)
+
+#: kill switch for ragged one-shot dispatch — ``SPARKDL_RAGGED=0``
+#: forces every endpoint onto the padded bucket ladder
+ENV_RAGGED = "SPARKDL_RAGGED"
+
+
+def ragged_enabled() -> bool:
+    """Ragged slot-block dispatch is on unless ``SPARKDL_RAGGED=0``.
+    Read per dispatch cycle, so flipping the env mid-process takes
+    effect on the next batch (what the byte-identity tests and the
+    bench A/B rely on)."""
+    return os.environ.get(ENV_RAGGED, "1").strip() != "0"
 
 
 class ServingConfig:
@@ -131,12 +162,27 @@ class MicroBatcher:
         dtype: Any = np.float32,
         compile: bool = True,
         fingerprint: Optional[str] = None,
+        prologue: Optional[Callable[[Any], Any]] = None,
         clock=time.monotonic,
     ):
         self.model_id = model_id
         self._forward = forward
         self._config = config
         self._cache = cache
+        # fused on-device input prologue (cast/resize/normalize —
+        # transformers.utils.make_input_prologue): composed IN FRONT of
+        # the forward so compiled endpoints trace prologue+model as one
+        # donation-friendly XLA program and the host-side device_resize
+        # round-trips leave the hot path.  Plain endpoints apply it
+        # eagerly (same math, no fusion).
+        self._prologue = prologue
+        if prologue is None:
+            self._fused_forward = forward
+        else:
+            def _fused_forward(x, _fwd=forward, _pro=prologue):
+                return _fwd(_pro(x))
+
+            self._fused_forward = _fused_forward
         #: injectable time source — the sim drives the endpoint in
         #: virtual time; live serving keeps the monotonic default
         self._clock = clock
@@ -163,6 +209,22 @@ class MicroBatcher:
         )
         self._dtype = np.dtype(dtype)
         self._compile = bool(compile)
+        # the one-shot slot block: a request holds a slot from admission
+        # until its result is scattered back (i.e. across its block's
+        # time in the dispatch window), so the occupancy gauge reads
+        # "requests resident on the device" — the same meaning as
+        # decode.slots_occupied.  Worker-owned (single-owner discipline,
+        # like the decode pool); the gauge is the only cross-thread read.
+        self._pool = SlotPool(
+            config.max_batch,
+            occupied_gauge=metrics.gauge("batcher.slot_occupancy"),
+        )
+        # pad accounting: rows callers asked for vs rows the device
+        # computed — counters so the fleet federation can sum them
+        # across replicas; the gauge is this process's lifetime ratio
+        self._m_rows_real = metrics.counter("batcher.rows_real")
+        self._m_rows_computed = metrics.counter("batcher.rows_computed")
+        self._m_pad_gauge = metrics.gauge("batcher.pad_fraction")
         self._queue = AdmissionQueue(
             config.queue_capacity,
             depth_gauge=metrics.gauge(f"serving.queue_depth.{model_id}"),
@@ -271,15 +333,32 @@ class MicroBatcher:
             )
         if not self._compile:
             return ()
-        return self._cache.warmup(
+        warmed = self._cache.warmup(
             self.model_id,
-            self._forward,
+            self._fused_forward,
             self._item_shape,
             self._dtype,
             buckets=buckets,
             max_batch=self._config.max_batch,
             fingerprint=self._fingerprint,
         )
+        if self._ragged_active():
+            # pre-compile the slot-block executable too, so the first
+            # ragged dispatch is not a compile; the padded ladder above
+            # stays warm as the SPARKDL_RAGGED=0 fallback
+            import jax
+
+            n = self._pool.n_slots
+            fn = self._cache.ragged_program(
+                self.model_id, self._masked_fused(), n,
+                self._item_shape, self._dtype,
+                fingerprint=self._fingerprint,
+            )
+            x = np.zeros((n, *self._item_shape), dtype=self._dtype)
+            mask = np.zeros(n, dtype=bool)
+            # warmup WANTS to block — off the request path
+            jax.block_until_ready(fn(x, mask))  # sparkdl: disable=host-sync
+        return warmed
 
     # ------------------------------------------------------------------
     # worker
@@ -302,13 +381,16 @@ class MicroBatcher:
         try:
             while not self._closed:
                 try:
-                    batch = self._queue.take(
-                        self._config.max_batch,
-                        self._config.max_wait_ms / 1000.0,
-                        flush_early=self._device_free,
-                    )
-                    if batch:
-                        self._run_batch(batch)
+                    if self._ragged_active():
+                        self._ragged_tick()
+                    else:
+                        batch = self._queue.take(
+                            self._config.max_batch,
+                            self._config.max_wait_ms / 1000.0,
+                            flush_early=self._device_free,
+                        )
+                        if batch:
+                            self._run_batch(batch)
                     if len(self._window) and not len(self._queue):
                         # nothing left to overlap with — complete the
                         # in-flight batches now rather than holding their
@@ -341,7 +423,188 @@ class MicroBatcher:
         without blocking on an older fetch — the idle-device signal
         that cuts the coalesce linger short (holding a batch while the
         device sits idle buys no occupancy, only latency)."""
-        return len(self._window) <= self._window.depth
+        return self._window.has_room
+
+    # ------------------------------------------------------------------
+    # ragged slot-block dispatch
+    # ------------------------------------------------------------------
+    def _ragged_active(self) -> bool:
+        """Ragged dispatch, unless the kill switch says padded or the
+        endpoint is compiled without a durable fingerprint (the
+        sanctioned fallback: an anonymous slot-block executable could
+        neither persist nor be shared across restarts)."""
+        if not ragged_enabled():
+            return False
+        if self._compile and self._fingerprint is None:
+            return False
+        return True
+
+    def _masked_fused(self) -> Callable:
+        """The single ragged executable body: the (prologue-fused)
+        forward over the whole ``(n_slots, *item)`` block, vacant rows
+        zeroed by the occupancy mask — occupancy is data, never shape,
+        so every dispatch runs this one program."""
+        forward = self._fused_forward
+
+        def fused(block, mask):
+            import jax.numpy as jnp
+
+            out = forward(block)
+            m = mask.reshape(mask.shape + (1,) * (out.ndim - 1))
+            return jnp.where(m, out, jnp.zeros_like(out))
+
+        return fused
+
+    def _ragged_tick(self) -> None:
+        """One ragged worker cycle: free slots whose blocks have
+        overflowed the window, admit arrivals straight into free slots
+        (no coalesce linger), and dispatch them as one masked block."""
+        pool = self._pool
+        # complete what the window no longer needs in flight — these
+        # batches' slots free here, which is what lets the admission
+        # below proceed while older blocks are still fetching
+        for host, meta in self._window.pop_ready():
+            self._complete(host, meta)
+        if pool.n_free == 0:
+            # every slot is riding an in-flight block: completing the
+            # oldest batch is the only way to free one
+            if len(self._window):
+                host, meta = next(self._window.drain())
+                self._complete(host, meta)
+            return
+        busy = pool.n_occupied > 0 or len(self._window) > 0
+        reqs = self._queue.take(
+            pool.n_free,
+            0.0,
+            poll_s=0.0 if busy else 0.05,
+            flush_early=self._device_free,
+        )
+        if not reqs:
+            return
+        now = self._clock()
+        live = []
+        for r in reqs:
+            if r.expired(now):
+                metrics.counter("serving.expired").add(1)
+                r.future.set_exception(
+                    DeadlineExceeded(
+                        f"request to {self.model_id!r} expired after "
+                        f"{(now - r.enqueued_at) * 1000:.1f}ms in queue"
+                    )
+                )
+            else:
+                live.append(r)
+        if not live:
+            return
+        slots = []
+        for r in live:
+            slot = pool.acquire(r, r.value, now=now)
+            assert slot is not None  # take() was capped at n_free
+            slots.append(slot)
+            if r.span is not None:
+                r.span.event("slot_acquired", slot=slot.index)
+
+        if not self._compile:
+            # plain endpoints gather exactly the occupied rows — no pad
+            # rows computed at all — and stay fully synchronous (the
+            # fault-injection tests rely on deterministic ordering)
+            x = np.stack([r.value for r in live])
+
+            def forward_once():
+                inject.fire("serving.forward")
+                return np.asarray(self._forward(self._prep_host(x)))
+
+            try:
+                if not tracer.enabled:
+                    self._forward_batch(live, len(live), forward_once, now)
+                    return
+                with self._batch_span(live, len(live)):
+                    self._forward_batch(live, len(live), forward_once, now)
+                return
+            finally:
+                for s in slots:
+                    pool.release(s)
+
+        # compiled: dispatch the ONE slot-block program over the pool's
+        # block; this dispatch's rows ride the mask (NOT pool.mask() —
+        # slots of still-in-flight older blocks must stay masked out of
+        # this one's scatter)
+        mask = np.zeros(pool.n_slots, dtype=bool)
+        for s in slots:
+            mask[s.index] = True
+
+        def dispatch_once():
+            inject.fire("serving.forward")
+            fn = self._cache.ragged_program(
+                self.model_id, self._masked_fused(), pool.n_slots,
+                self._item_shape, self._dtype,
+                fingerprint=self._fingerprint,
+            )
+            # the program donates its block argument, and on CPU a
+            # device_put of a host array may be zero-copy — so the
+            # output block can ALIAS the buffer we pass in.  The pool's
+            # carry stack is mutable (release() zeroes freed rows while
+            # result views may still be unread), so it must never be
+            # that buffer: dispatch a private copy of the block
+            return fn(pool.carries().copy(), mask)
+
+        bspan = None
+        if tracer.enabled:
+            bspan = tracer.start_span(
+                "serving.batch",
+                model_id=self.model_id,
+                bucket=pool.n_slots,
+                n_real=len(live),
+                ragged=True,
+                member_span_ids=[
+                    r.span.span_id for r in live if r.span is not None
+                ],
+            )
+            for r in live:
+                if r.span is not None:
+                    r.span.event(
+                        "coalesced", batch_span=bspan.span_id,
+                        bucket=pool.n_slots,
+                    )
+        try:
+            self._breaker.check()
+            retry = self._config.retry
+            if retry is not None:
+                dls = [r.deadline for r in live if r.deadline is not None]
+                deadline = (
+                    Deadline(min(dls), what=f"batch to {self.model_id!r}")
+                    if dls
+                    else None
+                )
+                out_dev = retry.call(dispatch_once, deadline=deadline)
+            else:
+                out_dev = dispatch_once()
+        except CircuitOpen as e:
+            self._fail_batch(live, bspan, e, record=False)
+            for s in slots:
+                pool.release(s)
+            return
+        except Exception as e:
+            metrics.counter("serving.errors").add(1)
+            self._m_errors.add(len(live))
+            self._fail_batch(live, bspan, e, record=True)
+            for s in slots:
+                pool.release(s)
+            return
+        t_dispatched = self._clock()
+        for host, meta in self._window.submit(
+            out_dev, meta=(live, pool.n_slots, bspan, now, t_dispatched,
+                           slots)
+        ):
+            self._complete(host, meta)
+
+    def _prep_host(self, x):
+        """Eager (plain-endpoint) application of the input prologue —
+        same math as the fused trace, materialized back to numpy for
+        arbitrary non-JAX forwards."""
+        if self._prologue is None:
+            return x
+        return np.asarray(self._prologue(x))
 
     def _run_batch(self, reqs) -> None:
         now = self._clock()
@@ -360,7 +623,11 @@ class MicroBatcher:
         if not live:
             return
         bucket = shape_bucket(len(live), self._config.max_batch)
-        x = pad_to_batch(np.stack([r.value for r in live]), bucket)
+        # the sanctioned pad site: the SPARKDL_RAGGED=0 /
+        # unfingerprinted-endpoint fallback lane
+        x = pad_to_batch(  # sparkdl: disable=bucket-pad
+            np.stack([r.value for r in live]), bucket
+        )
 
         if not self._compile:
             # plain-Python endpoints stay fully synchronous — the fault-
@@ -368,7 +635,7 @@ class MicroBatcher:
             # there is no async dispatch to overlap anyway
             def forward_once():
                 inject.fire("serving.forward")
-                return np.asarray(self._forward(x))
+                return np.asarray(self._forward(self._prep_host(x)))
 
             if not tracer.enabled:
                 self._forward_batch(live, bucket, forward_once, now)
@@ -386,7 +653,7 @@ class MicroBatcher:
         def dispatch_once():
             inject.fire("serving.forward")
             fn = self._cache.program(
-                self.model_id, self._forward, bucket,
+                self.model_id, self._fused_forward, bucket,
                 self._item_shape, self._dtype,
                 fingerprint=self._fingerprint,
             )
@@ -431,7 +698,7 @@ class MicroBatcher:
             return
         t_dispatched = self._clock()
         for host, meta in self._window.submit(
-            out_dev, meta=(live, bucket, bspan, now, t_dispatched)
+            out_dev, meta=(live, bucket, bspan, now, t_dispatched, None)
         ):
             self._complete(host, meta)
 
@@ -476,12 +743,18 @@ class MicroBatcher:
             r.future.set_exception(exc)
 
     def _complete(self, host, meta) -> None:
-        """Resolve one batch that fell out of the dispatch window."""
-        live, bucket, bspan, t_batch, t_dispatched = meta
+        """Resolve one batch that fell out of the dispatch window.
+        ``meta[-1]`` discriminates the lanes: the padded ladder passes
+        ``None`` (request i reads row i), the ragged path passes the
+        batch's slots (request j reads its slot's row, then frees it)."""
+        live, n_computed, bspan, t_batch, t_dispatched, slots = meta
         if isinstance(host, FetchFailure):
             metrics.counter("serving.errors").add(1)
             self._m_errors.add(len(live))
             self._fail_batch(live, bspan, host.error, record=True)
+            if slots is not None:
+                for s in slots:
+                    self._pool.release(s)
             return
         self._breaker.record_success()
         done = self._clock()
@@ -496,18 +769,17 @@ class MicroBatcher:
                 "forward": (t_dispatched - t_batch) * 1000.0,
                 "fetch": (done - t_dispatched) * 1000.0,
             }
-            r.future.set_result(host[i])
+            r.future.set_result(
+                host[slots[i].index] if slots is not None else host[i]
+            )
             ms = (done - r.enqueued_at) * 1000.0
             ex = r.span.trace_id if r.span is not None else None
             latency.observe(ms, exemplar=ex)
             self._m_latency.observe(ms, exemplar=ex)
-        metrics.counter("serving.batches").add(1)
-        metrics.histogram("serving.batch_occupancy").observe(
-            len(live) / bucket
-        )
-        metrics.histogram("batcher.pad_fraction").observe(
-            (bucket - len(live)) / bucket
-        )
+        if slots is not None:
+            for s in slots:
+                self._pool.release(s)
+        self._observe_batch(len(live), n_computed)
         if bspan is not None:
             bspan.end()
 
@@ -559,13 +831,28 @@ class MicroBatcher:
             ex = r.span.trace_id if r.span is not None else None
             latency.observe(ms, exemplar=ex)
             self._m_latency.observe(ms, exemplar=ex)
+        self._observe_batch(len(live), bucket)
+
+    def _observe_batch(self, n_real: int, n_computed: int) -> None:
+        """Per-batch padding accounting, shared by every completion
+        path: ``n_real`` rows a caller asked for rode a device call of
+        ``n_computed`` rows (== n_real on the ragged plain lane, the
+        full slot block on the ragged compiled lane, the bucket on the
+        padded fallback)."""
         metrics.counter("serving.batches").add(1)
         metrics.histogram("serving.batch_occupancy").observe(
-            len(live) / bucket
+            n_real / n_computed
         )
         metrics.histogram("batcher.pad_fraction").observe(
-            (bucket - len(live)) / bucket
+            (n_computed - n_real) / n_computed
         )
+        self._m_rows_real.add(n_real)
+        self._m_rows_computed.add(n_computed)
+        computed = self._m_rows_computed.value
+        if computed:
+            self._m_pad_gauge.set(
+                round(1.0 - self._m_rows_real.value / computed, 4)
+            )
 
     # ------------------------------------------------------------------
     # lifecycle / introspection
@@ -618,6 +905,9 @@ class MicroBatcher:
             "dtype": self._dtype.name,
             "compiled": self._compile,
             "fingerprint": self._fingerprint,
+            "ragged": self._ragged_active(),
+            "slot_pool": self._pool.snapshot(),
+            "prologue": self._prologue is not None,
             "queue_depth": self.queue_depth,
             "queue_capacity": self._queue.capacity,
             "worker_alive": self.worker_alive,

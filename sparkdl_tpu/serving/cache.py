@@ -34,7 +34,10 @@ from sparkdl_tpu.utils.metrics import metrics
 
 class ProgramCache:
     """Bounded LRU of engine-compiled programs keyed by
-    ``(model_id, bucket, item_shape, dtype)``."""
+    ``(model_id, bucket, item_shape, dtype)``; ragged slot-block
+    executables occupy the same LRU under ``("ragged", n_slots)`` in
+    the bucket position (one per endpoint — occupancy is a runtime
+    mask, not a key)."""
 
     def __init__(self, maxsize: int = 32, compile_counter=None):
         self._lock = threading.Lock()
@@ -79,9 +82,65 @@ class ProgramCache:
         makes the slot's executable eligible for the persistent cache.
         """
         key = self._key(model_id, bucket, item_shape, dtype)
-        # claim the key (or wait for whoever holds it), then resolve
-        # outside the lock — an XLA compile takes seconds and must not
-        # block stats()/evict_model()/other buckets behind self._lock
+        spec = jax.ShapeDtypeStruct(
+            (int(bucket), *(int(d) for d in item_shape)), np.dtype(dtype)
+        )
+        return self._resolve(key, lambda: self._engine.program(
+            forward,
+            (spec,),
+            fingerprint=(
+                f"serving:{fingerprint}" if fingerprint else None
+            ),
+            donate=True,
+            name=f"serving_{model_id}_b{bucket}",
+        ))
+
+    def ragged_program(
+        self,
+        model_id: str,
+        fused: Callable,
+        n_slots: int,
+        item_shape: Sequence[int],
+        dtype: Any,
+        fingerprint: str,
+    ) -> Callable:
+        """The ONE compiled executable of a ragged one-shot endpoint:
+        ``fused(block, mask)`` over the fixed ``(n_slots, *item_shape)``
+        slot block (occupancy rides the bool mask, never the shape), so
+        admission at any occupancy dispatches the same program —
+        no bucket ladder, no per-occupancy recompile.  ``fingerprint``
+        is mandatory here: the batcher falls back to the padded ladder
+        for unfingerprinted endpoints rather than compiling an
+        anonymous (unpersistable) slot-block program per process."""
+        if fingerprint is None:
+            raise ValueError(
+                "ragged slot-block programs require a durable model "
+                "fingerprint (unfingerprinted endpoints serve padded)"
+            )
+        key = (model_id, ("ragged", int(n_slots)),
+               tuple(int(d) for d in item_shape), np.dtype(dtype).str)
+        block = jax.ShapeDtypeStruct(
+            (int(n_slots), *(int(d) for d in item_shape)), np.dtype(dtype)
+        )
+        mask = jax.ShapeDtypeStruct((int(n_slots),), np.dtype(bool))
+        from sparkdl_tpu.engine.slots import slot_block_fingerprint
+
+        return self._resolve(key, lambda: self._engine.program(
+            fused,
+            (block, mask),
+            fingerprint=(
+                "serving:"
+                + slot_block_fingerprint(fingerprint, "ragged", n_slots)
+            ),
+            donate=True,
+            name=f"serving_{model_id}_ragged{n_slots}",
+        ))
+
+    def _resolve(self, key: Tuple, build: Callable) -> Callable:
+        """Single-flight resolve of one program slot: claim the key (or
+        wait for whoever holds it), then run ``build`` — which may
+        AOT-compile for seconds — OUTSIDE the lock so stats()/
+        evict_model()/other keys never stall behind a cold program."""
         while True:
             with self._lock:
                 hit = self._programs.get(key)
@@ -94,19 +153,8 @@ class ProgramCache:
             waiter.wait()
 
         try:
-            spec = jax.ShapeDtypeStruct(
-                (int(bucket), *(int(d) for d in item_shape)), np.dtype(dtype)
-            )
             start = time.perf_counter()
-            handle = self._engine.program(
-                forward,
-                (spec,),
-                fingerprint=(
-                    f"serving:{fingerprint}" if fingerprint else None
-                ),
-                donate=True,
-                name=f"serving_{model_id}_b{bucket}",
-            )
+            handle = build()
             seconds = time.perf_counter() - start
             if handle.source == "compile":
                 if self._compile_counter is not None:

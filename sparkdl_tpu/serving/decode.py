@@ -58,7 +58,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from sparkdl_tpu.engine.slots import SlotPool
+from sparkdl_tpu.engine.slots import SlotPool, slot_block_fingerprint
 from sparkdl_tpu.obs.slo import sanitize_name
 from sparkdl_tpu.obs.trace import tracer
 from sparkdl_tpu.resilience import inject
@@ -281,9 +281,9 @@ class DecodeEndpoint:
     def _decode_fingerprint(self) -> Optional[str]:
         # one executable per (model, slot-pool shape): the pool size is
         # part of the identity, the per-request batch size is not
-        if self._fingerprint is None:
-            return None
-        return f"{self._fingerprint}:decode-slots-{self._pool.n_slots}"
+        return slot_block_fingerprint(
+            self._fingerprint, "decode", self._pool.n_slots
+        )
 
     # ------------------------------------------------------------------
     # worker

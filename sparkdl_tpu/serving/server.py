@@ -58,6 +58,7 @@ class ModelServer:
         dtype: Any = np.float32,
         compile: bool = True,
         fingerprint: Optional[str] = None,
+        prologue: Optional[Callable[[Any], Any]] = None,
     ) -> "ModelServer":
         """Register ``forward(batch) -> batch`` as endpoint ``model_id``.
 
@@ -66,8 +67,16 @@ class ModelServer:
         ``fingerprint`` — a durable identity of the model and its weights
         (e.g. a saved-file path+mtime) — lets the program cache persist
         this endpoint's compiled executables to disk, so a restarted
-        server's :meth:`warmup` loads instead of recompiling.
-        Returns ``self`` for chaining."""
+        server's :meth:`warmup` loads instead of recompiling; it also
+        gates ragged slot-block dispatch for compiled endpoints
+        (unfingerprinted ones serve on the padded bucket ladder).
+        ``prologue`` — a jnp-traceable, batch-row-independent input
+        stage (see :func:`~sparkdl_tpu.transformers.utils.
+        make_input_prologue`, or a registry entry's
+        ``serving_prologue()``) — fuses decode-output cast/resize/
+        normalize INTO the endpoint executable, replacing the host-side
+        ``device_resize`` round-trips.  Returns ``self`` for
+        chaining."""
         if model_id in self._endpoints:
             raise ValueError(f"endpoint {model_id!r} already registered")
         self._endpoints[model_id] = MicroBatcher(
@@ -79,6 +88,7 @@ class ModelServer:
             dtype=dtype,
             compile=compile,
             fingerprint=fingerprint,
+            prologue=prologue,
         )
         if self._default is None:
             self._default = model_id

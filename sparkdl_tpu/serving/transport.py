@@ -49,6 +49,7 @@ Env knobs (constructor args override)::
     SPARKDL_WIRE_COALESCE_MS    extra flush window, ms   (default 0)
     SPARKDL_WIRE_POOL_IDLE_S    pooled-socket age-out    (default 30)
     SPARKDL_SEND_TIMEOUT_S      server->client shm send bound (default 30)
+    SPARKDL_WIRE_EVENTFD        "0" forces socket doorbells (default 1)
     SPARKDL_FAULTNET            "1": wrap transports in FaultyTransport
 """
 
@@ -77,6 +78,7 @@ ENV_COALESCE = "SPARKDL_WIRE_COALESCE"        # "0" disables TCP coalescing
 ENV_COALESCE_MS = "SPARKDL_WIRE_COALESCE_MS"  # extra flush window (default 0)
 ENV_POOL_IDLE_S = "SPARKDL_WIRE_POOL_IDLE_S"  # pooled-socket age-out window
 ENV_SEND_TIMEOUT_S = "SPARKDL_SEND_TIMEOUT_S"  # server->client send bound
+ENV_EVENTFD = "SPARKDL_WIRE_EVENTFD"          # "0" forces socket doorbells
 ENV_FAULTNET = "SPARKDL_FAULTNET"             # wrap lanes in FaultyTransport
 
 #: discard pooled sockets idle longer than this — a replica that was
@@ -95,7 +97,23 @@ _SERVER_SEND_TIMEOUT_S = float(
 #: (via the ring's waiter flag) that it is blocked in select().  0x00
 #: can never open a real frame — wire.MAGIC starts with b"S" — so a
 #: reader can always tell a doorbell from a spilled frame by peeking.
+#: When both ends support it (Linux, negotiated at ``shm_attach``), the
+#: wake rides a pair of ``eventfd``\ s instead — one write syscall, no
+#: TCP stack, nothing to drain past an 8-byte counter reset — with this
+#: socket byte kept as the universal fallback.  Per-wake lane counts
+#: land in ``wire.doorbell.eventfd`` / ``wire.doorbell.socket``.
 _DOORBELL = b"\x00"
+
+
+def _eventfd_wanted() -> bool:
+    """Whether this end should offer/accept eventfd doorbells: needs
+    ``os.eventfd`` + SCM_RIGHTS fd passing (Linux, py>=3.10) and the
+    ``SPARKDL_WIRE_EVENTFD=0`` kill switch left alone."""
+    return (
+        os.environ.get(ENV_EVENTFD, "1").strip() != "0"
+        and hasattr(os, "eventfd")
+        and hasattr(socket, "send_fds")
+    )
 #: select() timeouts while a waiter flag is up.  These bound the cost of
 #: the one unfenced store-load race in the doorbell protocol (waiter
 #: store vs. head load can reorder through the store buffer): a missed
@@ -748,16 +766,36 @@ class _Ring:
         self._data.release()
 
 
-def _await_doorbell(sock, wait_s: float) -> Optional[Tuple[int, Any]]:
-    """Block up to ``wait_s`` for one byte on the TCP side-channel: the
-    cheap half of the doorbell contract.  A doorbell byte is consumed
-    right here — a wake costs one syscall and leaves nothing stale in
-    the buffer — and means "check your ring" (returns None).  A spilled
-    frame is read whole and returned.  EOF or a dead socket raises
-    ConnectionError (the side-channel doubles as the liveness signal),
-    and a quiet socket returns None after the timeout so the caller
-    re-polls its ring — the bounded wait is what closes the one
-    unfenced waiter-flag store/load race."""
+def _await_doorbell(
+    sock, wait_s: float, efd: Optional[int] = None
+) -> Optional[Tuple[int, Any]]:
+    """Block up to ``wait_s`` for a doorbell: the cheap half of the
+    doorbell contract.  A doorbell (eventfd tick when ``efd`` was
+    negotiated, else one byte on the TCP side-channel) is consumed
+    right here — a wake costs one syscall and leaves nothing stale
+    behind — and means "check your ring" (returns None).  A spilled
+    frame is read whole off the socket and returned.  EOF or a dead
+    socket raises ConnectionError (the side-channel doubles as the
+    liveness signal even when wakes ride the eventfd), and a quiet
+    wait returns None after the timeout so the caller re-polls its
+    ring — the bounded wait is what closes the one unfenced
+    waiter-flag store/load race."""
+    if efd is not None:
+        try:
+            readable, _, _ = select.select([sock, efd], [], [], wait_s)
+        except (OSError, ValueError) as exc:
+            raise ConnectionError(f"shm side-channel failed: {exc}")
+        if efd in readable:
+            try:
+                os.eventfd_read(efd)  # reset the counter: wake consumed
+            except BlockingIOError:
+                pass  # raced another reset; the wake still happened
+            except OSError as exc:
+                raise ConnectionError(f"eventfd doorbell failed: {exc}")
+        if sock not in readable:
+            return None
+        # socket bytes pending (legacy doorbell / spill / EOF): fall
+        # through — the recv below returns immediately
     prev = sock.gettimeout()
     sock.settimeout(wait_s)
     try:
@@ -820,6 +858,8 @@ class _ShmClientChannel:
         self._seg = None
         self._tx: Optional[_Ring] = None
         self._rx: Optional[_Ring] = None
+        self._efd_tx: Optional[int] = None  # we write: rings the replica
+        self._efd_rx: Optional[int] = None  # we read: replica rings us
         self._sock = wire.connect(host, port, connect_timeout_s)
         try:
             self._sock.settimeout(io_timeout_s)
@@ -840,18 +880,63 @@ class _ShmClientChannel:
             buf = self._seg.buf
             self._tx = _Ring(buf, 0, ring_bytes)
             self._rx = _Ring(buf, _Ring.HDR + ring_bytes, ring_bytes)
-            wire.send_msg(self._sock, {
-                "op": "shm_attach",
-                "shm": self._seg.name,
-                "ring_bytes": ring_bytes,
-            })
-            reply = wire.recv_msg(self._sock)
-            if reply is None:
-                raise ConnectionError("replica closed during shm handshake")
-            if not reply.get("ok"):
-                raise _ShmUnavailable(
-                    reply.get("error", "replica refused shm lane")
-                )
+            # eventfd doorbell offer: an abstract-namespace AF_UNIX
+            # listener (no filesystem entry to leak) whose name rides
+            # the attach message; a capable replica connects and passes
+            # two eventfds over it via SCM_RIGHTS.  Any failure at any
+            # step degrades silently to the socket doorbell — legacy
+            # replicas simply ignore the "efd" field.
+            efd_listener = None
+            efd_name = None
+            if _eventfd_wanted():
+                try:
+                    efd_listener = socket.socket(
+                        socket.AF_UNIX, socket.SOCK_STREAM
+                    )
+                    efd_name = f"sdw_efd_{os.getpid()}_{next(_seg_seq)}"
+                    efd_listener.bind("\0" + efd_name)
+                    efd_listener.listen(1)
+                except OSError:
+                    if efd_listener is not None:
+                        efd_listener.close()
+                    efd_listener = None
+                    efd_name = None
+            try:
+                attach = {
+                    "op": "shm_attach",
+                    "shm": self._seg.name,
+                    "ring_bytes": ring_bytes,
+                }
+                if efd_name is not None:
+                    attach["efd"] = efd_name
+                wire.send_msg(self._sock, attach)
+                reply = wire.recv_msg(self._sock)
+                if reply is None:
+                    raise ConnectionError(
+                        "replica closed during shm handshake"
+                    )
+                if not reply.get("ok"):
+                    raise _ShmUnavailable(
+                        reply.get("error", "replica refused shm lane")
+                    )
+                if reply.get("eventfd") and efd_listener is not None:
+                    try:
+                        efd_listener.settimeout(connect_timeout_s)
+                        conn, _ = efd_listener.accept()
+                        try:
+                            _, fds, _, _ = socket.recv_fds(conn, 1, 2)
+                        finally:
+                            conn.close()
+                        if len(fds) == 2:
+                            self._efd_tx, self._efd_rx = fds[0], fds[1]
+                        else:  # truncated SCM_RIGHTS: refuse the lane
+                            for fd in fds:
+                                os.close(fd)
+                    except OSError:
+                        self._close_efds()  # socket doorbell it is
+            finally:
+                if efd_listener is not None:
+                    efd_listener.close()
             metrics.counter("wire.shm.attach").add(1)
         except BaseException:
             self.close()
@@ -943,6 +1028,7 @@ class _ShmClientChannel:
                     got = _await_doorbell(
                         self._sock,
                         min(_CLIENT_WAIT_S, max(deadline - now, 0.001)),
+                        efd=self._efd_rx,
                     )
                     if got is not None:  # oversized reply spilled to tcp
                         return got
@@ -950,12 +1036,33 @@ class _ShmClientChannel:
                 self._rx.set_waiter(False)
 
     def _ring_doorbell(self) -> None:
+        if self._efd_tx is not None:
+            try:
+                os.eventfd_write(self._efd_tx, 1)
+                metrics.counter("wire.doorbell.eventfd").add(1)
+                return
+            except OSError:
+                # fd hosed: drop to the socket byte, whose failure is
+                # the authoritative liveness verdict
+                self._close_efds()
         try:
             self._sock.sendall(_DOORBELL)
         except OSError as exc:
             raise ConnectionError(f"replica gone (doorbell failed): {exc}")
+        metrics.counter("wire.doorbell.socket").add(1)
+
+    def _close_efds(self) -> None:
+        for attr in ("_efd_tx", "_efd_rx"):
+            fd = getattr(self, attr, None)
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+                setattr(self, attr, None)
 
     def close(self) -> None:
+        self._close_efds()
         try:
             self._sock.close()
         except OSError:
@@ -1124,6 +1231,8 @@ class ServerChannel:
         self._seg = None
         self._rx: Optional[_Ring] = None
         self._tx: Optional[_Ring] = None
+        self._efd_rx: Optional[int] = None  # we read: client rings us
+        self._efd_tx: Optional[int] = None  # we write: rings the client
         self._spins = 0
 
     @property
@@ -1162,7 +1271,9 @@ class ServerChannel:
                 try:
                     got = None
                     if not self._rx.readable():
-                        got = _await_doorbell(self._sock, _SERVER_WAIT_S)
+                        got = _await_doorbell(
+                            self._sock, _SERVER_WAIT_S, efd=self._efd_rx
+                        )
                 except ConnectionError:
                     return None  # socket torn down under us: client gone
                 if got is not None:  # oversized request spilled to tcp
@@ -1192,7 +1303,48 @@ class ServerChannel:
         # mirror of the client: its tx ring is our rx ring
         self._rx = _Ring(buf, 0, ring_bytes)
         self._tx = _Ring(buf, _Ring.HDR + ring_bytes, ring_bytes)
-        wire.send_msg(self._sock, {"ok": True})
+        efd_name = msg.get("efd")
+        eventfd_ok = bool(
+            efd_name and _eventfd_wanted()
+            and self._offer_eventfd(str(efd_name))
+        )
+        wire.send_msg(self._sock, {"ok": True, "eventfd": eventfd_ok})
+
+    def _offer_eventfd(self, name: str) -> bool:
+        """Create the doorbell eventfd pair and pass both ends to the
+        client over its abstract-namespace AF_UNIX listener.  The
+        connect happens *before* our attach reply goes out, but an
+        AF_UNIX stream connect completes against the listen backlog and
+        SCM_RIGHTS payloads buffer until the client accepts — so the
+        ordering is safe.  Any failure returns False and the connection
+        stays on socket doorbells."""
+        c2s = s2c = None
+        conn = None
+        try:
+            flags = os.EFD_NONBLOCK | getattr(os, "EFD_CLOEXEC", 0)
+            c2s = os.eventfd(0, flags)  # client rings us
+            s2c = os.eventfd(0, flags)  # we ring the client
+            conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            conn.settimeout(1.0)
+            conn.connect("\0" + name)
+            socket.send_fds(conn, [b"\x01"], [c2s, s2c])
+        except OSError:
+            for fd in (c2s, s2c):
+                if fd is not None:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+            return False
+        finally:
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+        self._efd_rx, self._efd_tx = c2s, s2c
+        metrics.counter("wire.shm.eventfd").add(1)
+        return True
 
     def send(self, obj: Any, kind: int = wire.KIND_MSG) -> None:
         parts = wire.encode_parts(obj, kind)
@@ -1208,16 +1360,36 @@ class ServerChannel:
                     self._wake.wait(_POLL_SLEEP_S)
                 spins += 1
             if self._tx.waiter:
-                try:
-                    self._sock.sendall(_DOORBELL)
-                except OSError as exc:
-                    raise ConnectionError(
-                        f"client gone (doorbell failed): {exc}"
-                    )
+                self._ring_doorbell()
             return
         wire.sendall_parts(self._sock, parts)
 
+    def _ring_doorbell(self) -> None:
+        if self._efd_tx is not None:
+            try:
+                os.eventfd_write(self._efd_tx, 1)
+                metrics.counter("wire.doorbell.eventfd").add(1)
+                return
+            except OSError:
+                self._close_efds()  # socket byte decides liveness below
+        try:
+            self._sock.sendall(_DOORBELL)
+        except OSError as exc:
+            raise ConnectionError(f"client gone (doorbell failed): {exc}")
+        metrics.counter("wire.doorbell.socket").add(1)
+
+    def _close_efds(self) -> None:
+        for attr in ("_efd_tx", "_efd_rx"):
+            fd = getattr(self, attr, None)
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+                setattr(self, attr, None)
+
     def close(self) -> None:
+        self._close_efds()
         if self._rx is not None:
             self._rx.release()
             self._rx = None

@@ -133,8 +133,11 @@ ENVELOPE_FIELDS = frozenset({
     # the endpoint's registered maximum)
     "op", "model_id", "value", "deadline_ms", "tenant", "trace", "seq",
     "max_steps",
-    # shm lane upgrade handshake
-    "shm", "ring_bytes",
+    # shm lane upgrade handshake ("efd" is the client's abstract-
+    # namespace AF_UNIX listener name for eventfd doorbell passing;
+    # "eventfd" in the attach reply confirms the replica passed the fd
+    # pair — absent/false means socket doorbells)
+    "shm", "ring_bytes", "efd", "eventfd",
     # replies ("cache" marks how the result was produced — "hit" from
     # the router tier, "collapsed" when single-flight fanned a leader's
     # reply out, "negative" when a poison-input error replayed)

@@ -475,6 +475,36 @@ def cast_and_resize_on_device(x, size: Optional[Tuple[int, int]] = None):
     return x
 
 
+def make_input_prologue(
+    size: Optional[Tuple[int, int]] = None,
+    preprocess: Optional[Callable] = None,
+):
+    """Build the fused on-device input prologue of an online endpoint:
+    cast (uint8 ingest) → optional bilinear resize to ``size`` → optional
+    ``preprocess`` (e.g. a registry entry's Keras-parity normalize), as
+    ONE jnp-traceable callable the micro-batcher composes *into* the
+    endpoint executable.
+
+    This is :func:`cast_and_resize_on_device` promoted from "call it
+    yourself at the top of your forward" to a first-class registration
+    hook (``ModelServer.register(prologue=...)``): the whole
+    decode-output → normalized-model-input pipeline compiles with the
+    model into a single donation-friendly XLA program, so the per-shape-
+    group :func:`device_resize` host round-trips disappear from the
+    serving hot path.  ``preprocess`` must be jnp-traceable and
+    batch-row-independent (row i of the output depends only on row i of
+    the input) — the same contract as the forward itself, and what keeps
+    ragged and padded dispatch byte-identical per row."""
+
+    def prologue(x):
+        x = cast_and_resize_on_device(x, size)
+        if preprocess is not None:
+            x = preprocess(x)
+        return x
+
+    return prologue
+
+
 def run_batched_multi(
     fn: Callable,
     arrays: Sequence[np.ndarray],

@@ -125,6 +125,19 @@ class TestSlotPool:
         assert c is not None and c.index == a.index
         np.testing.assert_array_equal(pool.carries()[c.index], 7.0)
 
+    def test_mask_tracks_occupancy_by_index(self):
+        pool = SlotPool(3)
+        a = pool.acquire("a", np.zeros(2, np.float32))
+        pool.acquire("b", np.zeros(2, np.float32))
+        np.testing.assert_array_equal(
+            pool.mask(), [True, True, False]
+        )
+        pool.release(a)
+        np.testing.assert_array_equal(
+            pool.mask(), [False, True, False]
+        )
+        assert pool.mask().dtype == bool
+
 
 # ----------------------------------------------------------------------
 # endpoint: streaming semantics

@@ -654,3 +654,303 @@ class TestOfferWaitRaces:
         finally:
             gate.set()
             server.close()
+
+
+# ----------------------------------------------------------------------
+# ragged slot-block dispatch (ISSUE-20)
+# ----------------------------------------------------------------------
+class TestRaggedDispatch:
+    """One-shot slot-block dispatch: admission into any free slot, a
+    bool occupancy mask instead of pad rows, the padded ladder kept as
+    the SPARKDL_RAGGED=0 kill switch and the fallback for compiled
+    endpoints without a durable fingerprint."""
+
+    DIM = 4
+
+    def _matrix_server(self):
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.transformers.utils import make_input_prologue
+
+        w = np.linspace(-1.0, 1.0, self.DIM * self.DIM,
+                        dtype=np.float32).reshape(self.DIM, self.DIM)
+        pro = make_input_prologue(preprocess=lambda x: x / 2.0)
+        server = ModelServer(ServingConfig(
+            max_batch=8, max_wait_ms=5.0, queue_capacity=64,
+        ))
+        server.register(
+            "plain", lambda x, _w=w: np.tanh(np.asarray(x) @ _w),
+            item_shape=(self.DIM,), compile=False,
+        )
+        server.register(
+            "plain_pro", lambda x, _w=w: np.tanh(np.asarray(x) @ _w),
+            item_shape=(self.DIM,), compile=False, prologue=pro,
+        )
+        server.register(
+            "jit", lambda x, _w=w: jnp.tanh(x @ _w),
+            item_shape=(self.DIM,), compile=True,
+            fingerprint="test:ragged:jit:v1",
+        )
+        server.register(
+            "jit_pro", lambda x, _w=w: jnp.tanh(x @ _w),
+            item_shape=(self.DIM,), compile=True,
+            fingerprint="test:ragged:jit-pro:v1", prologue=pro,
+        )
+        return server
+
+    def test_ragged_and_padded_outputs_byte_identical(self, monkeypatch):
+        """THE equivalence matrix: the same 20 inputs through plain,
+        plain+prologue, compiled-fingerprinted, and compiled+prologue
+        endpoints, ragged on then off — every output byte-identical.
+        Dispatch shape (mask vs pad) must never leak into results."""
+        rng = np.random.default_rng(7)
+        xs = [rng.standard_normal(self.DIM).astype(np.float32)
+              for _ in range(20)]
+        outs = {}
+        for mode in ("1", "0"):
+            monkeypatch.setenv("SPARKDL_RAGGED", mode)
+            server = self._matrix_server()
+            try:
+                per_ep = {}
+                for ep in ("plain", "plain_pro", "jit", "jit_pro"):
+                    futs = [server.submit(x, model_id=ep) for x in xs]
+                    per_ep[ep] = np.stack([
+                        np.asarray(f.result(timeout=30.0)) for f in futs
+                    ]).tobytes()
+                outs[mode] = per_ep
+            finally:
+                server.close()
+        assert outs["1"] == outs["0"]
+
+    def test_ragged_active_and_fallback_rules(self, monkeypatch):
+        """Plain and fingerprinted-compiled endpoints serve ragged;
+        unfingerprinted-compiled endpoints and SPARKDL_RAGGED=0 fall
+        back to the padded ladder (and stay correct)."""
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("SPARKDL_RAGGED", "1")
+        server = ModelServer(ServingConfig(max_batch=4, max_wait_ms=5.0))
+        server.register("plain", lambda x: np.asarray(x) * 2.0,
+                        item_shape=(4,), compile=False)
+        server.register("anon_jit", lambda x: jnp.asarray(x) * 2.0,
+                        item_shape=(4,), compile=True)
+        server.register("fp_jit", lambda x: jnp.asarray(x) * 2.0,
+                        item_shape=(4,), compile=True,
+                        fingerprint="test:fallback:v1")
+        try:
+            eps = server.status()["endpoints"]
+            assert eps["plain"]["ragged"] is True
+            assert eps["fp_jit"]["ragged"] is True
+            # anonymous slot-block executables can't persist — padded
+            assert eps["anon_jit"]["ragged"] is False
+            x = np.full((4,), 1.5, np.float32)
+            for ep in ("plain", "anon_jit", "fp_jit"):
+                np.testing.assert_allclose(
+                    server.submit(x, model_id=ep).result(timeout=30.0),
+                    3.0,
+                )
+            monkeypatch.setenv("SPARKDL_RAGGED", "0")  # live kill switch
+            eps = server.status()["endpoints"]
+            assert all(not e["ragged"] for e in eps.values())
+            np.testing.assert_allclose(
+                server.submit(x, model_id="plain").result(timeout=30.0),
+                3.0,
+            )
+        finally:
+            server.close()
+
+    def test_ragged_computes_no_pad_rows(self, monkeypatch):
+        """rows_computed == rows_real on the ragged plain lane (pad
+        fraction 0), while the padded ladder computes bucket-rounded
+        rows for the same traffic."""
+        monkeypatch.setenv("SPARKDL_RAGGED", "1")
+        gate = threading.Event()
+        server = ModelServer(ServingConfig(
+            max_batch=8, max_wait_ms=5.0, queue_capacity=64,
+        ))
+
+        def forward(x):
+            gate.wait(10.0)
+            return np.asarray(x) * 2.0
+
+        server.register("ep", forward, item_shape=(4,), compile=False)
+        try:
+            first = server.submit(np.ones(4, np.float32))
+            time.sleep(0.3)  # worker blocked in forward on batch #1
+            rest = [server.submit(np.ones(4, np.float32))
+                    for _ in range(3)]
+            gate.set()
+            for f in [first] + rest:
+                np.testing.assert_allclose(f.result(timeout=10.0), 2.0)
+            real = metrics.counter("batcher.rows_real").value
+            computed = metrics.counter("batcher.rows_computed").value
+            assert real == computed == 4.0
+            assert metrics.gauge("batcher.pad_fraction").value == 0.0
+        finally:
+            gate.set()
+            server.close()
+
+    def test_padded_ladder_counts_pad_rows(self, monkeypatch):
+        monkeypatch.setenv("SPARKDL_RAGGED", "0")
+        gate = threading.Event()
+        server = ModelServer(ServingConfig(
+            max_batch=8, max_wait_ms=5.0, queue_capacity=64,
+        ))
+
+        def forward(x):
+            gate.wait(10.0)
+            return np.asarray(x) * 2.0
+
+        server.register("ep", forward, item_shape=(4,), compile=False)
+        try:
+            first = server.submit(np.ones(4, np.float32))
+            time.sleep(0.3)
+            rest = [server.submit(np.ones(4, np.float32))
+                    for _ in range(2)]
+            gate.set()
+            for f in [first] + rest:
+                np.testing.assert_allclose(f.result(timeout=10.0), 2.0)
+            # batch #1: 1 row in bucket 1; batch #2: 2 rows in bucket 2
+            # ... unless the two queued requests split — either way the
+            # ladder computed at least the real rows, and the counters
+            # agree with the gauge
+            real = metrics.counter("batcher.rows_real").value
+            computed = metrics.counter("batcher.rows_computed").value
+            assert real == 3.0 and computed >= real
+            assert metrics.gauge("batcher.pad_fraction").value == round(
+                1.0 - real / computed, 4
+            )
+        finally:
+            gate.set()
+            server.close()
+
+    def test_freed_slots_admit_waiting_requests(self, monkeypatch):
+        """More requests than slots: a 2-slot pool serves 6 requests by
+        admitting into freed slots, never batching beyond the pool."""
+        monkeypatch.setenv("SPARKDL_RAGGED", "1")
+        seen = []
+        server = ModelServer(ServingConfig(
+            max_batch=2, max_wait_ms=5.0, queue_capacity=64,
+        ))
+
+        def forward(x):
+            x = np.asarray(x)
+            seen.append(int(x.shape[0]))
+            return x * 2.0
+
+        server.register("ep", forward, item_shape=(4,), compile=False)
+        try:
+            futs = [server.submit(np.ones(4, np.float32))
+                    for _ in range(6)]
+            for f in futs:
+                np.testing.assert_allclose(f.result(timeout=10.0), 2.0)
+            assert sum(seen) == 6
+            assert max(seen) <= 2, (
+                f"dispatch exceeded the slot pool: {seen}"
+            )
+            snap = server.status()["endpoints"]["ep"]["slot_pool"]
+            assert snap["n_slots"] == 2
+        finally:
+            server.close()
+
+    def test_single_request_dispatches_without_coalesce_wait(
+        self, monkeypatch
+    ):
+        """Slot dispatch admits the moment a request arrives — a lone
+        request against an effectively-infinite coalesce window must
+        still resolve immediately."""
+        monkeypatch.setenv("SPARKDL_RAGGED", "1")
+        server = ModelServer(ServingConfig(
+            max_batch=8, max_wait_ms=3_600_000.0,
+        ))
+        server.register("ep", lambda x: np.asarray(x) * 2.0,
+                        item_shape=(4,), compile=False)
+        try:
+            t0 = time.monotonic()
+            fut = server.submit(np.ones(4, np.float32))
+            np.testing.assert_allclose(fut.result(timeout=10.0), 2.0)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            server.close()
+
+    def test_prologue_fused_matches_host_application(self):
+        """The fused prologue must equal applying the same callable on
+        the host before the forward — one program, same bytes."""
+        from sparkdl_tpu.transformers.utils import make_input_prologue
+
+        pro = make_input_prologue(preprocess=lambda x: x / 255.0)
+        x = np.arange(4, dtype=np.float32)
+        server = ModelServer(ServingConfig(max_batch=4, max_wait_ms=5.0))
+        server.register("fused", lambda b: np.asarray(b) + 1.0,
+                        item_shape=(4,), compile=False, prologue=pro)
+        server.register("host", lambda b: np.asarray(b) + 1.0,
+                        item_shape=(4,), compile=False)
+        try:
+            fused = np.asarray(
+                server.submit(x, model_id="fused").result(timeout=10.0)
+            )
+            host_in = np.asarray(pro(x[None]))[0]
+            host = np.asarray(
+                server.submit(host_in, model_id="host").result(
+                    timeout=10.0
+                )
+            )
+            assert fused.tobytes() == host.tobytes()
+        finally:
+            server.close()
+
+
+class TestWarmStartResultIntegrity:
+    """The r20 warm-start corruption regression: a disk-loaded
+    executable may hand later calls the same output buffer (and
+    zero-copy-alias host inputs), so fetched results must leave the
+    dispatch window as owned copies — a request's future must keep its
+    row even after later batches run through the same executable."""
+
+    DIM = 4
+
+    @pytest.mark.parametrize("ragged", ["1", "0"])
+    def test_warm_loaded_endpoint_serves_correct_rows(
+        self, tmp_path, monkeypatch, ragged
+    ):
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("SPARKDL_RAGGED", ragged)
+        monkeypatch.setenv("SPARKDL_COMPILE_CACHE", str(tmp_path / "exe"))
+        scale = np.linspace(0.5, 1.5, self.DIM, dtype=np.float32)
+        xs = [np.full(self.DIM, float(i + 1), np.float32)
+              for i in range(24)]
+
+        def serve_all():
+            server = ModelServer(ServingConfig(
+                max_batch=8, max_wait_ms=2.0, queue_capacity=64,
+            ))
+            server.register(
+                "jit", lambda x, _s=scale: jnp.tanh(x * _s),
+                item_shape=(self.DIM,), compile=True,
+                fingerprint="test:warmstart:v1",
+            )
+            try:
+                futs = [server.submit(x, model_id="jit") for x in xs]
+                return np.stack([
+                    np.asarray(f.result(timeout=30.0)) for f in futs
+                ])
+            finally:
+                server.close()
+
+        expect = np.stack([np.tanh(x * scale) for x in xs])
+        cold = serve_all()   # compiles, persists under the fingerprint
+        warm = serve_all()   # fresh ProgramCache in-process -> disk load
+        np.testing.assert_allclose(cold, expect, atol=1e-6)
+        # every request reads ITS row — not a later batch's rewrite of
+        # a shared output buffer
+        np.testing.assert_array_equal(warm, cold)
+
+    def test_fetched_results_own_their_memory(self):
+        import jax.numpy as jnp
+
+        from sparkdl_tpu.engine.executor import _fetch_host
+
+        host = _fetch_host(jnp.arange(8, dtype=jnp.float32))
+        assert isinstance(host, np.ndarray)
+        assert host.base is None and host.flags.owndata

@@ -71,13 +71,13 @@ def rule_lines(report, rule_id):
 # framework plumbing
 # ---------------------------------------------------------------------------
 
-def test_registry_has_all_fifteen_rules():
+def test_registry_has_all_sixteen_rules():
     assert set(all_rule_ids()) == {
         "lock-order", "lock-blocking", "host-sync", "recompile-hazard",
         "donation-safety", "contextvar-leak", "sleep-retry", "metric-name",
         "raw-jit", "exception-safety", "resource-lifecycle",
         "fault-site-coverage", "wire-envelope", "error-taxonomy",
-        "raw-clock",
+        "raw-clock", "bucket-pad",
     }
 
 
@@ -2041,3 +2041,67 @@ def test_raw_clock_inline_suppression(tmp_path):
     )
     assert report.findings == []
     assert len(report.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# bucket-pad (ISSUE-20)
+# ---------------------------------------------------------------------------
+
+def test_bucket_pad_flags_pad_in_serving(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/batcher.py",
+        """
+        from sparkdl_tpu.transformers.utils import pad_to_batch
+
+        def run(batch, bucket):
+            return pad_to_batch(batch, bucket)
+        """,
+        rules=["bucket-pad"],
+    )
+    assert rule_lines(report, "bucket-pad") == [5]
+    assert "slot block" in report.findings[0].message
+
+
+def test_bucket_pad_flags_attribute_spelling(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/router.py",
+        """
+        from sparkdl_tpu.transformers import utils
+
+        def run(batch, bucket):
+            return utils.pad_to_batch(batch, bucket)
+        """,
+        rules=["bucket-pad"],
+    )
+    assert rule_lines(report, "bucket-pad") == [5]
+
+
+def test_bucket_pad_sanctioned_fallback_is_suppressed(tmp_path):
+    report = check_snippet(
+        tmp_path, "serving/batcher.py",
+        """
+        from sparkdl_tpu.transformers.utils import pad_to_batch
+
+        def run(batch, bucket):
+            return pad_to_batch(  # sparkdl: disable=bucket-pad
+                batch, bucket
+            )
+        """,
+        rules=["bucket-pad"],
+    )
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+
+
+def test_bucket_pad_ignores_transformers_batch_path(tmp_path):
+    """Offline Spark-partition batching legitimately pads — the rule
+    scopes to the serving hot path only."""
+    report = check_snippet(
+        tmp_path, "transformers/utils.py",
+        """
+        def chunked(chunks, batch_size):
+            return [pad_to_batch(c, batch_size) for c in chunks]
+        """,
+        rules=["bucket-pad"],
+    )
+    assert report.findings == []

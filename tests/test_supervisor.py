@@ -317,11 +317,18 @@ class TestReplicaSpec:
 # supervisor.replica_serve under sustained traffic
 # ----------------------------------------------------------------------
 class TestKillMatrix:
-    @pytest.mark.parametrize("lane", ["tcp", "shm"])
+    # ragged slot-block dispatch defaults ON (ISSUE-20), so the two
+    # lane cases already prove zero accepted loss through the ragged
+    # path; the third case pins the SPARKDL_RAGGED=0 padded-ladder
+    # fallback to the same contract
+    @pytest.mark.parametrize("lane,ragged", [
+        ("tcp", "1"), ("shm", "1"), ("shm", "0"),
+    ])
     def test_replica_kill_under_load_loses_nothing(
-        self, lane, monkeypatch
+        self, lane, ragged, monkeypatch
     ):
         monkeypatch.setenv("SPARKDL_WIRE_TRANSPORT", lane)
+        monkeypatch.setenv("SPARKDL_RAGGED", ragged)
         sup = fast_supervisor(
             replicas=2,
             fault_plans={0: [{

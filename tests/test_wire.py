@@ -148,7 +148,10 @@ class TestEnvelopeSchema:
         },
         "shm_handshake": {
             "op": "shm_attach", "shm": "psm_fixture",
-            "ring_bytes": 1 << 20,
+            "ring_bytes": 1 << 20, "efd": "sdw_efd_fixture",
+        },
+        "shm_handshake_reply": {
+            "ok": True, "eventfd": True,
         },
         "reply": {
             "ok": True, "result": None, "server_ms": 3.25,
@@ -502,6 +505,56 @@ class TestTransports:
             assert metrics.counter("wire.shm.fallback").value > before
             t.close()
             assert my_shm_entries() == []
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    @pytest.mark.skipif(
+        not (hasattr(os, "eventfd") and hasattr(socket, "send_fds")),
+        reason="eventfd doorbells need os.eventfd + SCM_RIGHTS passing",
+    )
+    def test_shm_doorbells_ride_eventfd(self):
+        """Where the platform supports it, shm doorbells are eventfd
+        wakes — the socket side-channel carries zero doorbell bytes."""
+        srv, port = start_echo()
+        try:
+            efd_before = metrics.counter("wire.doorbell.eventfd").value
+            sock_before = metrics.counter("wire.doorbell.socket").value
+            t = transport.ShmTransport("127.0.0.1", port)
+            x = np.ones(8, np.float32)
+            for i in range(6):
+                reply = t.request({"op": "infer", "value": x + i}, 5.0)
+                np.testing.assert_array_equal(reply["result"], (x + i) * 2)
+            assert t.lane == "shm"
+            t.close()
+            assert metrics.counter("wire.doorbell.eventfd").value \
+                > efd_before
+            assert metrics.counter("wire.doorbell.socket").value \
+                == sock_before
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_eventfd_kill_switch_forces_socket_doorbells(
+        self, monkeypatch
+    ):
+        """SPARKDL_WIRE_EVENTFD=0 must pin every doorbell to the socket
+        byte — the portable path stays exercised and killable."""
+        monkeypatch.setenv("SPARKDL_WIRE_EVENTFD", "0")
+        srv, port = start_echo()
+        try:
+            efd_before = metrics.counter("wire.doorbell.eventfd").value
+            sock_before = metrics.counter("wire.doorbell.socket").value
+            t = transport.ShmTransport("127.0.0.1", port)
+            x = np.ones(8, np.float32)
+            reply = t.request({"op": "infer", "value": x}, 5.0)
+            np.testing.assert_array_equal(reply["result"], x * 2)
+            assert t.lane == "shm"
+            t.close()
+            assert metrics.counter("wire.doorbell.eventfd").value \
+                == efd_before
+            assert metrics.counter("wire.doorbell.socket").value \
+                > sock_before
         finally:
             srv.shutdown()
             srv.server_close()
